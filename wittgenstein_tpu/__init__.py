@@ -1,0 +1,7 @@
+"""wittgenstein_tpu — a TPU-native discrete-event simulator for consensus
+protocols, with the capabilities of ConsenSys/wittgenstein re-designed for
+JAX/XLA: struct-of-arrays node state, fixed-shape time-bucketed mailboxes,
+counter-based PRNG determinism, and vmap/shard_map scaling over nodes & seeds.
+"""
+
+__version__ = "0.1.0"

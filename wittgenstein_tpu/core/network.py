@@ -1,0 +1,252 @@
+"""The TPU-native discrete-event engine.
+
+This replaces the reference's single-threaded event loop (core/Network.java:
+receiveUntil/nextMessage, :533-637) with a fixed-shape, jit-compiled
+millisecond step driven by `lax.scan`:
+
+  per ms t:   inbox  = mailbox slice [N, C] + broadcast recompute [N, B]
+              state' = protocol.step(state, inbox, t)       # all N nodes at once
+              mailbox.scatter(outbox arrivals)              # sort-based binning
+
+Determinism comes for free: every random draw is a pure function of
+(seed, t, ids) via `ops.prng`, and same-ms delivery order is fixed by the
+stable sort in the scatter — the tensor analogue of the reference's
+deterministic same-ms linked lists (Network.java:108-115).
+
+Design notes vs the reference:
+  * Arrivals beyond ``t + horizon - 1`` are clamped into the ring (the
+    reference's rolling 60 s storage, Network.java:201-299, supports arbitrary
+    horizons; `msg_discard_time` Network.java:36-40 is the sanctioned way to
+    model bounded delivery windows).
+  * Per-(node, ms) unicast deliveries beyond `inbox_cap` are counted in
+    `NetState.dropped`; size the capacity for the protocol (tests assert 0).
+  * Partition membership is evaluated at delivery time for broadcasts (the
+    reference evaluates it at send time, Network.java:478); identical unless a
+    partition changes while a message is in flight.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import prng
+from .latency import full_latency
+from .state import EngineConfig, Inbox, NetState, Outbox
+
+
+def _retire_broadcasts(cfg: EngineConfig, net: NetState) -> NetState:
+    # A broadcast's last possible arrival is bc_time + horizon - 1.
+    live = net.bc_active & ((net.time - net.bc_time) < cfg.horizon)
+    return net.replace(bc_active=live)
+
+
+def build_inbox(cfg: EngineConfig, model, net: NetState, t):
+    """Assemble the time-t inbox and bump receive counters.
+
+    Mirrors the delivery path of Network.java:587-637: down destinations and
+    cross-partition messages are silently dropped (:603-613), receive counters
+    bumped per delivered message (:611-612).
+    """
+    nodes = net.nodes
+    n, c, b = cfg.n, cfg.inbox_cap, cfg.bcast_slots
+    h = t % cfg.horizon
+
+    # --- unicast slice ---
+    uc_data = net.box_data[h]                      # [N, C, F]
+    uc_src = net.box_src[h]                        # [N, C]
+    uc_size = net.box_size[h]
+    uc_valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
+    deliver_ok = (~nodes.down[:, None]) & (
+        nodes.partition[uc_src] == nodes.partition[:, None])
+    uc_valid = uc_valid & deliver_ok
+
+    # --- broadcast recompute: which records arrive at exactly t? ---
+    node_idx = jnp.arange(n, dtype=jnp.int32)
+    delta = prng.uniform_delta(net.bc_seed[:, None], node_idx[None, :])  # [B, N]
+    lat = full_latency(model, nodes, net.bc_src[:, None], node_idx[None, :],
+                       delta)
+    lat = jnp.clip(lat, 1, cfg.horizon - 2)
+    arrival = net.bc_time[:, None] + 1 + lat
+    bc_valid = (net.bc_active[:, None] & (arrival == t)
+                & (lat < cfg.msg_discard_time)
+                & (~nodes.down[None, :])
+                & (nodes.partition[net.bc_src][:, None] ==
+                   nodes.partition[None, :]))               # [B, N]
+    bc_valid = jnp.transpose(bc_valid)                      # [N, B]
+    bc_data = jnp.broadcast_to(net.bc_payload[None, :, :],
+                               (n, b, cfg.payload_words))
+    bc_src = jnp.broadcast_to(net.bc_src[None, :], (n, b))
+    bc_size = jnp.broadcast_to(net.bc_size[None, :], (n, b))
+
+    inbox = Inbox(
+        data=jnp.concatenate([uc_data, bc_data], axis=1),
+        src=jnp.concatenate([uc_src, bc_src], axis=1),
+        valid=jnp.concatenate([uc_valid, bc_valid], axis=1),
+    )
+
+    recv = (jnp.sum(uc_valid, 1) + jnp.sum(bc_valid, 1)).astype(jnp.int32)
+    rbytes = (jnp.sum(jnp.where(uc_valid, uc_size, 0), 1) +
+              jnp.sum(jnp.where(bc_valid, bc_size, 0), 1)).astype(jnp.int32)
+    nodes = nodes.replace(msg_received=nodes.msg_received + recv,
+                          bytes_received=nodes.bytes_received + rbytes)
+    return inbox, nodes
+
+
+def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
+    """Route the step's unicast sends into the mailbox ring.
+
+    The reference creates one MessageArrival per destination with a fresh
+    latency draw, sorts them, and links them into per-ms buckets
+    (Network.java:449-487).  Here: one latency draw per message, then a
+    stable sort on (arrival, dest) bins messages into ring slots; rank within
+    a (ms, dest) group + the current fill count gives each message its slot.
+    """
+    nodes = net.nodes
+    n, k, c = cfg.n, cfg.out_deg, cfg.inbox_cap
+    m = n * k
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    dest = out.dest.reshape(m)
+    payload = out.payload.reshape(m, cfg.payload_words)
+    size = out.size.reshape(m)
+
+    want = (dest >= 0) & (~nodes.down[src])
+    dest_c = jnp.clip(dest, 0, n - 1)
+
+    # Attempted sends bump the sender's counters regardless of whether the
+    # destination is reachable (Network.java:475-477 increments before the
+    # partition/down checks).
+    sent = nodes.msg_sent.at[src].add(want.astype(jnp.int32))
+    sbytes = nodes.bytes_sent.at[src].add(jnp.where(want, size, 0))
+    nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
+
+    seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
+    delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
+    lat = full_latency(model, nodes, src, dest_c, delta)
+    lat = jnp.clip(lat, 1, cfg.horizon - 2)
+    valid = want & (lat < cfg.msg_discard_time) & (~nodes.down[dest_c]) & (
+        nodes.partition[src] == nodes.partition[dest_c])
+
+    arrival = t + 1 + lat
+    rel = arrival - t                                   # in [2, horizon-1]
+    # Two-pass stable radix sort on (rel, dest): avoids the int32 overflow a
+    # fused `rel * n + dest` key would hit for n in the millions, yet still
+    # yields one deterministic order with (rel, dest) groups contiguous.
+    big = jnp.int32(0x7FFFFFFF)
+    rel_k = jnp.where(valid, rel, big)
+    dest_k = jnp.where(valid, dest_c, big)
+    o1 = jnp.argsort(dest_k, stable=True)
+    order = o1[jnp.argsort(rel_k[o1], stable=True)]
+    rel_s, dest_s = rel_k[order], dest_k[order]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    new_grp = ((rel_s != jnp.roll(rel_s, 1)) |
+               (dest_s != jnp.roll(dest_s, 1))).at[0].set(True)
+    rank = idx - jax.lax.cummax(jnp.where(new_grp, idx, 0))
+
+    h_s = (arrival % cfg.horizon)[order]
+    d_s = dest_c[order]
+    ok_s = valid[order]
+    slot = net.box_count[h_s, d_s] + rank
+    ok_s = ok_s & (slot < c)
+    slot_w = jnp.where(ok_s, slot, c)                  # c is OOB -> dropped
+
+    box_data = net.box_data.at[h_s, d_s, slot_w].set(payload[order],
+                                                     mode="drop")
+    box_src = net.box_src.at[h_s, d_s, slot_w].set(src[order], mode="drop")
+    box_size = net.box_size.at[h_s, d_s, slot_w].set(size[order], mode="drop")
+    box_count = net.box_count.at[h_s, d_s].add(ok_s.astype(jnp.int32),
+                                               mode="drop")
+    dropped = net.dropped + jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)
+    return net.replace(nodes=nodes, box_data=box_data, box_src=box_src,
+                       box_size=box_size, box_count=box_count, dropped=dropped)
+
+
+def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
+    """Allocate broadcast-table slots for this step's sendAll requests."""
+    nodes = net.nodes
+    n, b = cfg.n, cfg.bcast_slots
+    req = out.bcast & (~nodes.down)
+
+    # sendAll counts one attempted send per destination (all N nodes,
+    # including self — Network.java:341-347 sends to allNodes).
+    sent = nodes.msg_sent + jnp.where(req, n, 0).astype(jnp.int32)
+    sbytes = nodes.bytes_sent + jnp.where(req, out.bcast_size * n, 0)
+    nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
+
+    rank = jnp.cumsum(req.astype(jnp.int32)) - 1          # rank per requester
+    free = ~net.bc_active
+    n_free = jnp.sum(free).astype(jnp.int32)
+    slot_order = jnp.argsort(~free, stable=True)          # free slots first
+    ok = req & (rank < n_free)
+    slot = slot_order[jnp.clip(rank, 0, b - 1)]
+    slot_w = jnp.where(ok, slot, b)                       # b is OOB -> dropped
+
+    node_idx = jnp.arange(n, dtype=jnp.int32)
+    bseed = prng.hash3(prng.hash2(net.seed, prng.TAG_BCAST),
+                       jnp.full((n,), t, jnp.int32), node_idx)
+    return net.replace(
+        nodes=nodes,
+        bc_active=net.bc_active.at[slot_w].set(True, mode="drop"),
+        bc_src=net.bc_src.at[slot_w].set(node_idx, mode="drop"),
+        bc_time=net.bc_time.at[slot_w].set(t, mode="drop"),
+        bc_payload=net.bc_payload.at[slot_w].set(out.bcast_payload,
+                                                 mode="drop"),
+        bc_size=net.bc_size.at[slot_w].set(out.bcast_size, mode="drop"),
+        bc_seed=net.bc_seed.at[slot_w].set(bseed.astype(jnp.int32),
+                                           mode="drop"),
+        bc_dropped=net.bc_dropped + jnp.sum(req & ~ok).astype(jnp.int32),
+    )
+
+
+def step_ms(protocol, net: NetState, pstate):
+    """Advance the simulation by exactly one millisecond (pure, jittable)."""
+    cfg, model = protocol.cfg, protocol.latency
+    t = net.time
+    net = _retire_broadcasts(cfg, net)
+    inbox, nodes = build_inbox(cfg, model, net, t)
+    net = net.replace(nodes=nodes)
+
+    key = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
+    pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key)
+    net = net.replace(nodes=nodes)
+
+    # Clear the consumed slot, then route new sends (their arrivals are
+    # >= t+2, so they can never land in the slot just cleared).
+    net = net.replace(box_count=net.box_count.at[t % cfg.horizon].set(0))
+    net = enqueue_unicast(cfg, model, net, out, t)
+    net = enqueue_broadcast(cfg, net, out, t)
+    return net.replace(time=t + 1), pstate
+
+
+class Runner:
+    """Drives a protocol; caches one jitted scan per distinct chunk length.
+
+    The analogue of Network.runMs (Network.java:318-338) — but a whole chunk
+    of milliseconds is a single device program.
+    """
+
+    def __init__(self, protocol, donate=True):
+        self.protocol = protocol
+        self._jits = {}
+        self._donate = donate
+        self._validated = False
+
+    def _chunk_fn(self, ms):
+        if ms not in self._jits:
+            def run(net, pstate):
+                def body(carry, _):
+                    return step_ms(self.protocol, *carry), ()
+                (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms)
+                return net2, p2
+            kw = {"donate_argnums": (0, 1)} if self._donate else {}
+            self._jits[ms] = jax.jit(run, **kw)
+        return self._jits[ms]
+
+    def run_ms(self, net, pstate, ms: int):
+        if not self._validated:
+            validate = getattr(self.protocol.latency, "validate", None)
+            if validate is not None and not isinstance(
+                    jnp.asarray(net.nodes.city), jax.core.Tracer):
+                validate(net.nodes)
+            self._validated = True
+        return self._chunk_fn(int(ms))(net, pstate)

@@ -1,0 +1,39 @@
+"""Protocol contract — the TPU-native analogue of core/Protocol.java:9-22.
+
+The reference contract is three methods: ``network()``, ``copy()``, ``init()``.
+Here a protocol is a *pure description*:
+
+  - static attributes: `cfg` (EngineConfig), `latency` (a latency model), and
+    whatever parameters the protocol needs (the WParameters analogue is the
+    protocol's constructor arguments, kept as plain Python/JSON-able values);
+  - ``init(seed) -> (NetState, pstate)`` builds the whole simulation state
+    from a seed (the analogue of copy()+init(): re-calling init with the same
+    seed IS the reference's copy()-reproducibility contract, tested the same
+    way HandelTest.java:14-34 tests it);
+  - ``step(pstate, nodes, inbox, t, key) -> (pstate, nodes, outbox)`` is the
+    per-ms transition for ALL nodes at once — the vectorized replacement for
+    every Message.action + registered task of the reference.
+
+Protocols register themselves by class name so the scenario harness and the
+REST server can look them up by string, mirroring the wserver's classpath
+scan (wserver/Server.java:56-70).
+"""
+
+from __future__ import annotations
+
+from .state import EngineConfig  # noqa: F401  (re-export for implementors)
+
+PROTOCOLS: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: adds the protocol to the global name registry."""
+    PROTOCOLS[cls.__name__] = cls
+    return cls
+
+
+def get_protocol(name: str):
+    if name not in PROTOCOLS:
+        raise KeyError(
+            f"unknown protocol {name!r}; known: {sorted(PROTOCOLS)}")
+    return PROTOCOLS[name]

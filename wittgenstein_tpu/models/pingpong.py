@@ -1,0 +1,75 @@
+"""PingPong — the reference's canonical sample protocol (README.md:44-121,
+protocols/PingPong.java).
+
+A witness node broadcasts a Ping to every node; each node replies with a Pong
+to the sender; the witness counts Pongs.  The README publishes the expected
+convergence curve for 1000 nodes under NetworkLatencyByDistance
+(README.md:123-135) — our golden test checks the same qualitative curve.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders
+from ..core.latency import NetworkLatencyByDistanceWJitter
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+
+PING, PONG = 0, 1
+
+
+@struct.dataclass
+class PingPongState:
+    pongs: jnp.ndarray  # int32 scalar — pongs seen by the witness
+
+
+@register
+class PingPong:
+    """Parameters mirror PingPong.PingPongParameters (PingPong.java)."""
+
+    def __init__(self, node_count=1000, witness=0, latency=None,
+                 node_builder=None):
+        self.node_count = node_count
+        self.witness = witness
+        self.latency = latency or NetworkLatencyByDistanceWJitter()
+        self.builder = node_builder or builders.NodeBuilder()
+        # Pongs can pile up at the witness: with 1000 nodes the arrival curve
+        # peaks around a dozen per ms, so give the witness headroom.
+        self.cfg = EngineConfig(n=node_count, horizon=1024, inbox_cap=32,
+                                payload_words=1, out_deg=1, bcast_slots=2)
+
+    def init(self, seed):
+        nodes = self.builder.build(seed, self.node_count)
+        net = init_net(self.cfg, nodes, seed)
+        return net, PingPongState(pongs=jnp.asarray(0, jnp.int32))
+
+    def step(self, pstate, nodes, inbox, t, key):
+        n = self.cfg.n
+        out = empty_outbox(self.cfg)
+
+        # t == 0: the witness fires sendAll(Ping) (PingPong.java main flow).
+        is_witness = jnp.arange(n) == self.witness
+        out = out.replace(
+            bcast=is_witness & (t == 0),
+            bcast_payload=jnp.full((n, 1), PING, jnp.int32))
+
+        # On Ping: reply Pong to the ping's sender.
+        is_ping = inbox.valid & (inbox.data[:, :, 0] == PING)
+        any_ping = jnp.any(is_ping, axis=1)
+        first = jnp.argmax(is_ping, axis=1)
+        ping_src = jnp.take_along_axis(inbox.src, first[:, None],
+                                       axis=1)[:, 0]
+        out = out.replace(
+            dest=jnp.where(any_ping, ping_src, -1)[:, None],
+            payload=jnp.full((n, 1, 1), PONG, jnp.int32))
+
+        # The witness counts Pongs.
+        is_pong = inbox.valid & (inbox.data[:, :, 0] == PONG)
+        got = jnp.sum(jnp.where(is_witness[:, None], is_pong, False))
+        pstate = pstate.replace(pongs=pstate.pongs + got.astype(jnp.int32))
+        return pstate, nodes, out
+
+    def done(self, pstate, nodes):
+        return pstate.pongs >= self.node_count

@@ -1,0 +1,83 @@
+"""Counter-based (stateless) pseudo-random draws.
+
+The reference simulator never *stores* per-destination randomness: a multicast
+envelope recomputes each destination's latency draw from
+``hash(nodeId) ^ randomSeed`` (reference: core Network.java:493-503 and
+Envelope.java:45-56, the "95% of memory is messages" optimisation).  That trick
+is exactly a counter-based PRNG, which is also the idiomatic TPU design: no RNG
+state to carry through `lax.scan`, every draw is a pure function of
+(base_seed, purpose, ids), so a simulation is reproducible from its seed alone
+and vmappable over seeds.
+
+We use a murmur3-style 32-bit finalizer rather than Java's xorshift, since we
+target self-determinism + statistical equivalence, not JVM bit-parity
+(SURVEY.md §7.4.3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_U32 = jnp.uint32
+
+# Domain-separation tags: every subsystem derives its draws from
+# hash2(base_seed, TAG) so no two subsystems ever share a stream (otherwise
+# e.g. node x-positions and latency deltas at t=1 would be correlated).
+TAG_BUILDER = 0x4E4F4445   # node builder draws
+TAG_LATENCY = 0x4C415443   # engine unicast latency deltas
+TAG_BCAST = 0x42434153     # engine broadcast latency seeds
+TAG_PROTO = 0x50524F54     # protocol-internal draws
+
+
+def mix32(x):
+    """murmur3 fmix32 on uint32 arrays — a high-quality bijective mixer."""
+    x = jnp.asarray(x).astype(_U32)
+    x = x ^ (x >> _U32(16))
+    x = x * _U32(0x85EBCA6B)
+    x = x ^ (x >> _U32(13))
+    x = x * _U32(0xC2B2AE35)
+    x = x ^ (x >> _U32(16))
+    return x
+
+
+def hash2(a, b):
+    """Combine two uint32 streams into one mixed uint32."""
+    a = jnp.asarray(a).astype(_U32)
+    b = jnp.asarray(b).astype(_U32)
+    return mix32(mix32(a) ^ (b * _U32(0x9E3779B9)))
+
+
+def hash3(a, b, c):
+    return hash2(hash2(a, b), c)
+
+
+def uniform_delta(seed, ids):
+    """Deterministic uniform int in [0, 100) per id — the reference's
+    ``getPseudoRandom(nodeId, randomSeed)`` contract (Network.java:489-503):
+    same (seed, id) always yields the same delta, used to index latency
+    distributions."""
+    return (hash2(ids, seed) % _U32(100)).astype(jnp.int32)
+
+
+def uniform_u32(seed, ids):
+    """Deterministic uint32 per id."""
+    return hash2(ids, seed)
+
+
+def uniform_float(seed, ids):
+    """Deterministic float32 in [0, 1) per id.  Uses the top 24 bits so the
+    float32 cast is exact — a raw uint32/2^32 scale rounds values near 2^32
+    up to exactly 1.0, violating the half-open interval."""
+    return ((uniform_u32(seed, ids) >> _U32(8)).astype(jnp.float32) *
+            jnp.float32(1.0 / (1 << 24)))
+
+
+def uniform_int(seed, ids, n):
+    """Deterministic int32 in [0, n) per id (n may be a traced array)."""
+    n = jnp.asarray(n).astype(_U32)
+    return (hash2(ids, seed) % jnp.maximum(n, _U32(1))).astype(jnp.int32)
+
+
+def bernoulli(seed, ids, p):
+    """Deterministic bernoulli(p) per id; p float array or scalar."""
+    return uniform_float(seed, ids) < p
