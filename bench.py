@@ -1,0 +1,58 @@
+"""Benchmark entry point — prints ONE JSON line with the headline metric.
+
+Current headline: simulated-ms/sec running the README PingPong example
+(1000 nodes, distance latency) end to end.  This will switch to the Handel
+99%-aggregation wall-clock once Handel lands.
+
+vs_baseline: the reference publishes no wall-clock numbers (BASELINE.md), so
+the ratio is against the driver's north-star budget for the config.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+
+def bench_pingpong(n=1000, total_ms=768, chunk=256, repeats=3):
+    from wittgenstein_tpu.core.network import Runner
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    proto = PingPong(node_count=n)
+    runner = Runner(proto, donate=False)
+
+    # compile + warmup
+    net, p = proto.init(seed=0)
+    net, p = runner.run_ms(net, p, chunk)
+    jax.block_until_ready(net.time)
+
+    best = float("inf")
+    for _ in range(repeats):
+        net, p = proto.init(seed=0)
+        jax.block_until_ready(net.time)
+        t0 = time.perf_counter()
+        for _ in range(total_ms // chunk):
+            net, p = runner.run_ms(net, p, chunk)
+        jax.block_until_ready(net.time)
+        best = min(best, time.perf_counter() - t0)
+    assert int(p.pongs) >= n - 1, f"pingpong did not converge: {int(p.pongs)}"
+    return total_ms / best
+
+
+def main():
+    sim_ms_per_sec = bench_pingpong()
+    # Budget: drive the 1k-node README example at >= 10k simulated-ms/sec
+    # (about 14 simulated runs per wall-second).
+    out = {
+        "metric": "pingpong_1k_simulated_ms_per_sec",
+        "value": round(sim_ms_per_sec, 1),
+        "unit": "sim_ms/s",
+        "vs_baseline": round(sim_ms_per_sec / 10_000.0, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,10 +66,13 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     delta = prng.uniform_delta(net.bc_seed[:, None], node_idx[None, :])  # [B, N]
     lat = full_latency(model, nodes, net.bc_src[:, None], node_idx[None, :],
                        delta)
+    # Discard is checked against the TRUE latency (Network.java:481 compares
+    # nt before any storage), then the survivor is clamped into the ring.
+    not_discarded = lat < cfg.msg_discard_time
     lat = jnp.clip(lat, 1, cfg.horizon - 2)
     arrival = net.bc_time[:, None] + 1 + lat
     bc_valid = (net.bc_active[:, None] & (arrival == t)
-                & (lat < cfg.msg_discard_time)
+                & not_discarded
                 & (~nodes.down[None, :])
                 & (nodes.partition[net.bc_src][:, None] ==
                    nodes.partition[None, :]))               # [B, N]
@@ -123,8 +126,9 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
     delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
     lat = full_latency(model, nodes, src, dest_c, delta)
+    not_discarded = lat < cfg.msg_discard_time
     lat = jnp.clip(lat, 1, cfg.horizon - 2)
-    valid = want & (lat < cfg.msg_discard_time) & (~nodes.down[dest_c]) & (
+    valid = want & not_discarded & (~nodes.down[dest_c]) & (
         nodes.partition[src] == nodes.partition[dest_c])
 
     arrival = t + 1 + lat
