@@ -37,7 +37,8 @@ def bench_pingpong(n=1000, total_ms=768, chunk=256, repeats=3):
             net, p = runner.run_ms(net, p, chunk)
         jax.block_until_ready(net.time)
         best = min(best, time.perf_counter() - t0)
-    assert int(p.pongs) >= n - 1, f"pingpong did not converge: {int(p.pongs)}"
+    assert int(p.pongs) == n, f"pingpong did not converge: {int(p.pongs)}"
+    assert int(net.dropped) == 0 and int(net.bc_dropped) == 0
     return total_ms / best
 
 

@@ -1,0 +1,80 @@
+"""Harness tests — the analogue of the reference's use of RunMultipleTimes /
+ProgressPerTime in protocol tests (RunMultipleTimes.java, ProgressPerTime.java)."""
+
+import jax.numpy as jnp
+
+from wittgenstein_tpu.core import harness
+from wittgenstein_tpu.core.latency import (NetworkFixedLatency, get_by_name,
+                                           latency_name)
+from wittgenstein_tpu.models.pingpong import PingPong
+from wittgenstein_tpu.utils import stats
+
+
+def small_pingpong():
+    # Constant latency lands every pong on the same ms at the witness, so
+    # the inbox must hold all 64 of them.
+    return PingPong(node_count=64, latency=NetworkFixedLatency(20),
+                    inbox_cap=64)
+
+
+def test_run_multiple_times_completes_and_averages():
+    proto = small_pingpong()
+    res = harness.run_multiple_times(
+        proto, run_count=3, max_time=500, chunk=10,
+        stats_getters=(stats.done_at_stats, stats.msg_received_stats,
+                       stats.done_count),
+        final_check=lambda net, p: p.pongs >= proto.node_count)
+    # fixed latency 20: pings arrive t=21 (send t+1 + latency), pongs t=42
+    # -> all runs stop at the first 10ms boundary after 42.
+    assert [int(x) for x in res.stopped_at] == [50, 50, 50]
+    assert res.stats["doneCount"]["count"] == 64.0
+    # every node received either the ping (repliers) or 64 pongs+own ping
+    assert res.stats["msgReceived"]["min"] == 1.0
+    assert res.stats["msgReceived"]["max"] == 65.0
+    assert res.stats["doneAt"]["max"] == 42.0
+
+
+def test_run_multiple_times_is_deterministic():
+    proto = PingPong(node_count=64)
+    r1 = harness.run_multiple_times(proto, 2, max_time=800,
+                                    stats_getters=(stats.done_at_stats,))
+    r2 = harness.run_multiple_times(proto, 2, max_time=800,
+                                    stats_getters=(stats.done_at_stats,))
+    assert r1.stats == r2.stats
+    # distinct seeds genuinely differ (positions -> latencies -> doneAt)
+    per = r1.per_run["doneAt"]["avg"]
+    assert float(per[0]) != float(per[1])
+
+
+def test_frozen_runs_keep_their_stop_state():
+    proto = small_pingpong()
+    res = harness.run_multiple_times(
+        proto, run_count=2, max_time=500,
+        stats_getters=(stats.msg_sent_stats,))
+    # witness sent 64 (sendAll) + 1 pong to itself, repliers 1 each; frozen
+    # runs must not keep counting after stopping.
+    assert res.stats["msgSent"]["max"] == 65.0
+    assert res.stats["msgSent"]["min"] == 1.0
+    assert int(res.nets.time[0]) == int(res.stopped_at[0])
+
+
+def test_progress_per_time_series():
+    proto = small_pingpong()
+    ts, nets, ps = harness.progress_per_time(
+        proto, run_count=2, max_time=300, stat_each_ms=10,
+        stats_getters=(stats.done_count,))
+    counts = ts.merged["doneCount.count"]["avg"]
+    assert counts[-1] == 64.0
+    assert all(a <= b for a, b in zip(counts, counts[1:]))
+    assert ts.times[0] == 10 and ts.times[-1] <= 300
+
+
+def test_latency_registry():
+    assert latency_name("fixed", 100) == "NetworkFixedLatency(100)"
+    m = get_by_name("NetworkFixedLatency(100)")
+    assert m.fixed == 100
+    m = get_by_name("NetworkUniformLatency(200)")
+    assert m.max_latency == 200
+    assert get_by_name(None).name == "NetworkLatencyByDistanceWJitter"
+    assert get_by_name("NetworkNoLatency").name == "NetworkNoLatency"
+    assert get_by_name("IC3NetworkLatency").name == "IC3NetworkLatency"

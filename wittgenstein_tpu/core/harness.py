@@ -1,0 +1,171 @@
+"""Experiment harness: Monte-Carlo multi-seed runs + time-series collection.
+
+The reference runs seeds sequentially (core/RunMultipleTimes.java:41-87:
+``p.copy(); rd.setSeed(i); init(); runMs(10) while contIf``).  Here all seeds
+run **at once**: `init` and the per-ms step are vmapped over a seed axis, so a
+256-seed sweep is one device program — the DP analogue promised in SURVEY §2.6.
+
+Per-run stopping is faithful: after every `chunk` simulated ms (the
+reference's 10 ms granularity) each run's continue-predicate is evaluated
+in-kernel and finished runs are *frozen* (their state no longer changes), so
+every run's final state is exactly its state at its own stop time, and stats
+match the sequential semantics run for run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import stats as stats_mod
+from .network import scan_chunk
+
+
+def cont_until_done(net, pstate):
+    """RunMultipleTimes.contUntilDone (:90-97): continue while any live node
+    has doneAt == 0."""
+    live = ~net.nodes.down
+    return jnp.any(live & (net.nodes.done_at == 0))
+
+
+def _freeze_chunk(protocol, chunk, cont):
+    """Jitted: advance every run by `chunk` ms, keeping stopped runs frozen
+    at their stop-time state."""
+
+    one_chunk = scan_chunk(protocol, chunk)
+
+    @jax.jit
+    def chunk_all(nets, ps, stopped, stopped_at):
+        nets2, ps2 = jax.vmap(one_chunk)(nets, ps)
+
+        def sel(old, new):
+            shape = (stopped.shape[0],) + (1,) * (new.ndim - 1)
+            return jnp.where(stopped.reshape(shape), old, new)
+
+        nets3 = jax.tree.map(sel, nets, nets2)
+        ps3 = jax.tree.map(sel, ps, ps2)
+        still = jax.vmap(cont)(nets3, ps3)
+        newly_stopped = (~stopped) & (~still)
+        stopped_at = jnp.where(newly_stopped, nets3.time, stopped_at)
+        dropped = jnp.sum(nets3.dropped) + jnp.sum(nets3.bc_dropped)
+        return nets3, ps3, stopped | ~still, stopped_at, dropped
+
+    return chunk_all
+
+
+def _check_drops(dropped, where):
+    if int(dropped) > 0:
+        raise RuntimeError(
+            f"{int(dropped)} messages dropped during {where}: the protocol's "
+            "inbox_cap / out_deg / bcast_slots are undersized for this "
+            "scenario (pass fail_on_drop=False if drops are intended)")
+
+
+@dataclasses.dataclass
+class MultiRunResult:
+    nets: object          # NetState batch, leading run axis; each frozen at its stop time
+    pstates: object       # protocol state batch
+    stopped_at: jnp.ndarray   # int32 [R] — sim time when each run stopped (0 = ran to max)
+    stats: dict           # getter name -> averaged stat dict (floats)
+    per_run: dict         # getter name -> stat dict with leading run axis
+
+
+def run_multiple_times(protocol, run_count, max_time=0, chunk=10,
+                       cont_if=None, stats_getters=(), final_check=None,
+                       first_seed=0, fail_on_drop=True):
+    """Vectorized RunMultipleTimes.run (RunMultipleTimes.java:41-87).
+
+    Seeds are first_seed..first_seed+run_count-1 (the reference uses the
+    round index as seed, :46).  max_time=0 mirrors the reference's
+    "no time limit" — the loop then runs until every run's predicate stops
+    it, which never happens for a protocol that cannot converge; prefer a
+    real bound.  Returns averaged stats across runs plus per-run values.
+    """
+    cont = cont_if or cont_until_done
+    seeds = jnp.arange(first_seed, first_seed + run_count, dtype=jnp.int32)
+    nets, ps = jax.vmap(protocol.init)(seeds)
+    stopped = jnp.zeros((run_count,), bool)
+    stopped_at = jnp.zeros((run_count,), jnp.int32)
+    chunk_all = _freeze_chunk(protocol, chunk, cont)
+
+    steps = 10**9 if max_time == 0 else -(-max_time // chunk)
+    for _ in range(steps):
+        nets, ps, stopped, stopped_at, dropped = chunk_all(
+            nets, ps, stopped, stopped_at)
+        if fail_on_drop:
+            _check_drops(dropped, f"run_multiple_times({protocol})")
+        if bool(jnp.all(stopped)):
+            break
+
+    if final_check is not None:
+        ok = jax.vmap(final_check)(nets, ps)
+        if not bool(jnp.all(ok)):
+            bad = [int(s) for s in seeds[~ok]]
+            raise AssertionError(f"finalCheck failed for seeds {bad}")
+
+    per_run, averaged = {}, {}
+    for g in stats_getters:
+        vals = jax.vmap(lambda net: g(net.nodes))(nets)
+        per_run[g.stat_name] = vals
+        averaged[g.stat_name] = stats_mod.avg_stats(vals)
+    return MultiRunResult(nets=nets, pstates=ps, stopped_at=stopped_at,
+                          stats=averaged, per_run=per_run)
+
+
+@dataclasses.dataclass
+class TimeSeries:
+    times: list           # sample times (ms)
+    per_run: dict         # getter name -> list over time of stat dicts [R]
+    merged: dict          # "<getter>.<component>" -> {"min"/"max"/"avg": [...]}
+
+
+def progress_per_time(protocol, run_count=1, max_time=20_000,
+                      stat_each_ms=10, stats_getters=(), cont_if=None,
+                      first_seed=0, fail_on_drop=True):
+    """Time-series variant (core/ProgressPerTime.java:53-149): sample the
+    getters every `stat_each_ms` across all runs; merge min/avg/max across
+    the run axis per sample point.  Stopped runs are frozen exactly as in
+    `run_multiple_times`, so each run's samples flatline at its own
+    stop-time values (the sequential reference never samples a finished run
+    again; a frozen flatline is the batched equivalent)."""
+    cont = cont_if or cont_until_done
+    seeds = jnp.arange(first_seed, first_seed + run_count, dtype=jnp.int32)
+    nets, ps = jax.vmap(protocol.init)(seeds)
+    stopped = jnp.zeros((run_count,), bool)
+    stopped_at = jnp.zeros((run_count,), jnp.int32)
+    chunk_all = _freeze_chunk(protocol, stat_each_ms, cont)
+
+    @jax.jit
+    def sample(nets):
+        return {g.stat_name: jax.vmap(lambda net: g(net.nodes))(nets)
+                for g in stats_getters}
+
+    times, series = [], {g.stat_name: [] for g in stats_getters}
+    t = 0
+    while t < max_time:
+        nets, ps, stopped, stopped_at, dropped = chunk_all(
+            nets, ps, stopped, stopped_at)
+        if fail_on_drop:
+            _check_drops(dropped, f"progress_per_time({protocol})")
+        t += stat_each_ms
+        vals = sample(nets)
+        times.append(t)
+        for k, v in vals.items():
+            series[k].append(v)
+        if bool(jnp.all(stopped)):
+            break
+
+    # Merge across the run axis per sample point (Graph.statSeries,
+    # tools/Graph.java:214-251): one "<getter>.<component>" series each for
+    # min / max / avg across runs.
+    merged = {}
+    for k, samples in series.items():
+        for comp in samples[0]:
+            merged[f"{k}.{comp}"] = {
+                "min": [float(jnp.min(s[comp])) for s in samples],
+                "max": [float(jnp.max(s[comp])) for s in samples],
+                "avg": [float(jnp.mean(s[comp])) for s in samples],
+            }
+    return TimeSeries(times=times, per_run=series, merged=merged), nets, ps

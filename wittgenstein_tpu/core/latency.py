@@ -215,6 +215,33 @@ class IC3NetworkLatency:
         return self.name
 
 
+def latency_name(kind: str, fixed: int) -> str:
+    """Reference-compatible registry names (RegistryNetworkLatencies.name,
+    RegistryNetworkLatencies.java:17-26): 'NetworkFixedLatency(100)' etc."""
+    cls = {"FIXED": "NetworkFixedLatency",
+           "UNIFORM": "NetworkUniformLatency"}[kind.upper()]
+    return f"{cls}({int(fixed)})"
+
+
+def get_by_name(name: str | None):
+    """String-keyed latency lookup (RegistryNetworkLatencies.getByName,
+    :34-59): parametrised fixed/uniform names, then a by-class-simple-name
+    fallback; None falls back to NetworkLatencyByDistanceWJitter."""
+    if not name:
+        return NetworkLatencyByDistanceWJitter()
+    if "(" in name and name.endswith(")"):
+        cls, arg = name[:-1].split("(", 1)
+        ctor = {"NetworkFixedLatency": NetworkFixedLatency,
+                "NetworkUniformLatency": NetworkUniformLatency}.get(cls)
+        if ctor is None:
+            raise KeyError(f"unknown parametrised latency {name!r}")
+        return ctor(int(arg))
+    model = globals().get(name)
+    if model is None or not hasattr(model, "extended"):
+        raise KeyError(f"unknown latency model {name!r}")
+    return model() if isinstance(model, type) else model
+
+
 def full_latency(model, nodes, src, dst, delta):
     """The shared `getLatency` wrapper (NetworkLatency.java:27-34)."""
     base = nodes.extra_latency[src] + nodes.extra_latency[dst]

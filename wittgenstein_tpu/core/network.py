@@ -222,6 +222,20 @@ def step_ms(protocol, net: NetState, pstate):
     return net.replace(time=t + 1), pstate
 
 
+def scan_chunk(protocol, ms: int):
+    """Returns ``run(net, pstate) -> (net, pstate)`` advancing `ms`
+    milliseconds as one `lax.scan` — the single shared chunk body used by
+    `Runner`, the harness, and the sharded runner."""
+
+    def run(net, pstate):
+        def body(carry, _):
+            return step_ms(protocol, *carry), ()
+        (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms)
+        return net2, p2
+
+    return run
+
+
 class Runner:
     """Drives a protocol; caches one jitted scan per distinct chunk length.
 
@@ -237,13 +251,8 @@ class Runner:
 
     def _chunk_fn(self, ms):
         if ms not in self._jits:
-            def run(net, pstate):
-                def body(carry, _):
-                    return step_ms(self.protocol, *carry), ()
-                (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms)
-                return net2, p2
             kw = {"donate_argnums": (0, 1)} if self._donate else {}
-            self._jits[ms] = jax.jit(run, **kw)
+            self._jits[ms] = jax.jit(scan_chunk(self.protocol, ms), **kw)
         return self._jits[ms]
 
     def run_ms(self, net, pstate, ms: int):

@@ -30,15 +30,18 @@ class PingPong:
     """Parameters mirror PingPong.PingPongParameters (PingPong.java)."""
 
     def __init__(self, node_count=1000, witness=0, latency=None,
-                 node_builder=None):
+                 node_builder=None, inbox_cap=32):
         self.node_count = node_count
         self.witness = witness
         self.latency = latency or NetworkLatencyByDistanceWJitter()
         self.builder = node_builder or builders.NodeBuilder()
         # Pongs can pile up at the witness: with 1000 nodes the arrival curve
-        # peaks around a dozen per ms, so give the witness headroom.
-        self.cfg = EngineConfig(n=node_count, horizon=1024, inbox_cap=32,
-                                payload_words=1, out_deg=1, bcast_slots=2)
+        # peaks around a dozen per ms under the distance model, so give the
+        # witness headroom (inbox_cap must reach node_count if a constant
+        # latency makes every pong land on the same ms).
+        self.cfg = EngineConfig(n=node_count, horizon=1024,
+                                inbox_cap=inbox_cap, payload_words=1,
+                                out_deg=1, bcast_slots=2)
 
     def init(self, seed):
         nodes = self.builder.build(seed, self.node_count)
@@ -69,6 +72,16 @@ class PingPong:
         is_pong = inbox.valid & (inbox.data[:, :, 0] == PONG)
         got = jnp.sum(jnp.where(is_witness[:, None], is_pong, False))
         pstate = pstate.replace(pongs=pstate.pongs + got.astype(jnp.int32))
+
+        # doneAt bookkeeping (an addition over PingPong.java, which never
+        # sets doneAt): a replier is done once it has ponged; the witness
+        # once it has seen every pong.  This lets the default
+        # `cont_until_done` harness predicate drive PingPong runs.
+        finished = jnp.where(is_witness, pstate.pongs >= self.node_count,
+                             any_ping)
+        done_at = jnp.where(finished & (nodes.done_at == 0), t,
+                            nodes.done_at)
+        nodes = nodes.replace(done_at=done_at.astype(jnp.int32))
         return pstate, nodes, out
 
     def done(self, pstate, nodes):

@@ -1,0 +1,55 @@
+"""Stats over node state — the analogue of core/utils/StatsHelper.java.
+
+A *getter* is a named function ``get(nodes: NodeState) -> dict[str, jnp
+scalar]`` computed over LIVE nodes only (StatsHelper.java:120-137 filters on
+``liveNodes()``).  Getters are pure jnp so the harness can evaluate them
+inside jit and vmap them across runs; `avg_stats` averages a batch of stat
+dicts across the run axis (StatsHelper.avg, :31-58).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _masked(vals, live):
+    vals = vals.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(live), 1)
+    big = jnp.float32(3.4e38)
+    return {
+        "min": jnp.min(jnp.where(live, vals, big)),
+        "max": jnp.max(jnp.where(live, vals, -big)),
+        "avg": jnp.sum(jnp.where(live, vals, 0.0)) / n,
+    }
+
+
+def simple_stats(name, field):
+    """StatsHelper.SimpleStatsGetter over one NodeState field by name."""
+
+    def get(nodes):
+        return _masked(getattr(nodes, field), ~nodes.down)
+
+    get.stat_name = name
+    return get
+
+
+done_at_stats = simple_stats("doneAt", "done_at")          # GetDoneAt
+msg_received_stats = simple_stats("msgReceived", "msg_received")
+msg_sent_stats = simple_stats("msgSent", "msg_sent")
+bytes_received_stats = simple_stats("bytesReceived", "bytes_received")
+bytes_sent_stats = simple_stats("bytesSent", "bytes_sent")
+
+
+def done_count(nodes):
+    """How many live nodes reached done (doneAt > 0)."""
+    live = ~nodes.down
+    return {"count": jnp.sum(live & (nodes.done_at > 0)).astype(jnp.float32)}
+
+
+done_count.stat_name = "doneCount"
+
+
+def avg_stats(batch):
+    """Average a stat dict whose leaves have a leading run axis
+    (StatsHelper.avg semantics: plain mean of each component)."""
+    return {k: float(jnp.mean(v)) for k, v in batch.items()}
