@@ -1,0 +1,106 @@
+"""P2P layer tests — peer graphs + P2PFlood.
+
+Mirrors the reference test recipe (SURVEY.md §4): structural invariants after
+init (P2PNetworkTest.java min-degree construction), a run to completion
+asserting the protocol goal, and per-seed determinism (the copy() test
+analogue)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core import p2p
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.p2pflood import P2PFlood
+
+
+def test_peer_graph_minimum_degree():
+    peers, degree, overflow = p2p.build_peer_graph(0, 200, 5, minimum=True)
+    peers, degree = np.asarray(peers), np.asarray(degree)
+    assert int(overflow) == 0
+    # Every node drew 5 partners; symmetric closure can only add more.
+    assert degree.min() >= 5
+    # Mean is ~2c minus collision losses.
+    assert 8.0 < degree.mean() < 10.5
+    for i in (0, 17, 199):
+        row = peers[i][peers[i] >= 0]
+        assert len(row) == degree[i]
+        assert len(set(row.tolist())) == len(row)      # no dup peers
+        assert i not in row                            # no self loop
+        # Symmetry: each peer lists i back.
+        for j in row:
+            assert i in peers[j]
+
+
+def test_peer_graph_average_degree():
+    peers, degree, overflow = p2p.build_peer_graph(1, 400, 10, minimum=False)
+    degree = np.asarray(degree)
+    assert int(overflow) == 0
+    assert degree.min() >= 1
+    assert 8.0 < degree.mean() < 12.0                  # target average ~10
+    # Deterministic per seed.
+    p2, d2, _ = p2p.build_peer_graph(1, 400, 10, minimum=False)
+    assert np.array_equal(np.asarray(peers), np.asarray(p2))
+
+
+def test_flood_fanout_delays():
+    """The k-th peer in the shuffled order gets local + k*between delay
+    (FloodMessage.action semantics), skipping the excluded sender."""
+    from wittgenstein_tpu.core.state import EngineConfig
+    cfg = EngineConfig(n=4, out_deg=3)
+    peers = jnp.asarray([[1, 2, 3], [0, -1, -1], [0, -1, -1], [0, -1, -1]])
+    forward = jnp.asarray([True, False, False, False])
+    exclude = jnp.asarray([2, -1, -1, -1])
+    payload = jnp.zeros((4, 1), jnp.int32)
+    dest, pl, size, delay = p2p.flood_fanout(
+        cfg, peers, forward, exclude, payload, jnp.int32(7), 0,
+        local_delay=10, delay_between=30)
+    dest, delay = np.asarray(dest), np.asarray(delay)
+    sent = dest[0] >= 0
+    assert set(dest[0][sent].tolist()) == {1, 3}       # 2 excluded
+    assert sorted(delay[0][sent].tolist()) == [10, 40]  # staggered
+    assert (dest[1:] == -1).all()
+
+
+def test_p2pflood_converges_and_counts():
+    proto = P2PFlood(node_count=128, dead_node_count=10, peers_count=8,
+                     delay_before_resent=1, delay_between_sends=1,
+                     network_latency_name="NetworkLatencyByDistanceWJitter")
+    net, p = proto.init(0)
+    runner = Runner(proto, donate=False)
+    net, p = runner.run_ms(net, p, 2000)
+    nodes = net.nodes
+    live = ~np.asarray(nodes.down)
+    done = np.asarray(nodes.done_at)
+    assert (done[live] > 0).all()                      # all live nodes done
+    assert (done[~live] == 0).all()                    # dead nodes never done
+    assert int(net.dropped) == 0
+    assert int(net.clamped) == 0                       # horizon fit the stagger
+    # Every live node received the flood exactly once into `received`.
+    assert np.asarray(p.received)[live].all()
+    # Live nodes forwarded: msg counters moved.
+    assert int(jnp.sum(nodes.msg_sent)) > 100
+
+
+def test_p2pflood_deterministic_and_seed_sensitive():
+    proto = P2PFlood(node_count=64, dead_node_count=0, peers_count=5,
+                     delay_before_resent=5, delay_between_sends=2)
+    outs = []
+    for seed in (3, 3, 4):
+        net, p = proto.init(seed)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 1500)
+        outs.append(np.asarray(net.nodes.done_at))
+    assert np.array_equal(outs[0], outs[1])
+    assert not np.array_equal(outs[0], outs[2])
+
+
+def test_p2pflood_multiple_messages():
+    proto = P2PFlood(node_count=96, dead_node_count=0, msg_count=3,
+                     peers_count=6, delay_before_resent=2,
+                     delay_between_sends=1)
+    net, p = proto.init(5)
+    net, p = Runner(proto, donate=False).run_ms(net, p, 3000)
+    rec = np.asarray(p.received)
+    assert rec.all()                                   # all 3 floods everywhere
+    assert (np.asarray(net.nodes.done_at) > 0).all()
+    assert int(net.dropped) == 0
+    assert int(net.clamped) == 0

@@ -49,7 +49,8 @@ def _freeze_chunk(protocol, chunk, cont):
         still = jax.vmap(cont)(nets3, ps3)
         newly_stopped = (~stopped) & (~still)
         stopped_at = jnp.where(newly_stopped, nets3.time, stopped_at)
-        dropped = jnp.sum(nets3.dropped) + jnp.sum(nets3.bc_dropped)
+        dropped = (jnp.sum(nets3.dropped) + jnp.sum(nets3.bc_dropped) +
+                   jnp.sum(nets3.clamped))
         return nets3, ps3, stopped | ~still, stopped_at, dropped
 
     return chunk_all
@@ -58,9 +59,36 @@ def _freeze_chunk(protocol, chunk, cont):
 def _check_drops(dropped, where):
     if int(dropped) > 0:
         raise RuntimeError(
-            f"{int(dropped)} messages dropped during {where}: the protocol's "
-            "inbox_cap / out_deg / bcast_slots are undersized for this "
-            "scenario (pass fail_on_drop=False if drops are intended)")
+            f"{int(dropped)} messages dropped/clamped during {where}: the "
+            "protocol's inbox_cap / bcast_slots / horizon are undersized for "
+            "this scenario (pass fail_on_drop=False if that is intended)")
+
+
+class _BatchDriver:
+    """Shared multi-seed scaffolding for `run_multiple_times` and
+    `progress_per_time`: vmapped init over seeds, frozen-run chunk advance,
+    and the drop/clamp guard."""
+
+    def __init__(self, protocol, run_count, chunk, cont_if, first_seed,
+                 fail_on_drop, where):
+        self.cont = cont_if or cont_until_done
+        self.seeds = jnp.arange(first_seed, first_seed + run_count,
+                                dtype=jnp.int32)
+        self.nets, self.ps = jax.vmap(protocol.init)(self.seeds)
+        self.stopped = jnp.zeros((run_count,), bool)
+        self.stopped_at = jnp.zeros((run_count,), jnp.int32)
+        self._chunk_all = _freeze_chunk(protocol, chunk, self.cont)
+        self._fail_on_drop = fail_on_drop
+        self._where = where
+
+    def advance(self):
+        """One chunk for every run; returns True when all runs have stopped."""
+        (self.nets, self.ps, self.stopped, self.stopped_at,
+         dropped) = self._chunk_all(self.nets, self.ps, self.stopped,
+                                    self.stopped_at)
+        if self._fail_on_drop:
+            _check_drops(dropped, self._where)
+        return bool(jnp.all(self.stopped))
 
 
 @dataclasses.dataclass
@@ -83,21 +111,13 @@ def run_multiple_times(protocol, run_count, max_time=0, chunk=10,
     it, which never happens for a protocol that cannot converge; prefer a
     real bound.  Returns averaged stats across runs plus per-run values.
     """
-    cont = cont_if or cont_until_done
-    seeds = jnp.arange(first_seed, first_seed + run_count, dtype=jnp.int32)
-    nets, ps = jax.vmap(protocol.init)(seeds)
-    stopped = jnp.zeros((run_count,), bool)
-    stopped_at = jnp.zeros((run_count,), jnp.int32)
-    chunk_all = _freeze_chunk(protocol, chunk, cont)
-
+    drv = _BatchDriver(protocol, run_count, chunk, cont_if, first_seed,
+                       fail_on_drop, f"run_multiple_times({protocol})")
     steps = 10**9 if max_time == 0 else -(-max_time // chunk)
     for _ in range(steps):
-        nets, ps, stopped, stopped_at, dropped = chunk_all(
-            nets, ps, stopped, stopped_at)
-        if fail_on_drop:
-            _check_drops(dropped, f"run_multiple_times({protocol})")
-        if bool(jnp.all(stopped)):
+        if drv.advance():
             break
+    nets, ps, stopped_at, seeds = drv.nets, drv.ps, drv.stopped_at, drv.seeds
 
     if final_check is not None:
         ok = jax.vmap(final_check)(nets, ps)
@@ -130,12 +150,8 @@ def progress_per_time(protocol, run_count=1, max_time=20_000,
     `run_multiple_times`, so each run's samples flatline at its own
     stop-time values (the sequential reference never samples a finished run
     again; a frozen flatline is the batched equivalent)."""
-    cont = cont_if or cont_until_done
-    seeds = jnp.arange(first_seed, first_seed + run_count, dtype=jnp.int32)
-    nets, ps = jax.vmap(protocol.init)(seeds)
-    stopped = jnp.zeros((run_count,), bool)
-    stopped_at = jnp.zeros((run_count,), jnp.int32)
-    chunk_all = _freeze_chunk(protocol, stat_each_ms, cont)
+    drv = _BatchDriver(protocol, run_count, stat_each_ms, cont_if, first_seed,
+                       fail_on_drop, f"progress_per_time({protocol})")
 
     @jax.jit
     def sample(nets):
@@ -145,17 +161,15 @@ def progress_per_time(protocol, run_count=1, max_time=20_000,
     times, series = [], {g.stat_name: [] for g in stats_getters}
     t = 0
     while t < max_time:
-        nets, ps, stopped, stopped_at, dropped = chunk_all(
-            nets, ps, stopped, stopped_at)
-        if fail_on_drop:
-            _check_drops(dropped, f"progress_per_time({protocol})")
+        all_stopped = drv.advance()
         t += stat_each_ms
-        vals = sample(nets)
+        vals = sample(drv.nets)
         times.append(t)
         for k, v in vals.items():
             series[k].append(v)
-        if bool(jnp.all(stopped)):
+        if all_stopped:
             break
+    nets, ps = drv.nets, drv.ps
 
     # Merge across the run axis per sample point (Graph.statSeries,
     # tools/Graph.java:214-251): one "<getter>.<component>" series each for

@@ -69,6 +69,7 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     # Discard is checked against the TRUE latency (Network.java:481 compares
     # nt before any storage), then the survivor is clamped into the ring.
     not_discarded = lat < cfg.msg_discard_time
+    raw_lat = jnp.maximum(lat, 1)
     lat = jnp.clip(lat, 1, cfg.horizon - 2)
     arrival = net.bc_time[:, None] + 1 + lat
     bc_valid = (net.bc_active[:, None] & (arrival == t)
@@ -93,7 +94,11 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
               jnp.sum(jnp.where(bc_valid, bc_size, 0), 1)).astype(jnp.int32)
     nodes = nodes.replace(msg_received=nodes.msg_received + recv,
                           bytes_received=nodes.bytes_received + rbytes)
-    return inbox, nodes
+    # Broadcast deliveries whose true latency outran the ring (counted once,
+    # at their clamped delivery ms).
+    n_clamped = jnp.sum(jnp.transpose(bc_valid) &
+                        (raw_lat != lat)).astype(jnp.int32)
+    return inbox, nodes, n_clamped
 
 
 def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
@@ -112,6 +117,7 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     dest = out.dest.reshape(m)
     payload = out.payload.reshape(m, cfg.payload_words)
     size = out.size.reshape(m)
+    delay = out.delay.reshape(m)
 
     want = (dest >= 0) & (~nodes.down[src])
     dest_c = jnp.clip(dest, 0, n - 1)
@@ -127,11 +133,17 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
     lat = full_latency(model, nodes, src, dest_c, delta)
     not_discarded = lat < cfg.msg_discard_time
-    lat = jnp.clip(lat, 1, cfg.horizon - 2)
+    # `delay` is sender-chosen scheduling (send-at-future-time).  Arrivals
+    # past the ring are clamped to its edge and counted in `net.clamped`:
+    # a staggered fan-out that outruns the horizon loses its stagger, so
+    # size `horizon` for the protocol (tests/harness assert clamped == 0).
+    raw_total = jnp.clip(delay, 0, None) + jnp.maximum(lat, 1)
+    total = jnp.clip(raw_total, 1, cfg.horizon - 2)
     valid = want & not_discarded & (~nodes.down[dest_c]) & (
         nodes.partition[src] == nodes.partition[dest_c])
+    n_clamped = jnp.sum(valid & (raw_total != total)).astype(jnp.int32)
 
-    arrival = t + 1 + lat
+    arrival = t + 1 + total
     rel = arrival - t                                   # in [2, horizon-1]
     # Two-pass stable radix sort on (rel, dest): avoids the int32 overflow a
     # fused `rel * n + dest` key would hit for n in the millions, yet still
@@ -162,7 +174,8 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
                                                mode="drop")
     dropped = net.dropped + jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)
     return net.replace(nodes=nodes, box_data=box_data, box_src=box_src,
-                       box_size=box_size, box_count=box_count, dropped=dropped)
+                       box_size=box_size, box_count=box_count, dropped=dropped,
+                       clamped=net.clamped + n_clamped)
 
 
 def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
@@ -207,8 +220,8 @@ def step_ms(protocol, net: NetState, pstate):
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
     net = _retire_broadcasts(cfg, net)
-    inbox, nodes = build_inbox(cfg, model, net, t)
-    net = net.replace(nodes=nodes)
+    inbox, nodes, bc_clamped = build_inbox(cfg, model, net, t)
+    net = net.replace(nodes=nodes, clamped=net.clamped + bc_clamped)
 
     key = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
     pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key)

@@ -124,6 +124,7 @@ class NetState:
     bc_seed: jnp.ndarray        # int32 [B] — per-broadcast latency seed
     dropped: jnp.ndarray        # int32 scalar — overflowed unicast deliveries
     bc_dropped: jnp.ndarray     # int32 scalar — broadcasts lost to a full table
+    clamped: jnp.ndarray        # int32 scalar — arrivals clamped to the ring edge
 
 
 def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
@@ -145,6 +146,7 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
         bc_seed=jnp.zeros((b,), jnp.int32),
         dropped=jnp.asarray(0, jnp.int32),
         bc_dropped=jnp.asarray(0, jnp.int32),
+        clamped=jnp.asarray(0, jnp.int32),
     )
 
 
@@ -175,6 +177,7 @@ class Outbox:
     dest: jnp.ndarray           # int32 [N, K]
     payload: jnp.ndarray        # int32 [N, K, F]
     size: jnp.ndarray           # int32 [N, K]
+    delay: jnp.ndarray          # int32 [N, K] — extra ms before the latency
     bcast: jnp.ndarray          # bool [N]
     bcast_payload: jnp.ndarray  # int32 [N, F]
     bcast_size: jnp.ndarray     # int32 [N]
@@ -186,6 +189,7 @@ def empty_outbox(cfg: EngineConfig) -> Outbox:
         dest=jnp.full((n, k), -1, jnp.int32),
         payload=jnp.zeros((n, k, f), jnp.int32),
         size=jnp.ones((n, k), jnp.int32),
+        delay=jnp.zeros((n, k), jnp.int32),
         bcast=jnp.zeros((n,), bool),
         bcast_payload=jnp.zeros((n, f), jnp.int32),
         bcast_size=jnp.ones((n,), jnp.int32),

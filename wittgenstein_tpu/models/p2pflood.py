@@ -1,0 +1,159 @@
+"""P2PFlood — flood routing on a random peer graph.
+
+Reference: protocols/P2PFlood.java — when a node receives a flood message it
+has not seen, it forwards it to all its peers except the sender
+(core/messages/FloodMessage.java:47-54), after `delay_before_resent` ms and
+with `delay_between_sends` ms between consecutive peers.  Dead nodes are
+"officially up but actually not participating" byzantine-ish nodes
+(P2PFlood.java:27-36).  A node is done when it has received
+`msg_to_receive` distinct floods (P2PFlood.java:39-43, where the reference
+checks the received set size against msgCount).
+
+TPU-native state: `received`/`pending` are `[N, M]` bool matrices (M = number
+of distinct floods); the per-node forward queue drains one message id per ms
+(a burst of simultaneous new floods forwards over the next few ms — same
+statistical behavior, fixed shapes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders, p2p
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import prng
+
+TAG_SENDERS = 0x464C4453
+
+
+@struct.dataclass
+class P2PFloodState:
+    seed: jnp.ndarray         # int32 scalar — for the fan-out shuffle draws
+    peers: jnp.ndarray        # int32 [N, D]
+    degree: jnp.ndarray       # int32 [N]
+    received: jnp.ndarray     # bool [N, M]
+    pending: jnp.ndarray      # bool [N, M] — received, not yet forwarded
+    pending_src: jnp.ndarray  # int32 [N, M] — who sent it to us (-1: nobody)
+
+
+@register
+class P2PFlood:
+    """Parameters mirror P2PFlood.P2PFloodParameters (P2PFlood.java:46-110)."""
+
+    def __init__(self, node_count=100, dead_node_count=10,
+                 delay_before_resent=50, msg_count=1, msg_to_receive=None,
+                 peers_count=10, delay_between_sends=30,
+                 node_builder_name=None, network_latency_name=None,
+                 max_degree=None, inbox_cap=16, horizon=None):
+        if msg_count > node_count - dead_node_count:
+            # The reference's sender-selection loop would spin forever here
+            # (P2PFlood.init:152-160 only picks live nodes).
+            raise ValueError(
+                f"msg_count={msg_count} needs that many live senders; only "
+                f"{node_count - dead_node_count} nodes are up")
+        self.node_count = node_count
+        self.dead_node_count = dead_node_count
+        self.delay_before_resent = delay_before_resent
+        self.msg_count = msg_count
+        self.msg_to_receive = (msg_count if msg_to_receive is None
+                               else min(msg_to_receive, msg_count))
+        self.peers_count = peers_count
+        self.delay_between_sends = delay_between_sends
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        self.max_degree = max_degree or max(4 * peers_count, peers_count + 16)
+        if horizon is None:
+            # The ring must hold the full stagger schedule (last peer's
+            # delay is delay_before_resent + delay_between_sends * (D-1))
+            # plus a generous latency allowance, or arrivals get clamped.
+            need = (delay_before_resent
+                    + delay_between_sends * self.max_degree + 1024)
+            horizon = 1 << (need - 1).bit_length()
+        self.cfg = EngineConfig(
+            n=node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=1, out_deg=self.max_degree, bcast_slots=1)
+
+    def init(self, seed):
+        n, m = self.node_count, self.msg_count
+        nodes = self.builder.build(seed, n)
+        # First dead_node_count nodes are down (P2PFlood.init: i < deadNodeCount).
+        down = jnp.arange(n) < self.dead_node_count
+        nodes = nodes.replace(down=down)
+
+        peers, degree, _ = p2p.build_peer_graph(
+            seed, n, self.peers_count, minimum=True,
+            max_degree=self.max_degree)
+
+        # msg_count distinct random live senders (P2PFlood.init:152-165):
+        # order live nodes by a per-seed hash, take the first msg_count.
+        ids = jnp.arange(n, dtype=jnp.int32)
+        pri = prng.uniform_u32(prng.hash2(jnp.asarray(seed, jnp.int32),
+                                          TAG_SENDERS), ids)
+        pri = jnp.where(down, jnp.uint32(0xFFFFFFFF), pri)
+        senders = jnp.argsort(pri)[:m].astype(jnp.int32)   # [M]
+
+        received = jnp.zeros((n, m), bool).at[senders, jnp.arange(m)].set(True)
+        pending = received
+        pending_src = jnp.full((n, m), -1, jnp.int32)
+        # "if (params.msgCount == 1) from.doneAt = 1" (P2PFlood.java:161-163).
+        if m == 1:
+            nodes = nodes.replace(
+                done_at=nodes.done_at.at[senders].set(1))
+
+        net = init_net(self.cfg, nodes, seed)
+        return net, P2PFloodState(seed=jnp.asarray(seed, jnp.int32),
+                                  peers=peers, degree=degree,
+                                  received=received, pending=pending,
+                                  pending_src=pending_src)
+
+    def step(self, pstate, nodes, inbox, t, key):
+        n, m = self.node_count, self.msg_count
+        s = inbox.src.shape[1]
+        i_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, s))
+        msgid = jnp.clip(inbox.data[:, :, 0], 0, m - 1)
+
+        # First-arrival-wins per (node, msg): scatter-min the inbox slot index
+        # (slots are in deterministic delivery order).
+        slot = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                                (n, s))
+        slot_w = jnp.where(inbox.valid, slot, s)
+        first = jnp.full((n, m), s, jnp.int32).at[i_idx, msgid].min(
+            slot_w, mode="drop")
+        arrived = first < s
+        src = jnp.take_along_axis(
+            inbox.src, jnp.clip(first, 0, s - 1), axis=1)
+
+        new = arrived & ~pstate.received
+        received = pstate.received | arrived
+        pending = pstate.pending | new
+        pending_src = jnp.where(new, src, pstate.pending_src)
+
+        # Forward one pending msg id per node per ms.
+        has = jnp.any(pending, axis=1)
+        pick = jnp.argmax(pending, axis=1)                  # lowest id first
+        payload = pick[:, None].astype(jnp.int32)
+        exclude = pending_src[jnp.arange(n), pick]
+        dest, pl, size, delay = p2p.flood_fanout(
+            self.cfg, pstate.peers, has, exclude, payload, pstate.seed, t,
+            local_delay=self.delay_before_resent,
+            delay_between=self.delay_between_sends)
+        pending = pending.at[jnp.arange(n), pick].set(
+            jnp.where(has, False, pending[jnp.arange(n), pick]))
+
+        out = empty_outbox(self.cfg).replace(
+            dest=dest, payload=pl, size=size, delay=delay)
+
+        # doneAt = network.time when the count reaches the target
+        # (P2PFlood.java:39-43); never overwrite an earlier doneAt.
+        count = jnp.sum(received, axis=1)
+        done_now = (count >= self.msg_to_receive) & (nodes.done_at == 0)
+        nodes = nodes.replace(
+            done_at=jnp.where(done_now, jnp.maximum(t, 1),
+                              nodes.done_at).astype(jnp.int32))
+
+        return (pstate.replace(received=received, pending=pending,
+                               pending_src=pending_src),
+                nodes, out)
