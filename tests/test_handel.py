@@ -1,0 +1,107 @@
+"""Handel tests — the reference test recipe (SURVEY.md §4.2, HandelTest.java):
+structural invariants after init, run-to-completion, per-seed determinism
+(the testCopy analogue), plus unit tests of the level/bitset math."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.handel import Handel, _sibling_base, cont_if_handel
+from wittgenstein_tpu.ops import bitset
+
+
+def test_level_ranges_partition_ids():
+    """Level peer ranges (sibling halves) partition [0, N) \\ {i} — the
+    identity behind the single-bitset-per-node layout (allSigsAtLevel,
+    Handel.java:667-680)."""
+    n = 64
+    ids = jnp.arange(n, dtype=jnp.int32)
+    seen = np.zeros((n, n), bool)
+    for l in range(1, 7):
+        half = 1 << (l - 1)
+        base = np.asarray(_sibling_base(ids, half))
+        for i in range(n):
+            rng = range(base[i], base[i] + half)
+            assert i not in rng
+            for r in rng:
+                assert not seen[i, r]
+                seen[i, r] = True
+    for i in range(n):
+        assert seen[i].sum() == n - 1 and not seen[i, i]
+
+
+def test_init_invariants():
+    proto = Handel(node_count=64, threshold=60, nodes_down=4)
+    net, p = proto.init(0)
+    # Own signature verified at level 0 (HLevel() level-0 ctor).
+    ids = np.arange(64)
+    vi = np.asarray(p.ver_ind)
+    for i in ids:
+        assert vi[i, i // 32] >> (i % 32) & 1
+    assert int(bitset.popcount(p.ver_ind).sum()) == 64   # exactly own bits
+    assert int(np.asarray(net.nodes.down).sum()) == 4
+    # Emission lists: level-l columns hold a permutation of the level range.
+    em = np.asarray(p.emission)
+    for i in (0, 17, 63):
+        for l in (2, 4, 6):
+            half = 1 << (l - 1)
+            base = int(np.asarray(_sibling_base(jnp.asarray([i]), half))[0])
+            got = sorted(em[i, half:2 * half].tolist())
+            assert got == list(range(base, base + half))
+
+
+def test_run_to_completion_and_determinism():
+    n, down = 128, 12
+    proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
+                   nodes_down=down, pairing_time=4, level_wait_time=50,
+                   dissemination_period_ms=20, fast_path=10)
+    outs = []
+    for seed in (0, 0, 1):
+        net, p = proto.init(seed)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 1500)
+        outs.append(np.asarray(net.nodes.done_at))
+        live = ~np.asarray(net.nodes.down)
+        assert (outs[-1][live] > 0).all(), "live nodes must reach threshold"
+        assert (outs[-1][~live] == 0).all()
+        assert int(net.dropped) == 0 and int(net.clamped) == 0
+    assert np.array_equal(outs[0], outs[1])              # testCopy analogue
+    assert not np.array_equal(outs[0], outs[2])          # seed-sensitive
+
+
+def test_cont_if_and_extra_cycle():
+    proto = Handel(node_count=64, threshold=63, extra_cycle=3,
+                   network_latency_name="NetworkFixedLatency(20)",
+                   pairing_time=3, level_wait_time=20,
+                   dissemination_period_ms=10)
+    net, p = proto.init(0)
+    runner = Runner(proto, donate=False)
+    assert bool(cont_if_handel(net, p))
+    net, p = runner.run_ms(net, p, 800)
+    assert (np.asarray(net.nodes.done_at) > 0).all()
+    # extraCycle exhausted after completion -> contIf goes false.
+    assert not bool(cont_if_handel(net, p))
+    assert (np.asarray(p.added_cycle) == 0).all()
+
+
+def test_desynchronized_start():
+    proto = Handel(node_count=64, threshold=63, desynchronized_start=100,
+                   network_latency_name="NetworkFixedLatency(20)",
+                   pairing_time=3, level_wait_time=20,
+                   dissemination_period_ms=10)
+    net, p = proto.init(0)
+    sa = np.asarray(p.start_at)
+    assert sa.min() >= 0 and sa.max() < 100 and len(set(sa.tolist())) > 10
+    net, p = Runner(proto, donate=False).run_ms(net, p, 1200)
+    assert (np.asarray(net.nodes.done_at) > 0).all()
+
+
+def test_message_filtering_after_done():
+    proto = Handel(node_count=64, threshold=63, extra_cycle=5,
+                   network_latency_name="NetworkFixedLatency(20)",
+                   pairing_time=3, level_wait_time=20,
+                   dissemination_period_ms=10)
+    net, p = proto.init(0)
+    net, p = Runner(proto, donate=False).run_ms(net, p, 800)
+    # Done nodes kept receiving (extraCycle senders) but filtered the
+    # messages (onNewSig, Handel.java:755-758).
+    assert int(np.asarray(p.msg_filtered).sum()) > 0

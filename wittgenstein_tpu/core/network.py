@@ -49,13 +49,20 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     bumped per delivered message (:611-612).
     """
     nodes = net.nodes
-    n, c, b = cfg.n, cfg.inbox_cap, cfg.bcast_slots
+    n, c, b, f = cfg.n, cfg.inbox_cap, cfg.bcast_slots, cfg.payload_words
     h = t % cfg.horizon
+    hnc_total = cfg.horizon * n * c
 
-    # --- unicast slice ---
-    uc_data = net.box_data[h]                      # [N, C, F]
-    uc_src = net.box_src[h]                        # [N, C]
-    uc_size = net.box_size[h]
+    # --- unicast slice: contiguous [N*C] window per field at h*N*C ---
+    base = h * (n * c)
+    uc_data = jnp.stack(
+        [jax.lax.dynamic_slice(net.box_data, (fi * hnc_total + base,),
+                               (n * c,)).reshape(n, c)
+         for fi in range(f)], axis=-1)              # [N, C, F]
+    uc_src = jax.lax.dynamic_slice(net.box_src, (base,),
+                                   (n * c,)).reshape(n, c)
+    uc_size = jax.lax.dynamic_slice(net.box_size, (base,),
+                                    (n * c,)).reshape(n, c)
     uc_valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
     deliver_ok = (~nodes.down[:, None]) & (
         nodes.partition[uc_src] == nodes.partition[:, None])
@@ -164,12 +171,24 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     ok_s = valid[order]
     slot = net.box_count[h_s, d_s] + rank
     ok_s = ok_s & (slot < c)
-    slot_w = jnp.where(ok_s, slot, c)                  # c is OOB -> dropped
 
-    box_data = net.box_data.at[h_s, d_s, slot_w].set(payload[order],
-                                                     mode="drop")
-    box_src = net.box_src.at[h_s, d_s, slot_w].set(src[order], mode="drop")
-    box_size = net.box_size.at[h_s, d_s, slot_w].set(size[order], mode="drop")
+    # Flat 1-D scatters (cell (h, d, slot) at (h*N + d)*C + slot); the flat
+    # total size is the OOB sentinel for dropped entries.
+    hnc = cfg.horizon * n * c
+    flat = (h_s * n + d_s) * c + jnp.where(ok_s, slot, 0)
+    flat_w = jnp.where(ok_s, flat, hnc)
+    payload_s = payload[order]
+    box_data = net.box_data
+    for fi in range(cfg.payload_words):
+        # OOB sentinel must clear the WHOLE [F*hnc] array, not field fi's
+        # window, so dropped entries never write into field fi+1.
+        idx_f = jnp.where(ok_s, fi * hnc + flat, cfg.payload_words * hnc)
+        box_data = box_data.at[idx_f].set(
+            payload_s[:, fi], mode="drop", unique_indices=True)
+    box_src = net.box_src.at[flat_w].set(src[order], mode="drop",
+                                         unique_indices=True)
+    box_size = net.box_size.at[flat_w].set(size[order], mode="drop",
+                                           unique_indices=True)
     box_count = net.box_count.at[h_s, d_s].add(ok_s.astype(jnp.int32),
                                                mode="drop")
     dropped = net.dropped + jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)

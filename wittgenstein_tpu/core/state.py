@@ -110,10 +110,14 @@ class NetState:
     time: jnp.ndarray           # int32 scalar, milliseconds (Network.java:45-49)
     seed: jnp.ndarray           # int32 scalar — base seed; all draws derive from it
     nodes: NodeState
-    # Unicast mailbox ring [H, N, C]:
-    box_data: jnp.ndarray       # int32 [H, N, C, F]
-    box_src: jnp.ndarray        # int32 [H, N, C]
-    box_size: jnp.ndarray       # int32 [H, N, C]
+    # Unicast mailbox ring, logically [H, N, C] but stored FLAT (1-D) so the
+    # scan-carry layout and the scatter/slice layouts agree — multi-dim ring
+    # buffers made XLA:TPU relayout the whole ring every iteration (hundreds
+    # of MB/step).  Cell (h, n, c) lives at flat index (h*N + n)*C + c; the
+    # F payload words are field-major at f*H*N*C + idx.
+    box_data: jnp.ndarray       # int32 [F * H*N*C]
+    box_src: jnp.ndarray        # int32 [H*N*C]
+    box_size: jnp.ndarray       # int32 [H*N*C]
     box_count: jnp.ndarray      # int32 [H, N] — slots filled per (ms, node)
     # Broadcast table [B] (sendAll with recomputed per-dest latencies):
     bc_active: jnp.ndarray      # bool [B]
@@ -134,9 +138,9 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
         time=jnp.asarray(0, jnp.int32),
         seed=jnp.asarray(seed, jnp.int32),
         nodes=nodes,
-        box_data=jnp.zeros((h, n, c, f), jnp.int32),
-        box_src=jnp.zeros((h, n, c), jnp.int32),
-        box_size=jnp.zeros((h, n, c), jnp.int32),
+        box_data=jnp.zeros((f * h * n * c,), jnp.int32),
+        box_src=jnp.zeros((h * n * c,), jnp.int32),
+        box_size=jnp.zeros((h * n * c,), jnp.int32),
         box_count=jnp.zeros((h, n), jnp.int32),
         bc_active=jnp.zeros((b,), bool),
         bc_src=jnp.zeros((b,), jnp.int32),

@@ -1,0 +1,668 @@
+"""Handel — practical multi-signature aggregation for large Byzantine
+committees (arXiv:1906.05132).  The flagship protocol.
+
+Reference: protocols/Handel.java (1054 lines).  Mechanism recap (SURVEY.md
+§2.4): every node runs log2(N) binary-tree levels; per level it periodically
+sends its best aggregate to one peer (round-robin through an emission list
+ordered by the receivers' reception ranks, Handel.java:940-948,:991-1013);
+incoming aggregates queue for verification; every `pairingTime` ms a node
+picks ONE signature to verify using a variable-size rank window with a
+scoring function (bestToVerify, Handel.java:566-630), simulating the pairing
+cost; verified aggregates merge into per-level incoming sets, propagate into
+upper levels' outgoing sets, trigger fast-path sends on level completion
+(:738-743), and finish the node at the threshold (:747-749).
+
+TPU-native design (all shapes fixed, everything vmappable over seeds):
+
+* Level ranges partition the id space.  Node i's level-l peer set is the
+  sibling half of its 2^l-aligned block (allSigsAtLevel, Handel.java:667-680)
+  — contiguous and DISJOINT across levels.  So ONE [N, W] uint32 bitset row
+  per node stores all levels' state at once (W = N/32 words), and a level's
+  view is a computed range mask.  Per-level objects disappear.
+* `totalIncoming = lastAggVerified | verifiedIndSignatures` and
+  `totalOutgoing(l) = totalIncoming & block_mask(i, l-1)` are identities in
+  the reference (updateVerifiedSignatures, Handel.java:686-750), so both are
+  derived, not stored.  All per-level cardinalities come from ONE
+  popcount-per-level primitive: word-level population counts contracted
+  against a word→level one-hot on the MXU (`_level_pc`), since every 32-bit
+  word of a node's row belongs to exactly one level.
+* Reception ranks: the reference shuffles the full node list per node into an
+  [N, N] rank matrix (setReceivingRanks, :940-948).  Impossible at 1M nodes;
+  instead rank(i, s) = bij_perm(hash(seed, i), s) — a keyed bijective
+  permutation, recomputed in-kernel (SURVEY.md §7.4.6).  Verification
+  demotion (receptionRanks[from] += N, :830-834) becomes a per-(node, sender)
+  `demoted` bit: one demotion is remembered, repeats are rare and absorbed.
+* Messages carry (level, flags, round-slot) only — 3 words.  Signature bits
+  are reconstructed at delivery from a rotating per-sender snapshot pool
+  `pool[N, R, W]` written at send time: exact send-time aggregates without
+  per-destination bitset copies in the mailbox (the same memory trick as the
+  reference's recomputed-latency envelopes, Envelope.java:45-56; a fast-path
+  write inside a dissemination round can refresh the same slot early, which
+  only makes in-flight data marginally fresher).
+* The unbounded per-level verification queues `toVerifyAgg` become ONE flat
+  pool of Q slots per node tagged with (sender, level, rank); a slot's sig
+  row holds only its level's range bits, so no per-level copies exist.  One
+  entry per (sender, level) — newer aggregates supersede older (supersets in
+  practice); evict the highest-rank entry when full.  bestToVerify's
+  curation drops non-improving entries each pairing tick, exactly like the
+  reference (:597-614).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
+
+TAG_RANK = 0x48524E4B     # reception-rank permutation keys
+TAG_BAD = 0x48424144      # bad-node choice
+TAG_START = 0x48535452    # desynchronized start draw
+TAG_LEVEL = 0x484C564C    # random level pick in checkSigs
+
+U32 = jnp.uint32
+BIG = jnp.int32(1 << 30)
+
+
+def _sibling_base(ids, half):
+    """Base of the level range with half-block size `half` (int or [.]
+    array): the other half of the node's 2*half-aligned block
+    (Handel.allSigsAtLevel, Handel.java:667-680).  half == 0 -> empty."""
+    mine = ids & ~(2 * half - 1)
+    return mine + jnp.where((ids & half) != 0, 0, half)
+
+
+def _get_bit_rows(bits, idx):
+    """get_bit for [N, W] bitsets row-indexed by [N, ...] id arrays.
+
+    Flat 1-D gather — broadcasting bits to [N, S, W] for take_along_axis
+    materializes the broadcast and serializes on TPU."""
+    n = bits.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32).reshape(
+        (n,) + (1,) * (idx.ndim - 1))
+    word = gather2d(bits, rows, idx // 32)
+    return ((word >> (idx % 32).astype(U32)) & U32(1)) != 0
+
+
+@struct.dataclass
+class HandelState:
+    seed: jnp.ndarray          # int32 scalar
+    start_at: jnp.ndarray      # int32 [N] (desynchronizedStart, Handel:56-61)
+    pairing: jnp.ndarray       # int32 [N] nodePairingTime (speedRatio-scaled)
+    ver_ind: jnp.ndarray       # u32 [N, W] verifiedIndSignatures (+ own bit)
+    last_agg: jnp.ndarray      # u32 [N, W] lastAggVerified, all levels packed
+    finished_peers: jnp.ndarray  # u32 [N, W]
+    blacklist: jnp.ndarray     # u32 [N, W]
+    demoted: jnp.ndarray       # u32 [N, W] — reception-rank demotion bits
+    q_from: jnp.ndarray        # int32 [N, Q]  (-1 = empty slot)
+    q_lvl: jnp.ndarray         # int32 [N, Q]
+    q_rank: jnp.ndarray        # int32 [N, Q]
+    q_bad: jnp.ndarray         # bool [N, Q]
+    q_sig: jnp.ndarray         # u32 [N, Q, W] — only the entry's level bits
+    pool: jnp.ndarray          # u32 [N, R, W] — outgoing snapshots per round
+    emission: jnp.ndarray      # int32 [N, N] — per-level sorted receiver ids
+    pos: jnp.ndarray           # int32 [N, L] — posInLevel round-robin pointer
+    curr_window: jnp.ndarray   # int32 [N]
+    added_cycle: jnp.ndarray   # int32 [N] extraCycle countdown
+    pend_from: jnp.ndarray     # int32 [N] in-flight verification (-1 = none)
+    pend_level: jnp.ndarray    # int32 [N]
+    pend_bad: jnp.ndarray      # bool [N]
+    pend_sig: jnp.ndarray      # u32 [N, W]
+    pend_at: jnp.ndarray       # int32 [N] — apply time
+    sigs_checked: jnp.ndarray  # int32 [N]
+    msg_filtered: jnp.ndarray  # int32 [N]
+    evicted: jnp.ndarray       # int32 scalar — queue evictions (diagnostic)
+
+
+@register
+class Handel:
+    """Parameters mirror Handel.HandelParameters (Handel.java:22-142)."""
+
+    def __init__(self, node_count=2048, threshold=None, pairing_time=3,
+                 level_wait_time=50, extra_cycle=10,
+                 dissemination_period_ms=10, fast_path=10, nodes_down=0,
+                 node_builder_name=None, network_latency_name=None,
+                 desynchronized_start=0, window_initial=16, window_min=1,
+                 window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
+                 emission_lookahead=8):
+        if node_count & (node_count - 1):
+            raise ValueError("we support only power-of-two node counts "
+                             "(Handel.java:119-121)")
+        threshold = (int(node_count * 0.99) if threshold is None
+                     else threshold)
+        if not (0 <= nodes_down < node_count and
+                threshold + nodes_down <= node_count):
+            raise ValueError(f"nodeCount={node_count}, threshold={threshold},"
+                             f" nodesDown={nodes_down} (Handel.java:113-118)")
+        self.node_count = node_count
+        self.threshold = threshold
+        self.pairing_time = pairing_time
+        self.level_wait_time = level_wait_time
+        self.extra_cycle = extra_cycle
+        self.period = dissemination_period_ms
+        self.fast_path = fast_path
+        self.nodes_down = nodes_down
+        self.desynchronized_start = desynchronized_start
+        self.window_initial = window_initial
+        self.window_min = window_min
+        self.window_max = window_max
+        self.queue_cap = queue_cap
+        self.emission_lookahead = emission_lookahead
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+
+        self.bits = max(1, int(math.log2(node_count)))
+        self.levels = self.bits + 1            # levels 0..bits
+        self.w = bitset.n_words(node_count)
+        self.rounds = horizon // max(1, dissemination_period_ms) + 2
+        # half[l] = size of the level-l peer range (0 for level 0).
+        self.half = np.array([0] + [1 << (l - 1)
+                                    for l in range(1, self.levels)],
+                             np.int32)
+        # K outbox slots: one per sending level (1..levels-1) + fast path.
+        k = (self.levels - 1) + fast_path
+        self.cfg = EngineConfig(n=node_count, horizon=horizon,
+                                inbox_cap=inbox_cap, payload_words=3,
+                                out_deg=k, bcast_slots=1)
+
+    # ------------------------------------------------------------ primitives
+
+    def _word_onehot(self, ids):
+        """[N, W, L] float one-hot: which level each ≥1-word-aligned word of
+        node i's row belongs to (word w != own word: level =
+        msb(word ^ own_word) + 6).  The own word (sub-word levels 0..5) maps
+        nowhere; `_level_pc` handles it separately."""
+        n, w, L = self.node_count, self.w, self.levels
+        hi = (ids >> 5)[:, None]                              # [N, 1]
+        word = jnp.arange(w, dtype=jnp.int32)[None, :]
+        x = hi ^ word
+        lvl = jnp.where(x == 0, -1,
+                        31 - jax.lax.clz(jnp.maximum(x, 1)) + 6)
+        return (lvl[..., None] ==
+                jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+
+    def _subword_masks(self, ids):
+        """[N, L] uint32 in-word masks of the sub-word levels (1..5): the
+        level range lives entirely inside the node's own 32-bit word."""
+        n, L = self.node_count, self.levels
+        masks = jnp.zeros((n, L), U32)
+        for l in range(1, min(6, L)):
+            half = 1 << (l - 1)
+            base = _sibling_base(ids, half) & 31
+            masks = masks.at[:, l].set(
+                U32((1 << half) - 1) << base.astype(U32))
+        return masks
+
+    def _level_pc(self, rows, onehot, sub_masks, hi):
+        """Per-level popcounts.  rows [N, ..., W] -> [N, ..., L] int32."""
+        pc = jax.lax.population_count(rows).astype(jnp.float32)
+        extra = pc.ndim - 2
+        lhs = "n" + "abc"[:extra] + "w"
+        big = jnp.einsum(f"{lhs},nwl->n{'abc'[:extra]}l", pc, onehot)
+        own_word = jnp.take_along_axis(
+            rows, hi.reshape((-1,) + (1,) * (rows.ndim - 1)), axis=-1)[..., 0]
+        # sub-word levels: broadcast masks over the middle dims.
+        sm = sub_masks.reshape((sub_masks.shape[0],) + (1,) * extra +
+                               (sub_masks.shape[1],))
+        small = jax.lax.population_count(
+            own_word[..., None] & sm).astype(jnp.float32)
+        return (big + small).astype(jnp.int32)
+
+    def _range_mask_dyn(self, ids, level):
+        """[., W] level range mask where `level` is a traced array
+        broadcastable with ids."""
+        half = jnp.where(level > 0,
+                         1 << jnp.clip(level - 1, 0, 30), 0)
+        base = _sibling_base(ids, jnp.maximum(half, 1))
+        return bitset.range_mask(jnp.where(half > 0, base, 0), half, self.w)
+
+    def _sender_block_mask(self, src, level):
+        """[., W] mask of sender's outgoing set at `level`: the 2^(l-1)
+        block containing the sender (= the receiver's level range)."""
+        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 0)
+        base = src & ~jnp.maximum(half - 1, 0)
+        return bitset.range_mask(base, half, self.w)
+
+    def _rank(self, seed, i_ids, s_ids):
+        """Reception rank node i assigns to sender s (the [N, N] shuffled
+        matrix of setReceivingRanks, Handel.java:940-948, as a keyed
+        permutation)."""
+        key = prng.hash3(seed, TAG_RANK, i_ids)
+        return prng.bij_perm(key, s_ids, self.bits)
+
+    # ---------------------------------------------------------------- init
+
+    def init(self, seed):
+        n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        # chooseBadNodes (Network.java:52-64): nodes_down distinct random.
+        if self.nodes_down:
+            pri = prng.uniform_u32(prng.hash2(seed, TAG_BAD), ids)
+            down = jnp.zeros((n,), bool).at[
+                jnp.argsort(pri)[:self.nodes_down]].set(True)
+            nodes = nodes.replace(down=down)
+
+        start_at = (prng.uniform_int(prng.hash2(seed, TAG_START), ids,
+                                     self.desynchronized_start)
+                    if self.desynchronized_start else
+                    jnp.zeros((n,), jnp.int32))
+        pairing = jnp.maximum(
+            1, (self.pairing_time * nodes.speed_ratio)).astype(jnp.int32)
+
+        # Emission lists: for each (node, level), receivers of the level
+        # sorted by the rank THEY assign to us (Handel.java:991-1013), laid
+        # out per node as concatenated levels (level l at columns
+        # [2^(l-1), 2^l)); column 0 unused (level 0 has no peers).
+        emission = jnp.zeros((n, n), jnp.int32)
+        for l in range(1, L):
+            half = 1 << (l - 1)
+            base = _sibling_base(ids, half)                   # [N]
+            recv = base[:, None] + jnp.arange(half)[None, :]  # [N, half]
+            key = self._rank(seed, recv, jnp.broadcast_to(ids[:, None],
+                                                          recv.shape))
+            order = jnp.argsort(key * n + (recv - base[:, None]), axis=1)
+            emission = emission.at[:, half:2 * half].set(
+                jnp.take_along_axis(recv, order, axis=1))
+
+        zero_bits = jnp.zeros((n, w), U32)
+        net = init_net(self.cfg, nodes, seed)
+        pstate = HandelState(
+            seed=seed, start_at=start_at, pairing=pairing,
+            ver_ind=bitset.one_bit(ids, w), last_agg=zero_bits,
+            finished_peers=zero_bits, blacklist=zero_bits, demoted=zero_bits,
+            q_from=jnp.full((n, Q), -1, jnp.int32),
+            q_lvl=jnp.zeros((n, Q), jnp.int32),
+            q_rank=jnp.zeros((n, Q), jnp.int32),
+            q_bad=jnp.zeros((n, Q), bool),
+            q_sig=jnp.zeros((n, Q, w), U32),
+            pool=jnp.zeros((n, self.rounds, w), U32),
+            emission=emission, pos=jnp.zeros((n, L), jnp.int32),
+            curr_window=jnp.full((n,), self.window_initial, jnp.int32),
+            added_cycle=jnp.full((n,), self.extra_cycle, jnp.int32),
+            pend_from=jnp.full((n,), -1, jnp.int32),
+            pend_level=jnp.zeros((n,), jnp.int32),
+            pend_bad=jnp.zeros((n,), bool),
+            pend_sig=jnp.zeros((n, w), U32),
+            pend_at=jnp.zeros((n,), jnp.int32),
+            sigs_checked=jnp.zeros((n,), jnp.int32),
+            msg_filtered=jnp.zeros((n,), jnp.int32),
+            evicted=jnp.asarray(0, jnp.int32),
+        )
+        return net, pstate
+
+    # ---------------------------------------------------------------- step
+
+    def step(self, p: HandelState, nodes, inbox, t, key):
+        ids = jnp.arange(self.node_count, dtype=jnp.int32)
+        active = (~nodes.down) & (t >= p.start_at + 1)
+        onehot = self._word_onehot(ids)
+        subm = self._subword_masks(ids)
+        hi = ids >> 5
+
+        p = self._receive(p, nodes, inbox, t)
+        p, nodes, fast_level = self._apply_pending(p, nodes, t, onehot,
+                                                   subm, hi)
+        p = self._pick_verification(p, nodes, t, active, onehot, subm, hi)
+        p, out = self._disseminate(p, nodes, t, active, fast_level,
+                                   onehot, subm, hi)
+        return p, nodes, out
+
+    # -- receive: queue incoming aggregates (onNewSig, Handel.java:753-786)
+
+    def _receive(self, p: HandelState, nodes, inbox, t):
+        n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+        done = nodes.done_at > 0
+
+        valid = inbox.valid                                   # [N, S]
+        src = jnp.clip(inbox.src, 0, n - 1)
+        level = jnp.clip(inbox.data[:, :, 0], 0, L - 1)
+        flags = inbox.data[:, :, 1]
+        rslot = jnp.clip(inbox.data[:, :, 2], 0, self.rounds - 1)
+
+        # Filters (Handel.java:755-763): done -> counted; pre-start or
+        # blacklisted sender -> silently ignored.
+        blk = _get_bit_rows(p.blacklist, src)
+        ok = valid & ~done[:, None] & (t >= p.start_at)[:, None] & ~blk
+        filtered = jnp.sum(valid & done[:, None], axis=1).astype(jnp.int32)
+
+        # levelFinished -> finishedPeers (Handel.java:770-772).
+        fin = ok & ((flags & 1) != 0)
+        fin_bits = jnp.where(fin[..., None], bitset.one_bit(src, w), U32(0))
+        finished = p.finished_peers | jax.lax.reduce(
+            fin_bits, U32(0), jax.lax.bitwise_or, (1,))
+
+        # Reconstruct sigs from the senders' snapshot pool (one flat gather).
+        sig_all = gather_rows(p.pool, src, rslot) & \
+            self._sender_block_mask(src, level)
+        rank_all = self._rank(p.seed, ids[:, None], src) + \
+            jnp.where(_get_bit_rows(p.demoted, src), n, 0)
+
+        q_from, q_lvl, q_rank = p.q_from, p.q_lvl, p.q_rank
+        q_bad, q_sig = p.q_bad, p.q_sig
+        evicted = p.evicted
+        for s in range(S):
+            oks, srcs, lvls = ok[:, s], src[:, s], level[:, s]
+            ranks = rank_all[:, s]
+            same = (q_from == srcs[:, None]) & (q_lvl == lvls[:, None])
+            free = q_from < 0
+            worst = jnp.argmax(jnp.where(free, -1, q_rank), axis=1)
+            worst_rank = jnp.take_along_axis(q_rank, worst[:, None],
+                                             axis=1)[:, 0]
+            any_same = jnp.any(same, axis=1)
+            any_free = jnp.any(free, axis=1)
+            slot = jnp.where(any_same, jnp.argmax(same, axis=1),
+                             jnp.where(any_free, jnp.argmax(free, axis=1),
+                                       worst))
+            evict = oks & ~any_same & ~any_free
+            ins = oks & (~evict | (ranks < worst_rank))
+            evicted = evicted + jnp.sum(evict & ins).astype(jnp.int32)
+
+            q_from = set2d(q_from, ids, slot, srcs, ok=ins)
+            q_lvl = set2d(q_lvl, ids, slot, lvls, ok=ins)
+            q_rank = set2d(q_rank, ids, slot, ranks, ok=ins)
+            q_bad = set2d(q_bad, ids, slot, False, ok=ins)
+            q_sig = set_rows(q_sig, ids, slot, sig_all[:, s], ok=ins)
+
+        return p.replace(q_from=q_from, q_lvl=q_lvl, q_rank=q_rank,
+                         q_bad=q_bad, q_sig=q_sig, finished_peers=finished,
+                         msg_filtered=p.msg_filtered + filtered,
+                         evicted=evicted)
+
+    # -- apply a finished verification (updateVerifiedSignatures, :686-750)
+
+    def _apply_pending(self, p: HandelState, nodes, t, onehot, subm, hi):
+        n, w, L = self.node_count, self.w, self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        due = (p.pend_from >= 0) & (t >= p.pend_at)
+
+        vs_from, vs_level, vs_sig, vs_bad = (p.pend_from, p.pend_level,
+                                             p.pend_sig, p.pend_bad)
+        # Bad sig -> blacklist the sender (suicide attack, :690-699).
+        bad = due & vs_bad
+        blacklist = jnp.where(bad[:, None],
+                              p.blacklist | bitset.one_bit(vs_from, w),
+                              p.blacklist)
+        ok = due & ~vs_bad
+
+        lmask = self._range_mask_dyn(ids, vs_level)           # [N, W]
+        from_bit = bitset.one_bit(jnp.maximum(vs_from, 0), w)
+        ver_ind = jnp.where(ok[:, None], p.ver_ind | from_bit, p.ver_ind)
+
+        # lastAgg(level) = sig if it intersects the old, else old | sig —
+        # only when the combined set improves on verifiedInd (:710-724).
+        old_agg_l = p.last_agg & lmask
+        ver_l = ver_ind & lmask
+        improves = (bitset.popcount(vs_sig | ver_l) >
+                    bitset.popcount(ver_l))
+        inter = bitset.intersects(old_agg_l, vs_sig)
+        new_agg_l = jnp.where((improves & inter)[:, None], vs_sig,
+                              jnp.where(improves[:, None],
+                                        old_agg_l | vs_sig, old_agg_l))
+        last_agg = jnp.where(ok[:, None],
+                             (p.last_agg & ~lmask) | new_agg_l, p.last_agg)
+
+        total_inc = last_agg | ver_ind
+        inc_pc = self._level_pc(total_inc, onehot, subm, hi)  # [N, L]
+        halfs = jnp.asarray(self.half)[None, :]               # [1, L]
+        vs_half = jnp.where(vs_level > 0,
+                            1 << jnp.clip(vs_level - 1, 0, 30), 0)
+        vs_inc = gather2d(inc_pc, ids, vs_level)
+        just_completed = ok & (vs_inc >= vs_half) & (vs_half > 0)
+
+        # Fast path (:738-743): on level completion, the lowest upper level
+        # whose outgoing set is complete sends to fast_path peers.
+        fast_level = jnp.zeros((n,), jnp.int32)
+        if self.fast_path > 0:
+            og_size = 1 + jnp.cumsum(inc_pc, axis=1) - inc_pc  # sum l'<l
+            og_complete = og_size >= halfs                     # [N, L]
+            cand = (og_complete &
+                    (jnp.arange(L)[None, :] > vs_level[:, None]) &
+                    (halfs > 0) & just_completed[:, None])
+            first = jnp.argmax(cand, axis=1)
+            fast_level = jnp.where(jnp.any(cand, axis=1), first, 0)
+
+        # doneAt at threshold (:747-749).
+        total_card = bitset.popcount(total_inc)
+        done_now = (nodes.done_at == 0) & ok & (total_card >= self.threshold)
+        nodes = nodes.replace(done_at=jnp.where(
+            done_now, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
+
+        p = p.replace(blacklist=blacklist, ver_ind=ver_ind,
+                      last_agg=last_agg,
+                      pend_from=jnp.where(due, -1, p.pend_from))
+        return p, nodes, fast_level
+
+    # -- pick next signature to verify (checkSigs/bestToVerify, :566-630)
+
+    def _pick_verification(self, p: HandelState, nodes, t, active,
+                           onehot, subm, hi):
+        n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
+        ids = jnp.arange(n, dtype=jnp.int32)
+        due = (active & (p.pend_from < 0) &
+               ((t - (p.start_at + 1)) % p.pairing == 0))
+
+        total_inc = p.last_agg | p.ver_ind
+        inc_pc = self._level_pc(total_inc, onehot, subm, hi)   # [N, L]
+        ver_pc = self._level_pc(p.ver_ind, onehot, subm, hi)
+        agg_pc = self._level_pc(p.last_agg, onehot, subm, hi)
+        halfs = jnp.asarray(self.half)[None, :]
+
+        rows = ids[:, None]
+        filled = p.q_from >= 0                                 # [N, Q]
+        elvl = p.q_lvl
+        emask = self._range_mask_dyn(rows, elvl)               # [N, Q, W]
+        sig = p.q_sig                                          # [N, Q, W]
+        inc_e = total_inc[:, None, :] & emask
+        ver_e = p.ver_ind[:, None, :] & emask
+        agg_e = p.last_agg[:, None, :] & emask
+        cur_size = gather2d(inc_pc, rows, elvl)                # [N, Q]
+        blk = _get_bit_rows(p.blacklist, jnp.maximum(p.q_from, 0))
+
+        # sizeIfIncluded (:545-552).
+        disj = ~bitset.intersects(sig, inc_e)
+        merged = jnp.where(disj[..., None], sig | inc_e, sig)
+        s_inc = bitset.popcount(merged | ver_e)
+        improving = filled & ~blk & (s_inc > cur_size)
+        keep = improving | ~filled          # curation (:597-614)
+
+        # windowIndex = min rank over the whole queue per level (:573-574).
+        lvl_eq = (elvl[:, None, :] ==
+                  jnp.arange(L, dtype=jnp.int32)[None, :, None])  # [N, L, Q]
+        rank_b = jnp.where(filled[:, None, :] & lvl_eq, p.q_rank[:, None, :],
+                           BIG)
+        win_lo = jnp.min(rank_b, axis=2)                       # [N, L]
+        win_lo_e = gather2d(win_lo, rows, elvl)
+        inside = improving & (p.q_rank <= win_lo_e +
+                              p.curr_window[:, None])
+
+        # score (:651-664).
+        halfs_arr = jnp.asarray(self.half)
+        agg_card_e = gather2d(agg_pc, rows, elvl)
+        half_e = halfs_arr[elvl]
+        sc_disj = agg_card_e + bitset.popcount(sig)
+        sc_join = jnp.maximum(0, bitset.popcount(sig | ver_e) - agg_card_e)
+        score = jnp.where(bitset.intersects(sig, agg_e), sc_join, sc_disj)
+        score = jnp.where(agg_card_e >= half_e, 0, score)
+        score_in = jnp.where(inside, score, -1)
+
+        # Per-level best: inside-window best score, else lowest rank outside.
+        score_b = jnp.where(lvl_eq, score_in[:, None, :], -1)
+        in_slot = jnp.argmax(score_b, axis=2)                  # [N, L]
+        in_ok = jnp.max(score_b, axis=2) > 0
+        out_rank_b = jnp.where(lvl_eq & (improving & ~inside)[:, None, :],
+                               p.q_rank[:, None, :], BIG)
+        out_slot = jnp.argmin(out_rank_b, axis=2)
+        out_ok = jnp.min(out_rank_b, axis=2) < BIG
+        best_slot = jnp.where(in_ok, in_slot, out_slot)        # [N, L]
+        has_best = (in_ok | out_ok) & due[:, None]
+
+        # chooseBestFromLevels (:788-790): uniform random non-empty level.
+        cnt = jnp.sum(has_best, axis=1).astype(jnp.int32)
+        r = prng.uniform_int(prng.hash3(p.seed, TAG_LEVEL, t), ids,
+                             jnp.maximum(cnt, 1))
+        csum = jnp.cumsum(has_best, axis=1).astype(jnp.int32)
+        pick_level = jnp.argmax((csum == r[:, None] + 1) & has_best, axis=1)
+        do = due & (cnt > 0)
+
+        slot = gather2d(best_slot, ids, pick_level)
+        vfrom = gather2d(p.q_from, ids, slot)
+        vbad = gather2d(p.q_bad, ids, slot)
+        vsig = gather_rows(p.q_sig, ids, slot)
+
+        # Window resize (:821-823): grow on good, shrink on bad, clamped to
+        # [min, max] then to the level size.
+        lsize = jnp.maximum(halfs_arr[pick_level], 1)
+        grown = jnp.where(vbad, p.curr_window // 4, 2 * p.curr_window)
+        new_win = jnp.clip(grown, self.window_min, self.window_max)
+        curr_window = jnp.where(do, jnp.minimum(new_win, lsize),
+                                p.curr_window)
+
+        # Rank demotion (:830-834) — remembered as a bit.
+        demoted = jnp.where(
+            do[:, None],
+            p.demoted | bitset.one_bit(jnp.maximum(vfrom, 0), w), p.demoted)
+
+        # Curation sweep for due nodes + removal of the picked entry.
+        q_from = jnp.where(due[:, None] & ~keep, -1, p.q_from)
+        q_from = set2d(q_from, ids, slot, -1, ok=do)
+
+        return p.replace(
+            q_from=q_from, curr_window=curr_window, demoted=demoted,
+            pend_from=jnp.where(do, vfrom, p.pend_from),
+            pend_level=jnp.where(do, pick_level, p.pend_level),
+            pend_bad=jnp.where(do, vbad, p.pend_bad),
+            pend_sig=jnp.where(do[:, None], vsig, p.pend_sig),
+            pend_at=jnp.where(do, t + p.pairing, p.pend_at),
+            sigs_checked=p.sigs_checked + do.astype(jnp.int32))
+
+    # -- dissemination (doCycle, :331-343,:470-504) + outbox assembly
+
+    def _disseminate(self, p: HandelState, nodes, t, active, fast_level,
+                     onehot, subm, hi):
+        n, w, L = self.node_count, self.w, self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        done = nodes.done_at > 0
+        halfs_np = self.half                                   # numpy [L]
+        halfs = jnp.asarray(halfs_np)[None, :]
+
+        per_due = active & ((t - (p.start_at + 1)) % self.period == 0)
+        # extraCycle (:331-343): done nodes keep disseminating for
+        # added_cycle more periods.
+        send_ok = per_due & (~done | (p.added_cycle > 0))
+        added_cycle = jnp.where(per_due & done,
+                                jnp.maximum(p.added_cycle - 1, 0),
+                                p.added_cycle)
+
+        total_inc = p.last_agg | p.ver_ind
+        inc_pc = self._level_pc(total_inc, onehot, subm, hi)   # [N, L]
+        og_size = 1 + jnp.cumsum(inc_pc, axis=1) - inc_pc      # sum l'<l + own
+        og_complete = og_size >= halfs
+        inc_complete = inc_pc >= halfs
+        lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+        is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
+                   og_complete) & (halfs > 0)
+
+        # Candidate existence per level: any waited peer not finished and
+        # not blacklisted (else outgoingFinished, :470-504).
+        fin_pc = self._level_pc(p.finished_peers | p.blacklist, onehot,
+                                subm, hi)
+        any_cand = (halfs - fin_pc) > 0
+
+        # Round-robin pick: next non-finished peer in emission order,
+        # looking ahead `look` entries from posInLevel.
+        look = self.emission_lookahead
+        half_cols = jnp.maximum(halfs, 1)                      # [1, L]
+        offs = (p.pos[:, :, None] + jnp.arange(look)[None, None, :]) % \
+            half_cols[:, :, None]                              # [N, L, k]
+        cols = jnp.minimum(half_cols[:, :, None] + offs, n - 1)
+        cand_ids = gather2d(p.emission, ids[:, None, None], cols)
+        bad_bits = p.finished_peers | p.blacklist
+        okc = ~_get_bit_rows(bad_bits, cand_ids)               # [N, L, k]
+        found = jnp.any(okc, axis=2)
+        first = jnp.argmax(okc, axis=2)
+        # candidate at the first ok position (max trick: invalid -> -1).
+        peer = jnp.max(jnp.where(
+            okc & (jnp.arange(look)[None, None, :] == first[..., None]),
+            cand_ids, -1), axis=2)                             # [N, L]
+
+        send_l = send_ok[:, None] & is_open & any_cand & found
+        adv = per_due[:, None] & is_open & any_cand
+        pos = jnp.where(adv,
+                        (p.pos + jnp.where(found, first + 1, look)) %
+                        half_cols, p.pos)
+
+        rslot = (t // self.period) % self.rounds
+        K = self.cfg.out_deg
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, 3), jnp.int32)
+        sizes = jnp.ones((n, K), jnp.int32)
+        # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
+        sz_l = 1 + halfs // 8 + 192                            # [1, L]
+        dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
+        payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
+        payload = payload.at[:, :L - 1, 1].set(
+            inc_complete.astype(jnp.int32)[:, 1:])
+        payload = payload.at[:, :L - 1, 2].set(rslot)
+        sizes = sizes.at[:, :L - 1].set(
+            jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+
+        # Fast-path sends on level completion (:738-743), bypassing the
+        # period gate: the next fast_path peers of the completed level.
+        if self.fast_path > 0:
+            fp = self.fast_path
+            fl = fast_level                                    # [N], 0 = none
+            halfs_arr = jnp.asarray(halfs_np)
+            fhalf = jnp.maximum(halfs_arr[fl], 1)
+            fpos = gather2d(pos, ids, fl)
+            foffs = (fpos[:, None] + jnp.arange(fp)[None, :]) % \
+                fhalf[:, None]
+            fcols = jnp.minimum(fhalf[:, None] + foffs, n - 1)
+            fids = gather2d(p.emission, ids[:, None], fcols)
+            fok = ~_get_bit_rows(bad_bits, fids)
+            fsend = (fl > 0) & active & ~done
+            fdest = jnp.where(fsend[:, None] & fok, fids, -1)
+            koff = L - 1
+            dest = dest.at[:, koff:koff + fp].set(fdest)
+            payload = payload.at[:, koff:koff + fp, 0].set(fl[:, None])
+            payload = payload.at[:, koff:koff + fp, 2].set(rslot)
+            sizes = sizes.at[:, koff:koff + fp].set(
+                (1 + fhalf // 8 + 192)[:, None])
+            pos = add2d(pos, ids, jnp.maximum(fl, 1),
+                        jnp.where(fsend, jnp.sum(fok, axis=1), 0))
+
+        # Snapshot pool: any sender this ms records its current total_inc;
+        # receivers mask out their level's view at delivery.
+        wrote = jnp.any(dest >= 0, axis=1)
+        pool = set_rows(p.pool, ids, jnp.full((n,), rslot, jnp.int32),
+                        total_inc, ok=wrote)
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
+                                             size=sizes)
+        return p.replace(pos=pos, added_cycle=added_cycle, pool=pool), out
+
+    # ---------------------------------------------------------------- misc
+
+    def done(self, pstate, nodes):
+        return jnp.all(nodes.down | (nodes.done_at > 0))
+
+
+def cont_if_handel(net, pstate):
+    """Handel.newContIf (Handel.java:1040-1049): run while any live node is
+    not done or still owes extra dissemination cycles."""
+    live = ~net.nodes.down
+    return jnp.any(live & ((net.nodes.done_at == 0) |
+                           (pstate.added_cycle > 0)))

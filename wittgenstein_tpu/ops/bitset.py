@@ -1,0 +1,72 @@
+"""Word-packed bitset kernels: the TPU representation of java.util.BitSet.
+
+The reference's aggregation protocols are bitset algebra over node-id sets
+(Handel.java lastAggVerified/totalIncoming/..., GSFSignature, San Fermín).
+Here a bitset over [0, n) is a row of ``ceil(n/32)`` uint32 words; all ops
+are elementwise, so they batch freely over [N, W] node-state matrices.
+
+Contiguous-range masks matter because the binary-tree protocols only ever
+deal in aligned ranges (a node's level-l peer set is the sibling half of its
+2^l-aligned block — Handel.allSigsAtLevel, Handel.java:667-680), so a mask
+is computed from (base, length) arithmetic, never stored.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+WORD = 32
+
+
+def n_words(n: int) -> int:
+    return (n + WORD - 1) // WORD
+
+
+def popcount(bits, axis=-1):
+    """Total set bits along the word axis."""
+    return jnp.sum(jax.lax.population_count(bits).astype(jnp.int32),
+                   axis=axis)
+
+
+def one_bit(idx, w: int):
+    """[..., W] bitset with exactly bit `idx` set (idx int array)."""
+    idx = jnp.asarray(idx)
+    word = jnp.arange(w, dtype=jnp.int32)
+    hit = (idx[..., None] // WORD) == word
+    return jnp.where(hit, U32(1) << (idx[..., None] % WORD).astype(U32),
+                     U32(0))
+
+
+def get_bit(bits, idx):
+    """Read bit `idx` from [..., W] bitsets (idx broadcastable int array)."""
+    word = jnp.take_along_axis(bits, (idx[..., None] // WORD), axis=-1)[..., 0]
+    return ((word >> (idx % WORD).astype(U32)) & U32(1)) != 0
+
+
+def range_mask(base, length, w: int):
+    """[..., W] mask of the contiguous bit range [base, base+length).
+
+    base/length are int arrays (broadcast to the leading shape).  Handles the
+    hi==32 full-word case without a 1<<32 overflow.
+    """
+    base = jnp.asarray(base, jnp.int32)[..., None]
+    end = base + jnp.asarray(length, jnp.int32)[..., None]
+    wlo = jnp.arange(w, dtype=jnp.int32) * WORD
+    lo = jnp.clip(base - wlo, 0, WORD)
+    hi = jnp.clip(end - wlo, 0, WORD)
+    full = U32(0xFFFFFFFF)
+    m_hi = jnp.where(hi >= WORD, full, (U32(1) << hi.astype(U32)) - U32(1))
+    m_lo = jnp.where(lo >= WORD, full, (U32(1) << lo.astype(U32)) - U32(1))
+    return m_hi & ~m_lo
+
+
+def includes(a, b, axis=-1):
+    """True where bitset a ⊇ b (BitSetUtils.include, core/utils/
+    BitSetUtils.java)."""
+    return jnp.all((b & ~a) == 0, axis=axis)
+
+
+def intersects(a, b, axis=-1):
+    return jnp.any((a & b) != 0, axis=axis)

@@ -1,0 +1,59 @@
+"""Flat-index gather/scatter helpers.
+
+XLA:TPU handles 1-D gathers/scatters with computed flat indices far better
+than multi-dimensional ones: multi-dim forms trigger per-row serialization
+and tile-relayout copies of the target (measured ~100x slower on the hot
+simulator paths).  These helpers express `arr[i, j]`-style access as row-major
+flat indexing.  The transient `reshape(-1)` of small state arrays is cheap;
+keep big ring buffers stored flat (see core/state.py NetState.box_*).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gather2d(arr, i, j):
+    """arr[A, B][i, j] elementwise over broadcasted index arrays."""
+    b = arr.shape[-1]
+    return arr.reshape(-1)[i * b + j]
+
+
+def gather_rows(arr3, i, j):
+    """arr[A, B, C][i, j] -> [..., C] row gather via flat indices."""
+    a, b, c = arr3.shape
+    base = (i * b + j)[..., None] * c + jnp.arange(c, dtype=jnp.int32)
+    return arr3.reshape(-1)[base]
+
+
+def set2d(arr2, i, j, vals, ok=None):
+    """arr[A, B] with arr[i, j] = vals where ok (drops where not)."""
+    a, b = arr2.shape
+    flat = i * b + j
+    if ok is not None:
+        flat = jnp.where(ok, flat, a * b)
+    out = arr2.reshape(-1).at[flat.reshape(-1)].set(
+        jnp.broadcast_to(vals, flat.shape).reshape(-1), mode="drop",
+        unique_indices=True)
+    return out.reshape(a, b)
+
+
+def add2d(arr2, i, j, vals):
+    """arr[A, B] with arr[i, j] += vals (duplicate indices accumulate)."""
+    a, b = arr2.shape
+    out = arr2.reshape(-1).at[(i * b + j).reshape(-1)].add(
+        jnp.broadcast_to(vals, i.shape).reshape(-1), mode="drop")
+    return out.reshape(a, b)
+
+
+def set_rows(arr3, i, j, vals, ok=None):
+    """arr[A, B, C] with row arr[i, j, :] = vals[..., C] where ok."""
+    a, b, c = arr3.shape
+    flat = i * b + j
+    if ok is not None:
+        flat = jnp.where(ok, flat, a * b)       # row a*b is OOB -> dropped
+    idx = flat[..., None] * c + jnp.arange(c, dtype=jnp.int32)
+    out = arr3.reshape(-1).at[idx.reshape(-1)].set(
+        jnp.broadcast_to(vals, idx.shape).reshape(-1), mode="drop",
+        unique_indices=True)
+    return out.reshape(a, b, c)

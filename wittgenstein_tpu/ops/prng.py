@@ -81,3 +81,29 @@ def uniform_int(seed, ids, n):
 def bernoulli(seed, ids, p):
     """Deterministic bernoulli(p) per id; p float array or scalar."""
     return uniform_float(seed, ids) < p
+
+
+def bij_perm(key, x, bits: int):
+    """Keyed bijective permutation of [0, 2^bits): a mini-PRP built from
+    invertible uint32 steps (xor-with-key, multiply-by-odd, xorshift-right),
+    so every (key) defines a distinct full permutation with NO storage.
+
+    This replaces the reference's stored random-rank matrices — e.g. Handel's
+    ``receptionRanks`` built by shuffling the full node list per node
+    (Handel.java:940-948), an [N, N] matrix that cannot exist at 1M nodes
+    (SURVEY.md §7.4.6): rank(i, s) = bij_perm(hash(seed, i), s, log2 N).
+    """
+    assert 1 <= bits <= 31
+    mask = _U32((1 << bits) - 1)
+    x = jnp.asarray(x).astype(_U32) & mask
+    key = jnp.asarray(key).astype(_U32)
+    s1 = max(1, (bits + 1) // 2)
+    s2 = max(1, (2 * bits) // 3)
+    for c in (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35):
+        k = mix32(key ^ _U32(c))
+        x = (x ^ (k & mask)) & mask
+        x = (x * (k | _U32(1))) & mask          # odd multiplier: bijective
+        x = x ^ (x >> _U32(s1))                 # xorshift: bijective
+        x = (x * _U32(0x6A09E667 | 1)) & mask
+        x = x ^ (x >> _U32(s2))
+    return (x & mask).astype(jnp.int32)
