@@ -134,6 +134,13 @@ class NetState:
 def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
     h, n, c, f, b = (cfg.horizon, cfg.n, cfg.inbox_cap, cfg.payload_words,
                      cfg.bcast_slots)
+    if f * h * n * c >= 1 << 31:
+        # Flat ring indices are int32; beyond this the single-chip mailbox
+        # must be sharded (the node axis partitions cleanly across devices).
+        raise ValueError(
+            f"mailbox ring too large for int32 flat indexing: "
+            f"{f}x{h}x{n}x{c} >= 2^31; shrink horizon/inbox_cap or shard "
+            f"the node axis across devices")
     return NetState(
         time=jnp.asarray(0, jnp.int32),
         seed=jnp.asarray(seed, jnp.int32),

@@ -1,0 +1,201 @@
+"""Slush & Snowflake — the Avalanche-family binary-consensus protocols.
+
+Reference: protocols/Slush.java (296) and protocols/Snowflake.java (312).
+Mechanism: a colored node repeatedly queries K distinct random peers for
+their color; an uncolored receiver adopts the query's color and starts
+querying too; every receiver answers with its current color.  When the
+querier has K answers: if the OTHER color got more than A*K answers it
+flips (Slush.onAnswer:163-175).  Slush runs M rounds then decides;
+Snowflake instead keeps a confidence counter — a flip resets it, a
+supermajority of its own color increments it, and it decides once the
+counter exceeds B (Snowflake.onAnswer:170-194).
+
+TPU-native state: one outstanding query per node (that is also the
+reference's steady state — a node issues query r+1 only after round r's
+K-th answer), so the answer bookkeeping is two [N] counters instead of a
+map of Answer objects.  Peer sampling uses counter-based draws with a few
+collision-repair rounds (the K-distinct invariant of randomRemotes,
+Slush.java:125-136).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import prng
+
+QUERY, ANSWER = 0, 1
+TAG_SAMPLE = 0x534C5348
+
+
+@struct.dataclass
+class AvalancheState:
+    seed: jnp.ndarray      # int32 scalar
+    color: jnp.ndarray     # int32 [N]: 0 = uncolored, 1 or 2
+    nonce: jnp.ndarray     # int32 [N] — current query id (0 = no query yet)
+    round: jnp.ndarray     # int32 [N] — Slush round / Snowflake query count
+    cnt: jnp.ndarray       # int32 [N] — Snowflake confidence counter
+    got1: jnp.ndarray      # int32 [N] — answers for color 1, current query
+    got2: jnp.ndarray      # int32 [N]
+    decided: jnp.ndarray   # bool [N]
+
+
+class _AvalancheBase:
+    """Shared Query/Answer machinery; subclasses decide flip/termination."""
+
+    def __init__(self, node_count=100, rounds=5, k=7, alpha=4.0 / 7.0,
+                 beta=3, node_builder_name=None, network_latency_name=None,
+                 inbox_cap=16, horizon=1024):
+        self.node_count = node_count
+        self.rounds = rounds
+        self.k = k
+        self.ak = alpha * k     # params.AK (A is a fraction here; the
+        #                         reference passes A=4 with K=7 meaning 4/7*K)
+        self.beta = beta
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        s = inbox_cap + 1
+        self.cfg = EngineConfig(n=node_count, horizon=horizon,
+                                inbox_cap=inbox_cap, payload_words=3,
+                                out_deg=k + s, bcast_slots=1)
+
+    def init(self, seed):
+        n = self.node_count
+        nodes = self.builder.build(seed, n)
+        net = init_net(self.cfg, nodes, seed)
+        ids = jnp.arange(n)
+        # init (Slush.java:64-74): node 0 gets color 1, node 1 color 2, and
+        # both start querying (handled at t == 0 in step).
+        color = jnp.where(ids == 0, 1, jnp.where(ids == 1, 2, 0))
+        return net, AvalancheState(
+            seed=jnp.asarray(seed, jnp.int32),
+            color=color.astype(jnp.int32),
+            nonce=jnp.zeros((n,), jnp.int32),
+            round=jnp.zeros((n,), jnp.int32),
+            cnt=jnp.zeros((n,), jnp.int32),
+            got1=jnp.zeros((n,), jnp.int32),
+            got2=jnp.zeros((n,), jnp.int32),
+            decided=jnp.zeros((n,), bool))
+
+    def _sample_peers(self, seed, nonce, n, k):
+        """K distinct uniform peers != self per node (randomRemotes,
+        Slush.java:125-136): fresh draw per (node, nonce)."""
+        ids = jnp.arange(n, dtype=jnp.int32)
+        cols = []
+        for j in range(k):
+            s = prng.hash3(prng.hash2(seed, TAG_SAMPLE), nonce * k + j, ids)
+            p = prng.uniform_int(s, ids, n - 1)
+            cols.append(p + (p >= ids))
+        part = jnp.stack(cols, axis=1)
+        for r in range(1, 4):
+            dup = jnp.zeros(part.shape, bool)
+            for j in range(1, k):
+                dup = dup.at[:, j].set(
+                    jnp.any(part[:, :j] == part[:, j:j + 1], axis=1))
+            s = prng.hash3(prng.hash2(seed, TAG_SAMPLE + r),
+                           nonce[:, None] * k + jnp.arange(k)[None, :],
+                           ids[:, None])
+            rd = prng.uniform_int(s, ids[:, None], n - 1)
+            part = jnp.where(dup, rd + (rd >= ids[:, None]), part)
+        return part                                           # [N, K]
+
+    def step(self, p: AvalancheState, nodes, inbox, t, key):
+        n, k = self.node_count, self.k
+        ids = jnp.arange(n, dtype=jnp.int32)
+        out = empty_outbox(self.cfg)
+        s_slots = inbox.src.shape[1]
+
+        typ = inbox.data[:, :, 0]
+        qid = inbox.data[:, :, 1]
+        qcolor = jnp.clip(inbox.data[:, :, 2], 0, 2)
+
+        # --- queries: adopt if uncolored, answer each with current color.
+        is_q = inbox.valid & (typ == QUERY)
+        any_q = jnp.any(is_q, axis=1)
+        first_q = jnp.argmax(is_q, axis=1)
+        first_color = jnp.take_along_axis(qcolor, first_q[:, None],
+                                          axis=1)[:, 0]
+        adopt = any_q & (p.color == 0)
+        color = jnp.where(adopt, first_color, p.color)
+
+        # Answers: one outbox slot per inbox slot (dest = querier).
+        ans_dest = jnp.where(is_q, inbox.src, -1)             # [N, S]
+        ans_payload = jnp.stack(
+            [jnp.full((n, s_slots), ANSWER, jnp.int32),
+             qid, jnp.broadcast_to(color[:, None], (n, s_slots))], axis=-1)
+
+        # --- answers for the current query.
+        is_a = (inbox.valid & (typ == ANSWER) &
+                (qid == p.nonce[:, None]) & (p.nonce > 0)[:, None])
+        got1 = p.got1 + jnp.sum(is_a & (qcolor == 1), axis=1)
+        got2 = p.got2 + jnp.sum(is_a & (qcolor == 2), axis=1)
+        complete = (~p.decided) & (p.nonce > 0) & (got1 + got2 >= k)
+
+        other = jnp.where(color == 1, 2, 1)
+        got_other = jnp.where(color == 1, got2, got1)
+        got_mine = jnp.where(color == 1, got1, got2)
+        flip = complete & (got_other > self.ak)
+        color = jnp.where(flip, other, color)
+        p2, requery, decided = self._on_complete(p, complete, flip,
+                                                 got_mine, color)
+
+        # --- issue queries: adopters start their first (onQuery:150-155);
+        # at t == 0 the two seeded nodes start (init); completers re-query.
+        kick = (t == 0) & (p.color > 0)
+        start = (~p.decided) & (adopt | kick | requery) & ~decided
+        nonce = jnp.where(start, p2.nonce + 1, p2.nonce)
+        peers = self._sample_peers(p.seed, nonce, n, k)
+        q_dest = jnp.where(start[:, None], peers, -1)
+        q_payload = jnp.stack(
+            [jnp.full((n, k), QUERY, jnp.int32),
+             jnp.broadcast_to(nonce[:, None], (n, k)),
+             jnp.broadcast_to(color[:, None], (n, k))], axis=-1)
+
+        out = out.replace(
+            dest=jnp.concatenate([q_dest, ans_dest], axis=1),
+            payload=jnp.concatenate([q_payload, ans_payload], axis=1))
+
+        done_now = decided & (nodes.done_at == 0)
+        nodes = nodes.replace(done_at=jnp.where(
+            done_now, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
+
+        return (p2.replace(color=color, nonce=nonce,
+                           got1=jnp.where(complete | start, 0, got1),
+                           got2=jnp.where(complete | start, 0, got2),
+                           decided=p2.decided | decided),
+                nodes, out)
+
+    def colors(self, p):
+        return p.color
+
+
+@register
+class Slush(_AvalancheBase):
+    """M rounds of K-sample queries, then decide (Slush.java:163-175)."""
+
+    def _on_complete(self, p, complete, flip, got_mine, color):
+        # Reference counting (Slush.onAnswer:168-173): requery while
+        # round < M, incrementing on each completion — so a node completes
+        # M+1 queries in total before it stops.
+        round2 = jnp.where(complete, p.round + 1, p.round)
+        requery = complete & (round2 <= self.rounds)
+        decided = complete & (round2 > self.rounds)
+        return p.replace(round=round2), requery, decided
+
+
+@register
+class Snowflake(_AvalancheBase):
+    """Confidence counter beta before accepting (Snowflake.java:170-194)."""
+
+    def _on_complete(self, p, complete, flip, got_mine, color):
+        cnt = jnp.where(complete & flip, 0,
+                        jnp.where(complete & (got_mine > self.ak),
+                                  p.cnt + 1, p.cnt))
+        decided = complete & (cnt > self.beta)
+        requery = complete & ~decided
+        return p.replace(cnt=cnt), requery, decided

@@ -118,6 +118,9 @@ class HandelState:
     pend_bad: jnp.ndarray      # bool [N]
     pend_sig: jnp.ndarray      # u32 [N, W]
     pend_at: jnp.ndarray       # int32 [N] — apply time
+    fast_pending: jnp.ndarray  # int32 [N] — level bitmask of queued
+    #                            fast-path sends (drained lowest-first,
+    #                            one level per ms)
     sigs_checked: jnp.ndarray  # int32 [N]
     msg_filtered: jnp.ndarray  # int32 [N]
     evicted: jnp.ndarray       # int32 scalar — queue evictions (diagnostic)
@@ -137,6 +140,12 @@ class Handel:
         if node_count & (node_count - 1):
             raise ValueError("we support only power-of-two node counts "
                              "(Handel.java:119-121)")
+        if node_count > 32768:
+            # The stored [N, N] emission matrix (and its int32 sort key)
+            # caps the single-chip exact implementation; larger N needs the
+            # in-kernel emission permutation + sharded node axis.
+            raise ValueError("node_count > 32768 requires the sharded "
+                             "engine (emission matrix is O(N^2))")
         threshold = (int(node_count * 0.99) if threshold is None
                      else threshold)
         if not (0 <= nodes_down < node_count and
@@ -296,6 +305,7 @@ class Handel:
             pend_bad=jnp.zeros((n,), bool),
             pend_sig=jnp.zeros((n, w), U32),
             pend_at=jnp.zeros((n,), jnp.int32),
+            fast_pending=jnp.zeros((n,), jnp.int32),
             sigs_checked=jnp.zeros((n,), jnp.int32),
             msg_filtered=jnp.zeros((n,), jnp.int32),
             evicted=jnp.asarray(0, jnp.int32),
@@ -312,11 +322,9 @@ class Handel:
         hi = ids >> 5
 
         p = self._receive(p, nodes, inbox, t)
-        p, nodes, fast_level = self._apply_pending(p, nodes, t, onehot,
-                                                   subm, hi)
+        p, nodes = self._apply_pending(p, nodes, t, onehot, subm, hi)
         p = self._pick_verification(p, nodes, t, active, onehot, subm, hi)
-        p, out = self._disseminate(p, nodes, t, active, fast_level,
-                                   onehot, subm, hi)
+        p, out = self._disseminate(p, nodes, t, active, onehot, subm, hi)
         return p, nodes, out
 
     # -- receive: queue incoming aggregates (onNewSig, Handel.java:753-786)
@@ -423,17 +431,22 @@ class Handel:
         vs_inc = gather2d(inc_pc, ids, vs_level)
         just_completed = ok & (vs_inc >= vs_half) & (vs_half > 0)
 
-        # Fast path (:738-743): on level completion, the lowest upper level
-        # whose outgoing set is complete sends to fast_path peers.
-        fast_level = jnp.zeros((n,), jnp.int32)
+        # Fast path (:738-743): on level completion, EVERY upper level
+        # whose outgoing set is complete sends to fast_path peers.  The
+        # reference sends them all in the same event; here the qualifying
+        # levels queue into a bitmask drained one level per ms (K-slot
+        # budget) — a <=L-ms stagger, far below the dissemination period.
+        fast_pending = p.fast_pending
         if self.fast_path > 0:
             og_size = 1 + jnp.cumsum(inc_pc, axis=1) - inc_pc  # sum l'<l
             og_complete = og_size >= halfs                     # [N, L]
             cand = (og_complete &
                     (jnp.arange(L)[None, :] > vs_level[:, None]) &
                     (halfs > 0) & just_completed[:, None])
-            first = jnp.argmax(cand, axis=1)
-            fast_level = jnp.where(jnp.any(cand, axis=1), first, 0)
+            bits = jnp.sum(
+                jnp.where(cand, jnp.int32(1) << jnp.arange(L)[None, :], 0),
+                axis=1).astype(jnp.int32)
+            fast_pending = fast_pending | bits
 
         # doneAt at threshold (:747-749).
         total_card = bitset.popcount(total_inc)
@@ -442,9 +455,9 @@ class Handel:
             done_now, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
 
         p = p.replace(blacklist=blacklist, ver_ind=ver_ind,
-                      last_agg=last_agg,
+                      last_agg=last_agg, fast_pending=fast_pending,
                       pend_from=jnp.where(due, -1, p.pend_from))
-        return p, nodes, fast_level
+        return p, nodes
 
     # -- pick next signature to verify (checkSigs/bestToVerify, :566-630)
 
@@ -551,7 +564,7 @@ class Handel:
 
     # -- dissemination (doCycle, :331-343,:470-504) + outbox assembly
 
-    def _disseminate(self, p: HandelState, nodes, t, active, fast_level,
+    def _disseminate(self, p: HandelState, nodes, t, active,
                      onehot, subm, hi):
         n, w, L = self.node_count, self.w, self.levels
         ids = jnp.arange(n, dtype=jnp.int32)
@@ -621,10 +634,14 @@ class Handel:
             jnp.broadcast_to(sz_l, (n, L))[:, 1:])
 
         # Fast-path sends on level completion (:738-743), bypassing the
-        # period gate: the next fast_path peers of the completed level.
+        # period gate: drain the lowest queued level's fast_path peers.
+        fast_pending = p.fast_pending
         if self.fast_path > 0:
             fp = self.fast_path
-            fl = fast_level                                    # [N], 0 = none
+            lsb = fast_pending & -fast_pending
+            fl = jnp.where(lsb > 0,
+                           31 - jax.lax.clz(jnp.maximum(lsb, 1)), 0)
+            fl = fl.astype(jnp.int32)                          # [N], 0 = none
             halfs_arr = jnp.asarray(halfs_np)
             fhalf = jnp.maximum(halfs_arr[fl], 1)
             fpos = gather2d(pos, ids, fl)
@@ -643,6 +660,10 @@ class Handel:
                 (1 + fhalf // 8 + 192)[:, None])
             pos = add2d(pos, ids, jnp.maximum(fl, 1),
                         jnp.where(fsend, jnp.sum(fok, axis=1), 0))
+            fast_pending = jnp.where(fsend, fast_pending & ~lsb,
+                                     fast_pending)
+            # Done nodes never fast-path again; drop stale queued levels.
+            fast_pending = jnp.where(done, 0, fast_pending)
 
         # Snapshot pool: any sender this ms records its current total_inc;
         # receivers mask out their level's view at delivery.
@@ -652,7 +673,8 @@ class Handel:
 
         out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
                                              size=sizes)
-        return p.replace(pos=pos, added_cycle=added_cycle, pool=pool), out
+        return p.replace(pos=pos, added_cycle=added_cycle, pool=pool,
+                         fast_pending=fast_pending), out
 
     # ---------------------------------------------------------------- misc
 

@@ -1,0 +1,270 @@
+"""Paxos — classic two-phase consensus with competing proposers.
+
+Reference: protocols/Paxos.java (525).  Mechanism: proposers send `Propose
+(seq)` to all acceptors (seq numbers partitioned by proposer rank,
+startNextProposal, Paxos.java:313-338); acceptors agree to the highest seq
+they've seen (onPropose :167-180) and report any previously accepted value;
+on a majority of agrees the proposer commits (the highest reported accepted
+value, else its own — onAgree :252-268); acceptors accept a commit matching
+their agreed seq (onCommit :183-196); a majority of accepts decides the
+proposer (onAccept :270-285); majorities of rejects or a timeout restart
+with a higher seq (:240-250, :287-297, :305-311).
+
+TPU-native notes: Paxos runs at ~3-10 nodes, so fidelity beats batching —
+inbox slots are processed SEQUENTIALLY (an unrolled loop over the slot
+axis), reproducing the reference's per-message ordering exactly.  All node
+state is [N] vectors; acceptors are ids [0, A), proposers [A, A+P).
+-1 encodes the reference's `null` for accepted seq/value.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import prng
+
+PROPOSE, REJECT, AGREE, COMMIT, ACCEPT, REJECT2 = range(6)
+MAX_VAL = 1000
+TAG_VAL = 0x50415856
+
+
+@struct.dataclass
+class PaxosState:
+    # acceptors (valid on ids < A)
+    max_agreed: jnp.ndarray     # int32 [N], init -1
+    accepted_seq: jnp.ndarray   # int32 [N], -1 = null
+    accepted_val: jnp.ndarray   # int32 [N], -1 = null
+    # proposers (valid on ids >= A)
+    value_proposed: jnp.ndarray  # int32 [N]
+    value_accepted: jnp.ndarray  # int32 [N], -1 = null
+    acc_seq_ip: jnp.ndarray     # int32 [N], -1 = null
+    acc_val_ip: jnp.ndarray     # int32 [N], -1 = null
+    seq_ip: jnp.ndarray         # int32 [N]
+    seq_accepted: jnp.ndarray   # int32 [N]
+    agree_ip: jnp.ndarray       # int32 [N]
+    rej1_ip: jnp.ndarray
+    accept_ip: jnp.ndarray
+    rej2_ip: jnp.ndarray
+    proposal_ip: jnp.ndarray    # bool [N]
+    timeout_at: jnp.ndarray     # int32 [N], 0 = none
+    # statistics (ProposerNode counters)
+    agree_count: jnp.ndarray
+    rej1_count: jnp.ndarray
+    rej2_count: jnp.ndarray
+    timeout_count: jnp.ndarray
+
+
+@register
+class Paxos:
+    """Parameters mirror Paxos.PaxosParameters (Paxos.java:352-374)."""
+
+    def __init__(self, acceptor_count=3, proposer_count=3, timeout=1000,
+                 node_builder_name=None, network_latency_name=None,
+                 inbox_cap=16, horizon=2048):
+        self.a = acceptor_count
+        self.p = proposer_count
+        self.n = acceptor_count + proposer_count
+        self.majority = acceptor_count // 2 + 1
+        self.timeout = timeout
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        s = inbox_cap + 1
+        self.cfg = EngineConfig(n=self.n, horizon=horizon,
+                                inbox_cap=inbox_cap, payload_words=4,
+                                out_deg=s + acceptor_count, bcast_slots=1)
+        self.node_count = self.n
+
+    def _is_proposer(self):
+        return jnp.arange(self.n) >= self.a
+
+    def init(self, seed):
+        n = self.n
+        nodes = self.builder.build(seed, n)
+        net = init_net(self.cfg, nodes, seed)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        neg = jnp.full((n,), -1, jnp.int32)
+        zero = jnp.zeros((n,), jnp.int32)
+        # ProposerNode ctor: valueProposed = rd.nextInt(MAX_VAL).
+        vals = prng.uniform_int(prng.hash2(jnp.asarray(seed, jnp.int32),
+                                           TAG_VAL), ids, MAX_VAL)
+        # Initial proposal is issued at t == 0 in step (init calls
+        # startNextProposal for every proposer, Paxos.java:381-387).
+        return net, PaxosState(
+            max_agreed=neg, accepted_seq=neg, accepted_val=neg,
+            value_proposed=vals, value_accepted=neg,
+            acc_seq_ip=neg, acc_val_ip=neg,
+            seq_ip=zero, seq_accepted=zero,
+            agree_ip=zero, rej1_ip=zero, accept_ip=zero, rej2_ip=zero,
+            proposal_ip=jnp.zeros((n,), bool), timeout_at=zero,
+            agree_count=zero, rej1_count=zero, rej2_count=zero,
+            timeout_count=zero)
+
+    def _next_seq(self, p: PaxosState, start):
+        """startNextProposal seq partitioning (Paxos.java:325-334): next
+        multiple-of-proposerCount block above seqAccepted, plus rank."""
+        rank = jnp.arange(self.n, dtype=jnp.int32) - self.a
+        gap = p.seq_accepted % self.p
+        new_seq = p.seq_accepted + self.p - gap + rank
+        seq = jnp.where(new_seq > p.seq_ip, new_seq, p.seq_ip + self.p)
+        return jnp.where(start, seq, p.seq_ip)
+
+    def step(self, p: PaxosState, nodes, inbox, t, key):
+        n, A = self.n, self.a
+        ids = jnp.arange(n, dtype=jnp.int32)
+        is_prop = ids >= A
+        S = inbox.src.shape[1]
+        out = empty_outbox(self.cfg)
+
+        # Reply slots: one per inbox slot.
+        r_dest = jnp.full((n, S), -1, jnp.int32)
+        r_pay = jnp.zeros((n, S, 4), jnp.int32)
+
+        start = jnp.zeros((n,), bool)       # proposers starting a proposal
+        commit = jnp.zeros((n,), bool)      # proposers broadcasting Commit
+
+        # Timeout (onTimeout, :305-311): fires before this ms's messages.
+        fire = is_prop & p.proposal_ip & (p.timeout_at > 0) & \
+            (t >= p.timeout_at)
+        p = p.replace(proposal_ip=jnp.where(fire, False, p.proposal_ip),
+                      timeout_count=p.timeout_count + fire)
+        start = start | fire
+
+        for s in range(S):
+            valid = inbox.valid[:, s]
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            typ = inbox.data[:, s, 0]
+            a1 = inbox.data[:, s, 1]
+            a2 = inbox.data[:, s, 2]
+            a3 = inbox.data[:, s, 3]
+
+            # ---- acceptor: onPropose (:167-180)
+            m = valid & ~is_prop & (typ == PROPOSE)
+            rej = m & (a1 < p.max_agreed)
+            agr = m & (a1 > p.max_agreed)
+            r_dest = r_dest.at[:, s].set(jnp.where(rej | agr, src,
+                                                   r_dest[:, s]))
+            r_pay = r_pay.at[:, s, :].set(jnp.where(
+                rej[:, None],
+                jnp.stack([jnp.full_like(src, REJECT), a1, p.max_agreed,
+                           jnp.zeros_like(src)], -1),
+                jnp.where(agr[:, None],
+                          jnp.stack([jnp.full_like(src, AGREE), a1,
+                                     p.accepted_seq, p.accepted_val], -1),
+                          r_pay[:, s, :])))
+            p = p.replace(max_agreed=jnp.where(agr, a1, p.max_agreed))
+
+            # ---- acceptor: onCommit (:183-196)
+            m = valid & ~is_prop & (typ == COMMIT)
+            bad = m & ((a1 != p.max_agreed) |
+                       ((p.accepted_val >= 0) & (p.accepted_val != a2)))
+            good = m & ~bad
+            r_dest = r_dest.at[:, s].set(jnp.where(bad | good, src,
+                                                   r_dest[:, s]))
+            r_pay = r_pay.at[:, s, :].set(jnp.where(
+                bad[:, None],
+                jnp.stack([jnp.full_like(src, REJECT2), a1, p.max_agreed,
+                           jnp.zeros_like(src)], -1),
+                jnp.where(good[:, None],
+                          jnp.stack([jnp.full_like(src, ACCEPT), a1,
+                                     jnp.zeros_like(src),
+                                     jnp.zeros_like(src)], -1),
+                          r_pay[:, s, :])))
+            p = p.replace(
+                accepted_val=jnp.where(good, a2, p.accepted_val),
+                accepted_seq=jnp.where(
+                    good, jnp.maximum(p.accepted_seq, a1), p.accepted_seq))
+
+            # ---- proposer: onReject / onRejectOnCommit (:240-250,:287-297)
+            for tcode, cnt_name, stat_name in (
+                    (REJECT, "rej1_ip", "rej1_count"),
+                    (REJECT2, "rej2_ip", "rej2_count")):
+                m = valid & is_prop & (typ == tcode) & (a1 == p.seq_ip)
+                cnt = getattr(p, cnt_name) + m
+                hit = m & (cnt == self.majority)
+                p = p.replace(**{
+                    cnt_name: cnt,
+                    stat_name: getattr(p, stat_name) + hit})
+                p = p.replace(
+                    proposal_ip=jnp.where(hit, False, p.proposal_ip),
+                    seq_accepted=jnp.where(
+                        hit, jnp.maximum(p.seq_accepted, a2),
+                        p.seq_accepted))
+                start = start | hit
+
+            # ---- proposer: onAgree (:252-268)
+            m = valid & is_prop & (typ == AGREE) & (a1 == p.seq_ip) & \
+                (p.agree_ip < self.majority)
+            take = m & (a2 >= 0) & ((p.acc_seq_ip < 0) |
+                                    (p.acc_seq_ip < a2))
+            agree_ip = p.agree_ip + m
+            maj = m & (agree_ip >= self.majority)
+            p = p.replace(
+                agree_ip=agree_ip,
+                acc_seq_ip=jnp.where(take, a2, p.acc_seq_ip),
+                acc_val_ip=jnp.where(take, a3, p.acc_val_ip),
+                agree_count=p.agree_count + maj)
+            p = p.replace(acc_val_ip=jnp.where(
+                maj & (p.acc_val_ip < 0), p.value_proposed, p.acc_val_ip))
+            commit = commit | maj
+
+            # ---- proposer: onAccept (:270-285)
+            m = valid & is_prop & (typ == ACCEPT) & (a1 == p.seq_ip) & \
+                (p.accept_ip < self.majority)
+            accept_ip = p.accept_ip + m
+            dec = m & (accept_ip >= self.majority)
+            p = p.replace(
+                accept_ip=accept_ip,
+                proposal_ip=jnp.where(dec, False, p.proposal_ip),
+                value_accepted=jnp.where(dec, p.acc_val_ip,
+                                         p.value_accepted))
+            nodes = nodes.replace(done_at=jnp.where(
+                dec & (nodes.done_at == 0), jnp.maximum(t, 1),
+                nodes.done_at).astype(jnp.int32))
+
+        # init: every proposer starts at t == 0 (:381-387).
+        start = start | ((t == 0) & is_prop)
+        start = start & (p.value_accepted < 0)
+
+        # startNextProposal (:313-338).
+        seq_ip = self._next_seq(p, start)
+        zero = jnp.zeros((n,), jnp.int32)
+        p = p.replace(
+            seq_ip=seq_ip,
+            acc_seq_ip=jnp.where(start, -1, p.acc_seq_ip),
+            acc_val_ip=jnp.where(start, -1, p.acc_val_ip),
+            proposal_ip=p.proposal_ip | start,
+            agree_ip=jnp.where(start, zero, p.agree_ip),
+            rej1_ip=jnp.where(start, zero, p.rej1_ip),
+            accept_ip=jnp.where(start, zero, p.accept_ip),
+            rej2_ip=jnp.where(start, zero, p.rej2_ip),
+            timeout_at=jnp.where(start, t + 1 + self.timeout, p.timeout_at))
+
+        # Broadcast slots to the acceptors: Propose on start, Commit on
+        # agree-majority (sendToAcceptors, :299-303).
+        bcast = start | commit
+        acc_ids = jnp.arange(self.a, dtype=jnp.int32)[None, :]
+        b_dest = jnp.where(bcast[:, None],
+                           jnp.broadcast_to(acc_ids, (n, self.a)), -1)
+        b_typ = jnp.where(start, PROPOSE, COMMIT)
+        b_pay = jnp.stack(
+            [jnp.broadcast_to(b_typ[:, None], (n, self.a)),
+             jnp.broadcast_to(p.seq_ip[:, None], (n, self.a)),
+             jnp.broadcast_to(p.acc_val_ip[:, None], (n, self.a)),
+             jnp.zeros((n, self.a), jnp.int32)], axis=-1)
+
+        out = out.replace(dest=jnp.concatenate([r_dest, b_dest], axis=1),
+                          payload=jnp.concatenate([r_pay, b_pay], axis=1))
+        return p, nodes, out
+
+    def done(self, pstate, nodes):
+        return jnp.all(pstate.value_accepted[self.a:] >= 0)
+
+    def cont_if(self):
+        """Continue while any proposer has no accepted value."""
+        a = self.a
+        return lambda net, pstate: jnp.any(pstate.value_accepted[a:] < 0)
