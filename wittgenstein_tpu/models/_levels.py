@@ -1,0 +1,92 @@
+"""Shared binary-tree level machinery for the San Fermín-family aggregation
+protocols (Handel, GSFSignature, HandelEth2, ...).
+
+All of them use the same id-space geometry (reference allSigsAtLevel —
+Handel.java:667-680, GSFSignature.java:383-397): node i's level-l peer set is
+the sibling half of its 2^l-aligned block.  Those ranges are contiguous and
+disjoint across levels, so one [N, W] uint32 bitset row per node holds every
+level's state at once, and per-level cardinalities come from ONE
+popcount-per-level primitive (word population counts contracted against a
+word→level one-hot on the MXU, plus an in-register path for the sub-word
+levels 1..5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import bitset
+
+U32 = jnp.uint32
+
+
+def sibling_base(ids, half):
+    """Base of the level range with half-block size `half`: the other half
+    of the node's 2*half-aligned block.  half == 0 -> empty."""
+    mine = ids & ~(2 * half - 1)
+    return mine + jnp.where((ids & half) != 0, 0, half)
+
+
+class LevelMixin:
+    """Requires self.node_count, self.bits (log2 N), self.levels, self.w."""
+
+    def _word_onehot(self, ids):
+        """[N, W, L] float one-hot: which level each >=1-word-aligned word
+        of node i's row belongs to (word w != own word: level =
+        msb(word ^ own_word) + 6).  The own word (sub-word levels 0..5)
+        maps nowhere; `_level_pc` handles it separately."""
+        w, L = self.w, self.levels
+        hi = (ids >> 5)[:, None]
+        word = jnp.arange(w, dtype=jnp.int32)[None, :]
+        x = hi ^ word
+        lvl = jnp.where(x == 0, -1,
+                        31 - jax.lax.clz(jnp.maximum(x, 1)) + 6)
+        return (lvl[..., None] ==
+                jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+
+    def _subword_masks(self, ids):
+        """[N, L] uint32 in-word masks of the sub-word levels (1..5)."""
+        n, L = self.node_count, self.levels
+        masks = jnp.zeros((n, L), U32)
+        for l in range(1, min(6, L)):
+            half = 1 << (l - 1)
+            base = sibling_base(ids, half) & 31
+            masks = masks.at[:, l].set(
+                U32((1 << half) - 1) << base.astype(U32))
+        return masks
+
+    def _level_pc(self, rows, onehot, sub_masks, hi):
+        """Per-level popcounts.  rows [N, ..., W] -> [N, ..., L] int32."""
+        pc = jax.lax.population_count(rows).astype(jnp.float32)
+        extra = pc.ndim - 2
+        lhs = "n" + "abc"[:extra] + "w"
+        big = jnp.einsum(f"{lhs},nwl->n{'abc'[:extra]}l", pc, onehot)
+        own_word = jnp.take_along_axis(
+            rows, hi.reshape((-1,) + (1,) * (rows.ndim - 1)), axis=-1)[..., 0]
+        sm = sub_masks.reshape((sub_masks.shape[0],) + (1,) * extra +
+                               (sub_masks.shape[1],))
+        small = jax.lax.population_count(
+            own_word[..., None] & sm).astype(jnp.float32)
+        return (big + small).astype(jnp.int32)
+
+    def _range_mask_dyn(self, ids, level):
+        """[., W] level range mask where `level` is a traced array
+        broadcastable with ids."""
+        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 0)
+        base = sibling_base(ids, jnp.maximum(half, 1))
+        return bitset.range_mask(jnp.where(half > 0, base, 0), half, self.w)
+
+    def _block_mask_dyn(self, ids, k):
+        """[., W] mask of the 2^k-block containing each id (incl. own bit);
+        k is a traced array.  block_0 = the node's own bit."""
+        size = 1 << jnp.clip(k, 0, 30)
+        base = ids & ~jnp.maximum(size - 1, 0)
+        return bitset.range_mask(base, size, self.w)
+
+    def _sender_block_mask(self, src, level):
+        """[., W] mask of sender's outgoing set at `level`: the 2^(l-1)
+        block containing the sender (= the receiver's level range)."""
+        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 0)
+        base = src & ~jnp.maximum(half - 1, 0)
+        return bitset.range_mask(base, half, self.w)

@@ -63,6 +63,7 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
+from ._levels import LevelMixin, sibling_base
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
 TAG_BAD = 0x48424144      # bad-node choice
@@ -73,12 +74,7 @@ U32 = jnp.uint32
 BIG = jnp.int32(1 << 30)
 
 
-def _sibling_base(ids, half):
-    """Base of the level range with half-block size `half` (int or [.]
-    array): the other half of the node's 2*half-aligned block
-    (Handel.allSigsAtLevel, Handel.java:667-680).  half == 0 -> empty."""
-    mine = ids & ~(2 * half - 1)
-    return mine + jnp.where((ids & half) != 0, 0, half)
+_sibling_base = sibling_base  # shared geometry (_levels.sibling_base)
 
 
 def _get_bit_rows(bits, idx):
@@ -127,7 +123,7 @@ class HandelState:
 
 
 @register
-class Handel:
+class Handel(LevelMixin):
     """Parameters mirror Handel.HandelParameters (Handel.java:22-142)."""
 
     def __init__(self, node_count=2048, threshold=None, pairing_time=3,
@@ -185,61 +181,10 @@ class Handel:
 
     # ------------------------------------------------------------ primitives
 
-    def _word_onehot(self, ids):
-        """[N, W, L] float one-hot: which level each ≥1-word-aligned word of
-        node i's row belongs to (word w != own word: level =
-        msb(word ^ own_word) + 6).  The own word (sub-word levels 0..5) maps
-        nowhere; `_level_pc` handles it separately."""
-        n, w, L = self.node_count, self.w, self.levels
-        hi = (ids >> 5)[:, None]                              # [N, 1]
-        word = jnp.arange(w, dtype=jnp.int32)[None, :]
-        x = hi ^ word
-        lvl = jnp.where(x == 0, -1,
-                        31 - jax.lax.clz(jnp.maximum(x, 1)) + 6)
-        return (lvl[..., None] ==
-                jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
 
-    def _subword_masks(self, ids):
-        """[N, L] uint32 in-word masks of the sub-word levels (1..5): the
-        level range lives entirely inside the node's own 32-bit word."""
-        n, L = self.node_count, self.levels
-        masks = jnp.zeros((n, L), U32)
-        for l in range(1, min(6, L)):
-            half = 1 << (l - 1)
-            base = _sibling_base(ids, half) & 31
-            masks = masks.at[:, l].set(
-                U32((1 << half) - 1) << base.astype(U32))
-        return masks
 
-    def _level_pc(self, rows, onehot, sub_masks, hi):
-        """Per-level popcounts.  rows [N, ..., W] -> [N, ..., L] int32."""
-        pc = jax.lax.population_count(rows).astype(jnp.float32)
-        extra = pc.ndim - 2
-        lhs = "n" + "abc"[:extra] + "w"
-        big = jnp.einsum(f"{lhs},nwl->n{'abc'[:extra]}l", pc, onehot)
-        own_word = jnp.take_along_axis(
-            rows, hi.reshape((-1,) + (1,) * (rows.ndim - 1)), axis=-1)[..., 0]
-        # sub-word levels: broadcast masks over the middle dims.
-        sm = sub_masks.reshape((sub_masks.shape[0],) + (1,) * extra +
-                               (sub_masks.shape[1],))
-        small = jax.lax.population_count(
-            own_word[..., None] & sm).astype(jnp.float32)
-        return (big + small).astype(jnp.int32)
 
-    def _range_mask_dyn(self, ids, level):
-        """[., W] level range mask where `level` is a traced array
-        broadcastable with ids."""
-        half = jnp.where(level > 0,
-                         1 << jnp.clip(level - 1, 0, 30), 0)
-        base = _sibling_base(ids, jnp.maximum(half, 1))
-        return bitset.range_mask(jnp.where(half > 0, base, 0), half, self.w)
 
-    def _sender_block_mask(self, src, level):
-        """[., W] mask of sender's outgoing set at `level`: the 2^(l-1)
-        block containing the sender (= the receiver's level range)."""
-        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 0)
-        base = src & ~jnp.maximum(half - 1, 0)
-        return bitset.range_mask(base, half, self.w)
 
     def _rank(self, seed, i_ids, s_ids):
         """Reception rank node i assigns to sender s (the [N, N] shuffled
