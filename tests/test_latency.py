@@ -1,0 +1,113 @@
+"""Latency-model tests — the analogue of core NetworkLatencyTest.java:
+city matrix lookups, AWS values, throughput numbers, estimator round-trip."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core import builders, geo
+from wittgenstein_tpu.core.latency import (
+    AWS_RTT, AwsRegionNetworkLatency, MathisNetworkThroughput,
+    MeasuredNetworkLatency, NetworkLatencyByCity, NetworkLatencyByCityWJitter,
+    NetworkLatencyByDistanceWJitter, NetworkFixedLatency, estimate_latency,
+    full_latency, get_by_name)
+
+
+def city_nodes(n=64, seed=3):
+    return builders.NodeBuilder(location="cities").build(seed, n)
+
+
+def test_city_db_vendored():
+    db = geo.load()
+    assert db.n >= 200                       # pruned intersection, ~218
+    assert db.rtt.shape == (db.n, db.n)
+    assert np.all(np.diag(db.rtt) == 30.0)   # SAME_CITY_LATENCY
+    # A few sanity anchors: transatlantic >> intra-Europe.
+    ams, lon = db.index("Amsterdam"), db.index("London")
+    syd = db.index("Sydney")
+    assert db.rtt[ams, lon] < 30
+    assert db.rtt[ams, syd] > 200
+    assert np.all(db.population >= 200_000)  # reference's +200k floor
+
+
+def test_city_latency_model():
+    nodes = city_nodes()
+    m = NetworkLatencyByCity()
+    src = jnp.zeros(8, jnp.int32)
+    dst = jnp.arange(8, dtype=jnp.int32)
+    delta = jnp.zeros(8, jnp.int32)
+    lat = full_latency(m, nodes, src, dst, delta)
+    assert int(lat[0]) == 1                  # same node -> 1 ms
+    db = geo.load()
+    c = np.asarray(nodes.city)
+    for i in range(1, 8):
+        expect = max(1, round(0.5 * float(db.rtt[c[0], c[i]])))
+        assert int(lat[i]) == expect
+
+
+def test_city_latency_jitter_floor():
+    nodes = city_nodes()
+    m = NetworkLatencyByCityWJitter()
+    src = jnp.zeros(100, jnp.int32)
+    dst = jnp.full(100, 1, jnp.int32)
+    delta = jnp.arange(100, dtype=jnp.int32)
+    lat = full_latency(m, nodes, src, dst, delta)
+    assert np.all(np.asarray(lat) >= 1)
+    # Jitter grows with delta: the 99th percentile far exceeds the median.
+    assert int(lat[99]) > int(lat[50])
+
+
+def test_city_registry_lookup():
+    assert isinstance(get_by_name("NetworkLatencyByCity"),
+                      NetworkLatencyByCity)
+    assert isinstance(get_by_name("NetworkLatencyByCityWJitter"),
+                      NetworkLatencyByCityWJitter)
+
+
+def test_aws_matrix_values():
+    # AwsRegionNetworkLatency: Oregon<->Virginia RTT 81 (NetworkLatency
+    # .java:113), so one-way floor is 40 + jitter >= 0 rounded.
+    nodes = builders.NodeBuilder(location="aws").build(0, 32)
+    m = AwsRegionNetworkLatency()
+    assert int(AWS_RTT[0, 1]) == 81
+    c = np.asarray(nodes.city)
+    pair = [(i, j) for i in range(32) for j in range(32)
+            if c[i] == 0 and c[j] == 1]
+    if pair:
+        i, j = pair[0]
+        lat = full_latency(m, nodes, jnp.asarray([i]), jnp.asarray([j]),
+                           jnp.asarray([0]))
+        assert 38 <= int(lat[0]) <= 45
+
+
+def test_mathis_throughput():
+    # NetworkThroughputTest.java analogue: small messages take the latency;
+    # big messages add transfer time.
+    nodes = builders.NodeBuilder().build(0, 4)
+    base = NetworkFixedLatency(50)
+    tp = MathisNetworkThroughput(base)
+    src = jnp.asarray([0]); dst = jnp.asarray([1])
+    delta = jnp.asarray([0])
+    small = tp.delay(nodes, src, dst, delta, jnp.asarray([100]))
+    assert int(small[0]) == 50
+    big = tp.delay(nodes, src, dst, delta, jnp.asarray([10_000_000]))
+    assert int(big[0]) > 50
+    # Mathis bound: rate = MSS*8/(RTT*sqrt(loss)) ~= 1847 bits/ms at
+    # RTT=100 -> 8e7-bit transfer ~= 43.3 s + 50 ms.
+    assert 40_000 <= int(big[0]) <= 47_000
+
+
+def test_estimate_latency_roundtrip():
+    nodes = builders.NodeBuilder().build(7, 256)
+    m = NetworkLatencyByDistanceWJitter()
+    est = estimate_latency(m, nodes, rounds=20)
+    assert isinstance(est, MeasuredNetworkLatency)
+    tab = np.asarray(est.table)
+    assert tab.shape == (100,)
+    assert np.all(np.diff(tab) >= 0) and tab[0] >= 1
+    # The estimated distribution must straddle the true median scale.
+    direct = full_latency(
+        m, nodes, jnp.zeros(1000, jnp.int32) % 256,
+        jnp.arange(1000, dtype=jnp.int32) % 256,
+        jnp.arange(1000, dtype=jnp.int32) % 100)
+    med = float(np.median(np.asarray(direct)))
+    assert 0.3 * med <= float(tab[50]) <= 3 * med

@@ -1,0 +1,114 @@
+"""One-shot data-vendoring script: convert the reference's public measurement
+data (WonderNetwork city ping CSVs + world-cities geo CSV) into one compact
+`wittgenstein_tpu/data/citydata.npz` so the framework is standalone.
+
+Semantics replicated (not code):
+  - tools/CSVLatencyReader.java: per-city Ping.csv, column 4 = avg RTT ms;
+    city name matched by longest contained name ('+' means space); same-city
+    RTT = 30 ms; cities missing a measurement in BOTH directions vs any other
+    city are pruned from the matrix.
+  - geoinfo/GeoAllCities.java: cities.csv (name, lat, long, population);
+    population + 200000 offset; x = (long+180)*(W/360) then -45 (west half)
+    or -70 (east half); y = H/2 - lat*H/180 then -35 if y < 0.2*H.
+
+Run: python tools/vendor_city_data.py [reference_root]
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+
+import numpy as np
+
+MAX_X, MAX_Y = 2000, 1112
+SAME_CITY_RTT = 30.0
+
+REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+RES = os.path.join(REF, "core", "src", "main", "resources")
+OUT = os.path.join(os.path.dirname(__file__), "..", "wittgenstein_tpu",
+                   "data", "citydata.npz")
+
+
+def read_geo():
+    geo = {}
+    with open(os.path.join(RES, "cities.csv"), newline="",
+              encoding="utf-8") as f:
+        rd = csv.reader(f)
+        next(rd)  # header
+        for row in rd:
+            name = row[0].replace(" ", "+")
+            lat, lng, pop = float(row[1]), float(row[2]), int(row[3])
+            x = int((lng + 180) * (MAX_X / 360.0))
+            x += -45 if x < MAX_X / 2 else -70
+            y = int(round(MAX_Y / 2.0 - lat * MAX_Y / 180.0))
+            if y < 0.2 * MAX_Y:
+                y -= 35
+            geo[name] = (max(1, min(MAX_X, x)), max(1, min(MAX_Y, y)),
+                         pop + 200_000)
+    return geo
+
+
+def read_pings():
+    data_dir = os.path.join(RES, "Data")
+    cities = sorted(os.listdir(data_dir))
+    # Longest-contained-name matching, as the reference does.
+    by_space = [(c, c.replace("+", " ")) for c in cities]
+    lat = {c: {} for c in cities}
+    for c in cities:
+        path = os.path.join(data_dir, c, c + "Ping.csv")
+        with open(path, newline="", encoding="utf-8") as f:
+            rd = csv.reader(f)
+            next(rd)
+            for row in rd:
+                loc = row[0]
+                best = None
+                for name, spaced in by_space:
+                    if spaced in loc and (best is None or
+                                          len(name) > len(best)):
+                        best = name
+                if best is not None:
+                    lat[c][best] = float(row[4])
+        lat[c][c] = SAME_CITY_RTT
+    # Prune cities with measurements missing in both directions.
+    while True:
+        bad = {a for a in lat
+               for b in lat if b not in lat[a] and a not in lat[b]}
+        if not bad:
+            break
+        for b in bad:
+            del lat[b]
+    kept = sorted(lat)
+    n = len(kept)
+    m = np.zeros((n, n), np.float32)
+    for i, a in enumerate(kept):
+        for j, b in enumerate(kept):
+            v = lat[a].get(b)
+            if v is None:
+                v = lat[b][a]
+            m[i, j] = v
+    return kept, m
+
+
+def main():
+    geo = read_geo()
+    kept, rtt = read_pings()
+    # The canonical city set: latency-complete AND geo-known (the reference's
+    # NodeBuilderWithCity intersects CSVLatencyReader.cities() with the geo
+    # map the same way).
+    idx = [i for i, c in enumerate(kept) if c in geo]
+    names = [kept[i] for i in idx]
+    rtt = rtt[np.ix_(idx, idx)]
+    x = np.array([geo[c][0] for c in names], np.int32)
+    y = np.array([geo[c][1] for c in names], np.int32)
+    pop = np.array([geo[c][2] for c in names], np.int64)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    np.savez_compressed(OUT, names=np.array(names), x=x, y=y, population=pop,
+                        rtt=rtt)
+    print(f"wrote {OUT}: {len(names)} cities, rtt {rtt.shape}, "
+          f"range [{rtt.min():.1f}, {rtt.max():.1f}] ms")
+
+
+if __name__ == "__main__":
+    main()

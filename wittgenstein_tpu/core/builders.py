@@ -9,16 +9,14 @@ construction is deterministic per seed and vmappable over seeds.
 
 from __future__ import annotations
 
-import csv
 import dataclasses
-import math
-import os
 from functools import lru_cache
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import prng
+from . import geo
 from .latency import AWS_REGIONS
 from .state import MAX_X, MAX_Y, NodeState, default_nodes
 
@@ -29,38 +27,14 @@ AWS_CITY_X = np.array([271, 513, 1344, 1641, 1507, 1773, 1708, 422, 985, 891,
 AWS_CITY_Y = np.array([261, 316, 426, 312, 532, 777, 316, 256, 226, 200, 205],
                       np.int32)
 
-# Optional real-city database (241 cities with lat/long/population,
-# core/src/main/resources/cities.csv in the reference).  We read it at
-# runtime when available; otherwise city-based builders fall back to the AWS
-# city set so everything still runs hermetically.
-CITIES_CSV = os.environ.get(
-    "WITTGENSTEIN_CITIES_CSV",
-    "/root/reference/core/src/main/resources/cities.csv")
 
-
-@lru_cache(maxsize=1)
 def load_city_db():
-    """Returns (names, x, y, population) with Mercator projection onto the
-    2000x1112 map (geoinfo/GeoAllCities.java:16-75)."""
-    if not os.path.exists(CITIES_CSV):
-        pop = np.ones(len(AWS_REGIONS), np.float64)
-        return list(AWS_REGIONS), AWS_CITY_X.copy(), AWS_CITY_Y.copy(), pop
-    names, xs, ys, pops = [], [], [], []
-    with open(CITIES_CSV, newline="", encoding="utf-8") as f:
-        for row in csv.reader(f):
-            try:
-                lat, lng, pop = float(row[2]), float(row[3]), float(row[4])
-            except (ValueError, IndexError):
-                continue
-            x = int((lng + 180.0) * (MAX_X / 360.0))
-            merc_n = math.log(math.tan(math.pi / 4 + math.radians(lat) / 2))
-            y = int(MAX_Y / 2 - MAX_X * merc_n / (2 * math.pi))
-            names.append(row[0])
-            xs.append(min(MAX_X, max(1, x)))
-            ys.append(min(MAX_Y, max(1, y)))
-            pops.append(max(pop, 1.0))
-    return (names, np.asarray(xs, np.int32), np.asarray(ys, np.int32),
-            np.asarray(pops, np.float64))
+    """Returns (names, x, y, population) from the vendored city database
+    (see core/geo.py; the reference reads cities.csv via
+    geoinfo/GeoAllCities.java:16-75)."""
+    db = geo.load()
+    return (list(db.names), db.x.astype(np.int32), db.y.astype(np.int32),
+            db.population.astype(np.float64))
 
 
 @dataclasses.dataclass(frozen=True)

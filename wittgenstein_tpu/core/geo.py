@@ -1,0 +1,48 @@
+"""The vendored city database: geo positions + WonderNetwork RTT matrix.
+
+This is the standalone analogue of the reference's resource data
+(core/src/main/resources/cities.csv read by geoinfo/GeoAllCities.java:16-75,
+and resources/Data/<City>/<City>Ping.csv read by
+tools/CSVLatencyReader.java:288-339).  `tools/vendor_city_data.py` converted
+those public measurement CSVs into one compressed npz at build time; at
+runtime everything loads from the package, no external paths.
+
+The canonical city index space (used by NodeState.city for 'cities'-located
+nodes and by NetworkLatencyByCity*) is the pruned intersection: cities with
+complete latency measurements AND known geo positions, sorted by name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import lru_cache
+
+import numpy as np
+
+_NPZ = os.path.join(os.path.dirname(os.path.dirname(__file__)), "data",
+                    "citydata.npz")
+
+
+@dataclasses.dataclass(frozen=True)
+class CityDB:
+    names: tuple            # city names, '+' for spaces (reference dir names)
+    x: np.ndarray           # int32 [C] map positions (2000x1112)
+    y: np.ndarray           # int32 [C]
+    population: np.ndarray  # int64 [C] (includes the reference's +200k floor)
+    rtt: np.ndarray         # float32 [C, C] avg round-trip ms; diagonal 30
+
+    @property
+    def n(self):
+        return len(self.names)
+
+    def index(self, name: str) -> int:
+        return self.names.index(name)
+
+
+@lru_cache(maxsize=1)
+def load() -> CityDB:
+    with np.load(_NPZ) as z:
+        return CityDB(names=tuple(str(s) for s in z["names"]),
+                      x=z["x"], y=z["y"], population=z["population"],
+                      rtt=z["rtt"])

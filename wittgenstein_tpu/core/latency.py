@@ -195,6 +195,50 @@ class EthScanNetworkLatency(MeasuredNetworkLatency):
         super().__init__(ETHSCAN_PROP, ETHSCAN_VAL, name="EthScanNetworkLatency")
 
 
+class NetworkLatencyByCity:
+    """WonderNetwork measured city-to-city RTT halved; same node 1 ms
+    (NetworkLatency.java:159-194).  Node ``city`` indexes the vendored city
+    database (core/geo.py) — the pruned CSVLatencyReader matrix."""
+
+    name = "NetworkLatencyByCity"
+
+    def __init__(self):
+        from . import geo
+        self.rtt = jnp.asarray(geo.load().rtt)
+
+    def validate(self, nodes):
+        import numpy as np
+        if np.any(np.asarray(nodes.city) < 0):
+            raise ValueError(
+                "NetworkLatencyByCity needs city-positioned nodes "
+                "(NodeBuilder(location='cities')); the reference throws "
+                "IllegalStateException for DEFAULT_CITY nodes "
+                "(NetworkLatency.java:175-178)")
+
+    def extended(self, nodes, src, dst, delta):
+        half = 0.5 * self.rtt[nodes.city[src], nodes.city[dst]]
+        return jnp.maximum(1, jnp.round(half)).astype(jnp.int32)
+
+    def __repr__(self):
+        return self.name
+
+
+class NetworkLatencyByCityWJitter(NetworkLatencyByCity):
+    """City matrix + generalized-Pareto jitter; 10 ms intra-city RTT
+    (NetworkLatency.java:200-233)."""
+
+    name = "NetworkLatencyByCityWJitter"
+
+    def extended(self, nodes, src, dst, delta):
+        c1, c2 = nodes.city[src], nodes.city[dst]
+        raw = gpd_inverse(delta.astype(jnp.float32) / 100.0)
+        raw = raw + jnp.where(c1 == c2, 10.0, self.rtt[c1, c2])
+        return jnp.maximum(1, jnp.round(0.5 * raw)).astype(jnp.int32)
+
+    def __repr__(self):
+        return self.name
+
+
 class IC3NetworkLatency:
     """IC3 paper percentile table keyed by covered-area ratio
     (NetworkLatency.java:399-417)."""
@@ -247,3 +291,59 @@ def full_latency(model, nodes, src, dst, delta):
     base = nodes.extra_latency[src] + nodes.extra_latency[dst]
     lat = jnp.maximum(1, base + model.extended(nodes, src, dst, delta))
     return jnp.where(src == dst, jnp.ones_like(lat), lat)
+
+
+class MathisNetworkThroughput:
+    """Size-dependent delay from the TCP Mathis equation
+    (core/NetworkThroughput.java:14-57): one-way latency from the wrapped
+    model, plus transfer time at min(MSS*8/(RTT*sqrt(loss)), window/RTT)
+    for messages larger than one segment."""
+
+    MSS = 1460
+    LOSS = 0.004
+
+    def __init__(self, latency_model, window_bytes=87380 * 1024):
+        self.latency_model = latency_model
+        self.window_bits = 8 * window_bytes
+        self.name = f"MathisNetworkThroughput({latency_model!r})"
+
+    def delay(self, nodes, src, dst, delta, msg_size):
+        st = full_latency(self.latency_model, nodes, src, dst,
+                          delta).astype(jnp.float32)
+        rtt = st * 2.0
+        bandwidth = (self.MSS * 8) / (rtt * np.sqrt(self.LOSS))
+        w_max = self.window_bits / rtt
+        av = jnp.minimum(bandwidth, w_max)
+        slow = (8.0 * msg_size) / av + st
+        return jnp.where(msg_size < self.MSS, st,
+                         slow.astype(jnp.int32).astype(jnp.float32)
+                         ).astype(jnp.int32)
+
+    def __repr__(self):
+        return self.name
+
+
+def estimate_latency(model, nodes, rounds=100, seed=0):
+    """Monte-Carlo sample a latency model into a MeasuredNetworkLatency
+    (NetworkLatency.estimateLatency, NetworkLatency.java:432-474): draw
+    src/dst pairs across the node set, bucket the observed latencies into a
+    100-quantile table."""
+    import numpy as np_
+    from ..ops import prng
+    n = int(nodes.x.shape[0])
+    ids = jnp.arange(rounds * n, dtype=jnp.int32)
+    s = prng.hash2(jnp.asarray(seed, jnp.int32), jnp.int32(0x4C455354))
+    src = prng.uniform_int(prng.hash2(s, 1), ids, n)
+    dst = prng.uniform_int(prng.hash2(s, 2), ids, n)
+    delta = prng.uniform_delta(prng.hash2(s, 3), ids)
+    keep = src != dst
+    lat = np_.asarray(full_latency(model, nodes, src, dst, delta))[
+        np_.asarray(keep)]
+    lat = np_.sort(lat)
+    qs = np_.quantile(lat, (np_.arange(100) + 1) / 100.0,
+                      method="lower").astype(np_.int32)
+    qs = np_.maximum.accumulate(np_.maximum(qs, 1))
+    table = MeasuredNetworkLatency.__new__(MeasuredNetworkLatency)
+    table.table = jnp.asarray(qs)
+    table.name = f"MeasuredNetworkLatency(estimate of {model!r})"
+    return table
