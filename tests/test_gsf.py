@@ -1,0 +1,80 @@
+"""GSFSignature tests — the analogue of GSFSignatureTest.java: init
+invariants, run-to-done, copy/seed determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.harness import run_multiple_times
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.gsf import GSFSignature, cont_if_gsf
+from wittgenstein_tpu.ops import bitset
+
+
+def test_init_invariants():
+    # GSFSignatureTest.java:22-42: after init every node has exactly its own
+    # signature verified, and level geometry covers the id space.
+    p = GSFSignature(node_count=64, threshold=50, nodes_down=0,
+                     network_latency_name="NetworkLatencyByDistanceWJitter")
+    net, ps = p.init(0)
+    card = np.asarray(bitset.popcount(ps.verified))
+    assert np.all(card == 1)
+    for i in (0, 17, 63):
+        assert bool(bitset.get_bit(ps.verified[i][None, :],
+                                   jnp.asarray([i]))[0])
+    # remainingCalls per level == the level size (peers.size()).
+    rem = np.asarray(ps.remaining)
+    assert rem.shape == (64, 7)
+    assert list(rem[0]) == [0, 1, 2, 4, 8, 16, 32]
+
+
+def test_peer_order_is_permutation():
+    p = GSFSignature(node_count=64)
+    net, ps = p.init(3)
+    ids = jnp.zeros((16,), jnp.int32) + 5
+    lvl = jnp.full((16,), 5, jnp.int32)   # half = 16
+    pos = jnp.arange(16, dtype=jnp.int32)
+    peers = np.asarray(p._peer_at(ps.seed, ids, lvl, pos))
+    # Node 5 at level 5: its 32-block is [0, 32), it sits in the lower
+    # half, so the sibling half is [16, 32).
+    assert sorted(peers) == list(range(16, 32))
+
+
+def test_run_to_done_and_determinism():
+    p = GSFSignature(node_count=128, threshold=115, pairing_time=3,
+                     period_duration_ms=10, accelerated_calls_count=10,
+                     nodes_down=12,
+                     network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    net2, ps2 = p.init(0)
+    for _ in range(8):
+        net, ps = r.run_ms(net, ps, 250)
+        if bool(p.done(ps, net.nodes)):
+            break
+    assert bool(p.done(ps, net.nodes)), "live nodes must all reach threshold"
+    assert int(net.dropped) == 0 and int(net.clamped) == 0
+    live = ~np.asarray(net.nodes.down)
+    done_at = np.asarray(net.nodes.done_at)
+    assert np.all(done_at[live] > 0)
+    card = np.asarray(bitset.popcount(ps.verified))
+    assert np.all(card[live] >= 115)
+
+    # Determinism (GSFSignatureTest.java:127+ testCopy analogue): re-init
+    # same seed, re-run, states identical.
+    for _ in range(2):
+        net2, ps2 = r.run_ms(net2, ps2, 250)
+    net3, ps3 = p.init(0)
+    for _ in range(2):
+        net3, ps3 = r.run_ms(net3, ps3, 250)
+    assert np.array_equal(np.asarray(ps2.verified), np.asarray(ps3.verified))
+    assert np.array_equal(np.asarray(net2.nodes.done_at),
+                          np.asarray(net3.nodes.done_at))
+
+
+def test_harness_multirun():
+    p = GSFSignature(node_count=64, threshold=58, nodes_down=4,
+                     network_latency_name="NetworkNoLatency")
+    res = run_multiple_times(p, run_count=2, max_time=3000, chunk=250,
+                             cont_if=cont_if_gsf)
+    assert np.all(np.asarray(res.stopped_at) > 0)

@@ -273,11 +273,19 @@ class Runner:
 
     The analogue of Network.runMs (Network.java:318-338) — but a whole chunk
     of milliseconds is a single device program.
+
+    donate="auto" disables buffer donation on TPU: the current (experimental)
+    TPU plugin fails at runtime (INVALID_ARGUMENT) when the full simulator
+    pytree is donated for the larger protocol states, and the failure
+    poisons the process.  Donation is a memory optimisation only; re-enable
+    explicitly once the backend handles it (CPU ignores donation anyway).
     """
 
-    def __init__(self, protocol, donate=True):
+    def __init__(self, protocol, donate="auto"):
         self.protocol = protocol
         self._jits = {}
+        if donate == "auto":
+            donate = jax.default_backend() != "tpu"
         self._donate = donate
         self._validated = False
 

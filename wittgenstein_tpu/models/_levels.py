@@ -17,8 +17,21 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import bitset
+from ..ops.flat import gather2d
 
 U32 = jnp.uint32
+
+
+def get_bit_rows(bits, idx):
+    """get_bit for [N, W] bitsets row-indexed by [N, ...] id arrays.
+
+    Flat 1-D gather — broadcasting bits to [N, S, W] for take_along_axis
+    materializes the broadcast and serializes on TPU."""
+    n = bits.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32).reshape(
+        (n,) + (1,) * (idx.ndim - 1))
+    word = gather2d(bits, rows, idx // 32)
+    return ((word >> (idx % 32).astype(U32)) & U32(1)) != 0
 
 
 def sibling_base(ids, half):

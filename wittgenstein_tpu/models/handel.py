@@ -63,7 +63,7 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
-from ._levels import LevelMixin, sibling_base
+from ._levels import LevelMixin, get_bit_rows as _get_bit_rows, sibling_base
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
 TAG_BAD = 0x48424144      # bad-node choice
@@ -75,18 +75,6 @@ BIG = jnp.int32(1 << 30)
 
 
 _sibling_base = sibling_base  # shared geometry (_levels.sibling_base)
-
-
-def _get_bit_rows(bits, idx):
-    """get_bit for [N, W] bitsets row-indexed by [N, ...] id arrays.
-
-    Flat 1-D gather — broadcasting bits to [N, S, W] for take_along_axis
-    materializes the broadcast and serializes on TPU."""
-    n = bits.shape[0]
-    rows = jnp.arange(n, dtype=jnp.int32).reshape(
-        (n,) + (1,) * (idx.ndim - 1))
-    word = gather2d(bits, rows, idx // 32)
-    return ((word >> (idx % 32).astype(U32)) & U32(1)) != 0
 
 
 @struct.dataclass
@@ -230,12 +218,17 @@ class Handel(LevelMixin):
             emission = emission.at[:, half:2 * half].set(
                 jnp.take_along_axis(recv, order, axis=1))
 
-        zero_bits = jnp.zeros((n, w), U32)
+        def zero_bits():
+            # Fresh buffer per field: under donation the same buffer must
+            # not appear twice in an executable's arguments.
+            return jnp.zeros((n, w), U32)
+
         net = init_net(self.cfg, nodes, seed)
         pstate = HandelState(
             seed=seed, start_at=start_at, pairing=pairing,
-            ver_ind=bitset.one_bit(ids, w), last_agg=zero_bits,
-            finished_peers=zero_bits, blacklist=zero_bits, demoted=zero_bits,
+            ver_ind=bitset.one_bit(ids, w), last_agg=zero_bits(),
+            finished_peers=zero_bits(), blacklist=zero_bits(),
+            demoted=zero_bits(),
             q_from=jnp.full((n, Q), -1, jnp.int32),
             q_lvl=jnp.zeros((n, Q), jnp.int32),
             q_rank=jnp.zeros((n, Q), jnp.int32),

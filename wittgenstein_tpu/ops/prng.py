@@ -94,16 +94,28 @@ def bij_perm(key, x, bits: int):
     (SURVEY.md §7.4.6): rank(i, s) = bij_perm(hash(seed, i), s, log2 N).
     """
     assert 1 <= bits <= 31
-    mask = _U32((1 << bits) - 1)
+    # Same construction as bij_perm_dyn (one shared definition keeps the two
+    # in bit-exact agreement); with static bits XLA folds the mask/shifts.
+    return bij_perm_dyn(key, x, bits)
+
+
+def bij_perm_dyn(key, x, bits):
+    """`bij_perm` with a *traced* per-element bit count: each element is
+    permuted within its own [0, 2^bits) domain (bits >= 0; bits == 0 maps
+    everything to 0).  Same construction — every step (masked xor, odd
+    multiply, xorshift-right) is bijective on the masked domain for any
+    shift >= 1."""
+    bits = jnp.asarray(bits, jnp.int32)
+    mask = ((_U32(1) << jnp.clip(bits, 0, 31).astype(_U32)) - _U32(1))
     x = jnp.asarray(x).astype(_U32) & mask
     key = jnp.asarray(key).astype(_U32)
-    s1 = max(1, (bits + 1) // 2)
-    s2 = max(1, (2 * bits) // 3)
+    s1 = jnp.maximum(1, (bits + 1) // 2).astype(_U32)
+    s2 = jnp.maximum(1, (2 * bits) // 3).astype(_U32)
     for c in (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35):
         k = mix32(key ^ _U32(c))
         x = (x ^ (k & mask)) & mask
-        x = (x * (k | _U32(1))) & mask          # odd multiplier: bijective
-        x = x ^ (x >> _U32(s1))                 # xorshift: bijective
+        x = (x * (k | _U32(1))) & mask
+        x = x ^ (x >> s1)
         x = (x * _U32(0x6A09E667 | 1)) & mask
-        x = x ^ (x >> _U32(s2))
+        x = x ^ (x >> s2)
     return (x & mask).astype(jnp.int32)
