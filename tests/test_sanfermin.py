@@ -1,0 +1,79 @@
+"""San Fermín tests — geometry unit tests (SanFerminHelper analogue) +
+run-to-done + determinism for both variants."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.sanfermin import (
+    SanFermin, SanFerminCappos, _cand_base, _half, _own_base, _pick_offset)
+
+
+def test_geometry():
+    # 16 nodes, bits = 4.  Node 5 = 0101.
+    bits = 4
+    ids = jnp.asarray([5])
+    # cpl = 3: half = 1, buddy differs in last bit -> candidate base 4.
+    h3 = _half(bits, jnp.asarray([3]))
+    assert int(h3[0]) == 1
+    assert int(_cand_base(ids, h3)[0]) == 4
+    # cpl = 2: half = 2, own block [4,6) -> sibling [6,8).
+    h2 = _half(bits, jnp.asarray([2]))
+    assert int(h2[0]) == 2
+    assert int(_own_base(ids, h2)[0]) == 4
+    assert int(_cand_base(ids, h2)[0]) == 6
+    # cpl = 0: half = 8, sibling is the other half of the network.
+    h0 = _half(bits, jnp.asarray([0]))
+    assert int(_cand_base(ids, h0)[0]) == 8
+
+
+def test_pick_order():
+    # partner offset first, then remaining offsets in index order
+    # (SanFerminHelper.pickNextNodes).
+    po = jnp.asarray([2])
+    picks = [int(_pick_offset(jnp.asarray([j]), po)[0]) for j in range(4)]
+    assert picks == [2, 0, 1, 3]
+
+
+def test_sanfermin_run_and_determinism():
+    p = SanFermin(node_count=128, threshold=128, pairing_time=2,
+                  reply_timeout=300, candidate_count=1,
+                  network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(12):
+        net, ps = r.run_ms(net, ps, 250)
+        if bool(jnp.all(ps.done)):
+            break
+    assert bool(jnp.all(ps.done)), "all nodes finish level 0"
+    assert int(net.dropped) == 0 and int(net.clamped) == 0
+    agg = np.asarray(ps.agg)
+    # Every node aggregated the full network (no failures configured).
+    assert np.all(agg == 128)
+    done_at = np.asarray(net.nodes.done_at)
+    assert np.all(done_at > 0)
+
+    # Determinism.
+    net2, ps2 = p.init(0)
+    for _ in range(12):
+        net2, ps2 = r.run_ms(net2, ps2, 250)
+        if bool(jnp.all(ps2.done)):
+            break
+    assert np.array_equal(np.asarray(net2.nodes.done_at), done_at)
+
+
+def test_cappos_run():
+    p = SanFerminCappos(node_count=64, threshold=48, pairing_time=2,
+                        timeout=150, candidate_count=4,
+                        network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(12):
+        net, ps = r.run_ms(net, ps, 250)
+        if bool(jnp.all(ps.done)):
+            break
+    assert bool(jnp.all(ps.done))
+    assert int(net.dropped) == 0
+    # Threshold tracking fired for everyone (64-node full run covers 48).
+    assert np.all(np.asarray(ps.threshold_at) > 0)
+    assert np.all(np.asarray(net.nodes.done_at) > 0)

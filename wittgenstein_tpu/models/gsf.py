@@ -239,9 +239,13 @@ class GSFSignature(LevelMixin):
             same = (q_from == srcs[:, None]) & (q_lvl == lvls[:, None]) & \
                 ~q_indiv
             free = q_from < 0
-            worst = jnp.argmax(jnp.where(free, -1, q_lvl), axis=1)
-            worst_lvl = jnp.take_along_axis(q_lvl, worst[:, None],
-                                            axis=1)[:, 0]
+            # Individual entries are never evicted: their got_indiv dedup
+            # bit stays set, so an evicted one would be lost forever.
+            evictable = ~free & ~q_indiv
+            worst = jnp.argmax(jnp.where(evictable, q_lvl, -1), axis=1)
+            worst_lvl = jnp.take_along_axis(
+                jnp.where(evictable, q_lvl, -1), worst[:, None],
+                axis=1)[:, 0]
             any_same = jnp.any(same, axis=1)
             any_free = jnp.any(free, axis=1)
             slot = jnp.where(any_same, jnp.argmax(same, axis=1),
@@ -251,7 +255,7 @@ class GSFSignature(LevelMixin):
             # scoring favors early levels, so replacing a low-level entry
             # with a high-level one would discard pending useful work.
             evict = oks & ~any_same & ~any_free
-            ins = oks & (~evict | (lvls < worst_lvl))
+            ins = oks & (~evict | ((worst_lvl >= 0) & (lvls < worst_lvl)))
             evicted = evicted + jnp.sum(evict & ins).astype(jnp.int32)
             q_from = set2d(q_from, ids, slot, srcs, ok=ins)
             q_lvl = set2d(q_lvl, ids, slot, lvls, ok=ins)

@@ -1,0 +1,562 @@
+"""San Fermín signature aggregation — pairwise binomial swaps.
+
+Two reference protocols share the geometry (SURVEY.md §2.4):
+
+* `SanFermin` — protocols/SanFerminSignature.java (619 lines).  Each node
+  walks prefix levels from log2(N)-1 down to 0; at each level it swaps its
+  aggregate with its mirror node in the sibling block (SwapRequest /
+  SwapReply), retrying other candidates on timeout; optimistic replies are
+  served from a per-level signature cache (:229-270); a verification
+  (pairingTime) gates every aggregation (transition, :519-540);
+  doneAt = time + 2*pairingTime once level 0 completes (:379-419).
+* `SanFerminCappos` — protocols/SanFerminCappos.java (523 lines).  Variant
+  with one `Swap(level, value, wantReply)` message, a per-level cache of
+  *best received values* whose total (1 + sum of per-level maxima at or
+  above the current level, totalNumberOfSigs :352-360) drives a threshold,
+  `candidateCount`~50 batch fan-out, and cached levels skipped on entry
+  (goNextLevel :307-345).
+
+Geometry (SanFerminHelper.java:46-100, power-of-two N): at prefix length
+cpl, half = 2^(log2(N)-cpl-1); own set = the node's `half`-block; candidate
+set = the sibling `half`-block; the deterministic first pick is the mirror
+node (same offset in the sibling block, getExactCandidateNode :104-116);
+later picks walk the remaining candidates in order (pickNextNodes
+:123-158).  All of it is index arithmetic — nothing stored.
+
+TPU-native simplifications (statistical equivalence, SURVEY §7.4.3):
+* one outstanding timeout per node (the reference chains one task per send);
+* at most one candidate batch triggered per node per ms (multiple same-ms
+  NO-replies coalesce);
+* replies are capped at `reply_cap` per node per ms — an over-capacity
+  requester just retries on its timeout.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import prng
+from ..ops.flat import gather2d, set2d
+
+TAG_PICK = 0x53465049
+
+REQ, OK, NO = 0, 1, 2          # SanFermin message kinds
+SWAP_ASK, SWAP_INFO = 0, 1     # Cappos: wantReply true / false
+
+
+def _half(bits, cpl):
+    """Block size at prefix length cpl: 2^(bits - cpl - 1)."""
+    return jnp.int32(1) << jnp.clip(bits - cpl - 1, 0, 30)
+
+
+def _own_base(ids, half):
+    return ids & ~(half - 1)
+
+
+def _cand_base(ids, half):
+    """Base of the sibling half-block (the candidate set)."""
+    return _own_base(ids, half) ^ half
+
+
+def _pick_offset(j, partner_off):
+    """The j-th pick in a level's candidate order: mirror node first, then
+    the remaining offsets in index order (pickNextNodes,
+    SanFerminHelper.java:123-158)."""
+    rest = jnp.where(j - 1 < partner_off, j - 1, j)
+    return jnp.where(j == 0, partner_off, rest)
+
+
+def _expected(off, partner_off, used):
+    """Was candidate-offset `off` among our first `used` picks?"""
+    rank = 1 + off - (off > partner_off)
+    return (off == partner_off) | (rank < used)
+
+
+class _SanFerminBase:
+    """Shared scaffolding: parameters, node building, level geometry."""
+
+    def _setup(self, node_count, pairing_time, signature_size,
+               candidate_count, reply_cap, inbox_cap, horizon,
+               node_builder_name, network_latency_name):
+        if node_count & (node_count - 1):
+            raise ValueError("power-of-two node counts only")
+        self.node_count = node_count
+        self.pairing_time = pairing_time
+        self.signature_size = signature_size
+        self.candidate_count = candidate_count
+        self.reply_cap = reply_cap
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        self.bits = int(math.log2(node_count))
+        self.levels = self.bits + 1          # cpl values 0..bits
+        self.cfg = EngineConfig(
+            n=node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=3, out_deg=candidate_count + reply_cap,
+            bcast_slots=1)
+
+    def _partner_off(self, ids, cpl):
+        half = _half(self.bits, cpl)
+        return ids & (half - 1)
+
+    def _pick_batch(self, ids, cpl, used, count):
+        """Candidate ids for picks used..used+count-1 at level cpl; -1 where
+        the candidate set is exhausted.  Returns (dest [N, count], n_taken)."""
+        half = _half(self.bits, cpl)                        # [N]
+        base = _cand_base(ids, half)
+        partner = self._partner_off(ids, cpl)
+        j = used[:, None] + jnp.arange(count, dtype=jnp.int32)[None, :]
+        off = _pick_offset(j, partner[:, None])
+        ok = j < half[:, None]
+        dest = jnp.where(ok, base[:, None] + off, -1)
+        return dest, jnp.sum(ok, axis=1).astype(jnp.int32)
+
+
+@struct.dataclass
+class SanFerminState:
+    seed: jnp.ndarray
+    cpl: jnp.ndarray           # int32 [N] currentPrefixLength
+    agg: jnp.ndarray           # int32 [N] aggValue
+    cache: jnp.ndarray         # int32 [N, L] signatureCache (0 = none)
+    used: jnp.ndarray          # int32 [N] picks consumed at current level
+    swapping: jnp.ndarray      # bool [N]
+    pend_val: jnp.ndarray      # int32 [N] value being "verified"
+    pend_at: jnp.ndarray       # int32 [N]
+    pend_on: jnp.ndarray       # bool [N]
+    timeout_at: jnp.ndarray    # int32 [N] (0 = none)
+    timeout_lvl: jnp.ndarray   # int32 [N]
+    threshold_at: jnp.ndarray  # int32 [N]
+    done: jnp.ndarray          # bool [N]
+    sent_requests: jnp.ndarray    # int32 [N]
+    received_requests: jnp.ndarray  # int32 [N]
+
+
+@register
+class SanFermin(_SanFerminBase):
+    """protocols/SanFerminSignature.java; parameters mirror
+    SanFerminSignatureParameters (:42-111)."""
+
+    def __init__(self, node_count=1024, threshold=None, pairing_time=2,
+                 signature_size=48, reply_timeout=300, candidate_count=1,
+                 node_builder_name=None, network_latency_name=None,
+                 reply_cap=4, inbox_cap=16, horizon=512):
+        self.threshold = node_count if threshold is None else threshold
+        self.reply_timeout = reply_timeout
+        self._setup(node_count, pairing_time, signature_size,
+                    candidate_count, reply_cap, inbox_cap, horizon,
+                    node_builder_name, network_latency_name)
+
+    def init(self, seed):
+        n, L = self.node_count, self.levels
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        net = init_net(self.cfg, nodes, seed)
+
+        def zi():
+            return jnp.zeros((n,), jnp.int32)
+
+        pstate = SanFerminState(
+            seed=seed,
+            cpl=jnp.full((n,), self.bits, jnp.int32),
+            agg=jnp.ones((n,), jnp.int32),
+            cache=jnp.zeros((n, L), jnp.int32),
+            used=zi(), swapping=jnp.zeros((n,), bool),
+            pend_val=zi(), pend_at=zi(),
+            pend_on=jnp.zeros((n,), bool),
+            timeout_at=zi(), timeout_lvl=zi(),
+            threshold_at=zi(),
+            done=jnp.zeros((n,), bool),
+            sent_requests=zi(), received_requests=zi(),
+        )
+        return net, pstate
+
+    # ------------------------------------------------------------------
+
+    def _enter_level(self, p, nodes, go, t):
+        """goNextLevel (SanFerminSignature.java:379-419): threshold / done
+        checks, cpl decrement, cache own agg, request-batch trigger."""
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        hit = go & ~(p.threshold_at > 0) & (p.agg >= self.threshold)
+        threshold_at = jnp.where(hit, t + 2 * self.pairing_time,
+                                 p.threshold_at)
+        finish = go & (p.cpl == 0) & ~p.done
+        done = p.done | finish
+        done_at = jnp.where(finish & (nodes.done_at == 0),
+                            jnp.maximum(1, t + 2 * self.pairing_time),
+                            nodes.done_at)
+        nodes = nodes.replace(done_at=done_at.astype(jnp.int32))
+
+        desc = go & ~finish & ~p.done
+        cpl = jnp.where(desc, p.cpl - 1, p.cpl)
+        cache = set2d(p.cache, ids, jnp.maximum(cpl, 0), p.agg, ok=desc)
+        p = p.replace(cpl=cpl, cache=cache, swapping=p.swapping & ~desc,
+                      used=jnp.where(desc, 0, p.used), done=done,
+                      threshold_at=threshold_at)
+        return p, nodes, desc        # desc nodes send a fresh batch
+
+    def step(self, p: SanFerminState, nodes, inbox, t, key):
+        n, L = self.node_count, self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+        alive = ~nodes.down
+
+        # Reply buffer for this step.
+        rc = self.reply_cap
+        r_dest = jnp.full((n, rc), -1, jnp.int32)
+        r_kind = jnp.zeros((n, rc), jnp.int32)
+        r_lvl = jnp.zeros((n, rc), jnp.int32)
+        r_val = jnp.zeros((n, rc), jnp.int32)
+        r_cnt = jnp.zeros((n,), jnp.int32)
+        want_batch = jnp.zeros((n,), bool)
+
+        def push_reply(bufs, cnt, to, kind, lvl, val, ok):
+            d, k, l, v = bufs
+            ok = ok & (cnt < rc)
+            slot = jnp.minimum(cnt, rc - 1)
+            d = set2d(d, ids, slot, to, ok=ok)
+            k = set2d(k, ids, slot, kind, ok=ok)
+            l = set2d(l, ids, slot, lvl, ok=ok)
+            v = set2d(v, ids, slot, val, ok=ok)
+            return (d, k, l, v), cnt + ok.astype(jnp.int32)
+
+        swapping, cache = p.swapping, p.cache
+        pend_val, pend_at, pend_on = p.pend_val, p.pend_at, p.pend_on
+        recvd = p.received_requests
+        bufs = (r_dest, r_kind, r_lvl, r_val)
+
+        for s in range(S):
+            ok_s = inbox.valid[:, s] & alive
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            kind = inbox.data[:, s, 0]
+            lvl = jnp.clip(inbox.data[:, s, 1], 0, L - 1)
+            val = inbox.data[:, s, 2]
+
+            half = _half(self.bits, lvl)
+            is_cand = ok_s & (_cand_base(ids, half) == _own_base(src, half))
+
+            # ---- SwapRequest (onSwapRequest, :229-270) ----
+            is_req = ok_s & (kind == REQ)
+            recvd = recvd + is_req.astype(jnp.int32)
+            wrong = is_req & (p.done | (lvl != p.cpl))
+            cached = gather2d(cache, ids, lvl)
+            # cached value -> optimistic OK reply
+            bufs, r_cnt = push_reply(bufs, r_cnt, src, OK, lvl, cached,
+                                     wrong & (cached > 0))
+            # no cache -> NO reply, remember the value if from a candidate.
+            # The NO carries the REPLIER's current level (the 3-arg
+            # sendSwapReply overload, SanFerminSignature.java:421-423), so
+            # it only triggers the requester's immediate retry when the two
+            # nodes happen to sit at the same level — usually the requester
+            # recovers via its timeout instead.
+            bufs, r_cnt = push_reply(bufs, r_cnt, src, NO, p.cpl,
+                                     0, wrong & (cached == 0))
+            cache = set2d(cache, ids, lvl, val,
+                          ok=wrong & (cached == 0) & is_cand)
+            # current level, already swapping -> optimistic OK with our agg
+            cur = is_req & ~wrong
+            busy = cur & swapping
+            bufs, r_cnt = push_reply(bufs, r_cnt, src, OK, lvl, p.agg, busy)
+            # valid swap -> latch the verification (transition, :519-540).
+            # Faithfully NO reply is sent on accept: the requester's own
+            # swap completes via the crossing request, or via the
+            # busy/cached optimistic replies on its timeout retries
+            # (onSwapRequest, :229-270).
+            accept = cur & ~swapping & is_cand
+            swapping = swapping | accept
+            pend_val = jnp.where(accept, val, pend_val)
+            pend_at = jnp.where(accept, t + self.pairing_time, pend_at)
+            pend_on = pend_on | accept
+
+            # ---- SwapReply (onSwapReply, :273-324) ----
+            is_rep = ok_s & ((kind == OK) | (kind == NO)) & ~p.done & \
+                (lvl == p.cpl) & ~swapping
+            off = src - _cand_base(ids, half)
+            expected = _expected(off, self._partner_off(ids, p.cpl), p.used)
+            acc2 = is_rep & (kind == OK) & is_cand
+            swapping = swapping | acc2
+            pend_val = jnp.where(acc2, val, pend_val)
+            pend_at = jnp.where(acc2, t + self.pairing_time, pend_at)
+            pend_on = pend_on | acc2
+            # NO from an expected candidate -> try the next ones (:311-318)
+            want_batch = want_batch | (is_rep & (kind == NO) & expected)
+
+        p = p.replace(swapping=swapping, cache=cache, pend_val=pend_val,
+                      pend_at=pend_at, pend_on=pend_on,
+                      received_requests=recvd)
+
+        # ---- apply finished verification -> aggregate + goNextLevel ----
+        due = pend_on & (t >= p.pend_at) & ~p.done
+        p = p.replace(agg=jnp.where(due, p.agg + p.pend_val, p.agg),
+                      pend_on=pend_on & ~due)
+        p, nodes, desc = self._enter_level(p, nodes, due, t)
+
+        # ---- init kick (registerTask(goNextLevel, 1), :141) ----
+        kick = alive & (t == 1) & (p.cpl == self.bits)
+        p, nodes, desc0 = self._enter_level(p, nodes, kick, t)
+        desc = desc | desc0
+
+        # ---- timeout (sendToNodes' chained task, :329-369) ----
+        fired = alive & ~p.done & (p.timeout_at > 0) & (t >= p.timeout_at) & \
+            (p.cpl == p.timeout_lvl)
+        want_batch = (want_batch & ~p.done & alive) | desc | fired
+
+        # ---- assemble outbox ----
+        cc = self.candidate_count
+        dest_req, taken = self._pick_batch(ids, p.cpl, p.used, cc)
+        dest_req = jnp.where(want_batch[:, None], dest_req, -1)
+        sent_some = want_batch & (taken > 0)
+        p = p.replace(
+            used=jnp.where(want_batch, p.used + taken, p.used),
+            sent_requests=p.sent_requests + jnp.where(
+                want_batch, jnp.sum(dest_req >= 0, axis=1), 0),
+            timeout_at=jnp.where(sent_some, t + self.reply_timeout,
+                                 p.timeout_at),
+            timeout_lvl=jnp.where(sent_some, p.cpl, p.timeout_lvl))
+
+        K, F = self.cfg.out_deg, self.cfg.payload_words
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, F), jnp.int32)
+        dest = dest.at[:, :cc].set(dest_req)
+        payload = payload.at[:, :cc, 0].set(REQ)
+        payload = payload.at[:, :cc, 1].set(p.cpl[:, None])
+        payload = payload.at[:, :cc, 2].set(p.agg[:, None])
+        rd, rk, rl, rv = bufs
+        live_r = jnp.arange(rc)[None, :] < r_cnt[:, None]
+        dest = dest.at[:, cc:cc + rc].set(jnp.where(live_r, rd, -1))
+        payload = payload.at[:, cc:cc + rc, 0].set(rk)
+        payload = payload.at[:, cc:cc + rc, 1].set(rl)
+        payload = payload.at[:, cc:cc + rc, 2].set(rv)
+        sizes = jnp.full((n, K), self.signature_size + 1, jnp.int32)
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
+                                             size=sizes)
+        return p, nodes, out
+
+    def done(self, pstate, nodes):
+        return jnp.all(nodes.down | pstate.done)
+
+
+@struct.dataclass
+class CapposState:
+    seed: jnp.ndarray
+    cpl: jnp.ndarray           # int32 [N]
+    cache_best: jnp.ndarray    # int32 [N, L] max received value per level
+    used: jnp.ndarray          # int32 [N]
+    swapping: jnp.ndarray      # bool [N]
+    pend_val: jnp.ndarray      # int32 [N]
+    pend_lvl: jnp.ndarray      # int32 [N]
+    pend_at: jnp.ndarray       # int32 [N]
+    pend_on: jnp.ndarray       # bool [N]
+    timeout_at: jnp.ndarray    # int32 [N]
+    timeout_lvl: jnp.ndarray   # int32 [N]
+    threshold_at: jnp.ndarray  # int32 [N]
+    done: jnp.ndarray          # bool [N]
+
+
+@register
+class SanFerminCappos(_SanFerminBase):
+    """protocols/SanFerminCappos.java; parameters mirror SanFerminParameters
+    (:43-106).  threshold counts 1 + sum of per-level best cached values at
+    or above the current level (totalNumberOfSigs, :352-360)."""
+
+    def __init__(self, node_count=2048, threshold=1024, pairing_time=2,
+                 signature_size=48, timeout=150, candidate_count=50,
+                 node_builder_name=None, network_latency_name=None,
+                 reply_cap=8, inbox_cap=32, horizon=512):
+        self.threshold = threshold
+        self.timeout = timeout
+        self._setup(node_count, pairing_time, signature_size,
+                    candidate_count, reply_cap, inbox_cap, horizon,
+                    node_builder_name, network_latency_name)
+
+    def init(self, seed):
+        n, L = self.node_count, self.levels
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        net = init_net(self.cfg, nodes, seed)
+
+        def zi():
+            return jnp.zeros((n,), jnp.int32)
+
+        pstate = CapposState(
+            seed=seed,
+            cpl=jnp.full((n,), self.bits, jnp.int32),
+            cache_best=jnp.zeros((n, L), jnp.int32),
+            used=zi(), swapping=jnp.zeros((n,), bool),
+            pend_val=zi(), pend_lvl=zi(), pend_at=zi(),
+            pend_on=jnp.zeros((n,), bool),
+            timeout_at=zi(), timeout_lvl=zi(), threshold_at=zi(),
+            done=jnp.zeros((n,), bool),
+        )
+        return net, pstate
+
+    def _total(self, cache_best, level):
+        """totalNumberOfSigs(level) = 1 + sum of best cached values at
+        levels >= level (:352-360)."""
+        L = self.levels
+        lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+        return 1 + jnp.sum(jnp.where(lvl_idx >= level[:, None],
+                                     cache_best, 0), axis=1)
+
+    def _enter_level(self, p, nodes, go, t):
+        """goNextLevel (:307-345): cached levels are skipped recursively."""
+        n, L = self.node_count, self.levels
+
+        def one(p, nodes, go):
+            total_cur = self._total(p.cache_best, p.cpl)
+            hit = go & ~(p.threshold_at > 0) & (total_cur >= self.threshold)
+            threshold_at = jnp.where(hit, t + 2 * self.pairing_time,
+                                     p.threshold_at)
+            finish = go & (p.cpl == 0) & ~p.done
+            done = p.done | finish
+            done_at = jnp.where(finish & (nodes.done_at == 0),
+                                jnp.maximum(1, t + 2 * self.pairing_time),
+                                nodes.done_at)
+            nodes = nodes.replace(done_at=done_at.astype(jnp.int32))
+            desc = go & ~finish & ~done
+            cpl = jnp.where(desc, p.cpl - 1, p.cpl)
+            p = p.replace(cpl=cpl, swapping=p.swapping & ~desc,
+                          used=jnp.where(desc, 0, p.used), done=done,
+                          threshold_at=threshold_at)
+            ids = jnp.arange(n, dtype=jnp.int32)
+            has_cache = gather2d(p.cache_best, ids, p.cpl) > 0
+            return p, nodes, desc & ~has_cache, desc & has_cache
+
+        send = jnp.zeros((n,), bool)
+        again = go
+        for _ in range(L):        # cached-level skips, at most L deep
+            p, nodes, fresh, again = one(p, nodes, again)
+            send = send | fresh
+        return p, nodes, send
+
+    def step(self, p: CapposState, nodes, inbox, t, key):
+        n, L = self.node_count, self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+        alive = ~nodes.down
+
+        rc = self.reply_cap
+        r_dest = jnp.full((n, rc), -1, jnp.int32)
+        r_lvl = jnp.zeros((n, rc), jnp.int32)
+        r_val = jnp.zeros((n, rc), jnp.int32)
+        r_cnt = jnp.zeros((n,), jnp.int32)
+
+        def push_reply(bufs, cnt, to, lvl, val, ok):
+            d, l, v = bufs
+            ok = ok & (cnt < rc)
+            slot = jnp.minimum(cnt, rc - 1)
+            d = set2d(d, ids, slot, to, ok=ok)
+            l = set2d(l, ids, slot, lvl, ok=ok)
+            v = set2d(v, ids, slot, val, ok=ok)
+            return (d, l, v), cnt + ok.astype(jnp.int32)
+
+        swapping, cache = p.swapping, p.cache_best
+        pend_val, pend_lvl, pend_at, pend_on = (p.pend_val, p.pend_lvl,
+                                                p.pend_at, p.pend_on)
+        bufs = (r_dest, r_lvl, r_val)
+        thr_at = p.threshold_at
+
+        for s in range(S):
+            ok_s = inbox.valid[:, s] & alive
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            kind = inbox.data[:, s, 0]
+            lvl = jnp.clip(inbox.data[:, s, 1], 0, L - 1)
+            val = inbox.data[:, s, 2]
+            want_reply = kind == SWAP_ASK
+
+            half = _half(self.bits, lvl)
+            is_cand = ok_s & (_cand_base(ids, half) == _own_base(src, half))
+
+            wrong = ok_s & (p.done | (lvl != p.cpl))
+            cached = gather2d(cache, ids, lvl)
+            bufs, r_cnt = push_reply(bufs, r_cnt, src, lvl, cached,
+                                     wrong & want_reply & (cached > 0))
+            # keep for later (putCachedSig, :240-247) — max, not replace
+            upd = wrong & ~(want_reply & (cached > 0)) & is_cand
+            cache = set2d(cache, ids, lvl, jnp.maximum(cached, val), ok=upd)
+            hit = upd & ~(thr_at > 0) & \
+                (self._total(cache, p.cpl) >= self.threshold)
+            thr_at = jnp.where(hit, t + 2 * self.pairing_time, thr_at)
+
+            cur = ok_s & ~wrong
+            bufs, r_cnt = push_reply(bufs, r_cnt, src, lvl,
+                                     self._total(cache, lvl),
+                                     cur & want_reply)
+            accept = cur & is_cand & ~swapping
+            swapping = swapping | accept
+            pend_val = jnp.where(accept, val, pend_val)
+            pend_lvl = jnp.where(accept, lvl, pend_lvl)
+            pend_at = jnp.where(accept, t + self.pairing_time, pend_at)
+            pend_on = pend_on | accept
+
+        p = p.replace(swapping=swapping, cache_best=cache,
+                      pend_val=pend_val, pend_lvl=pend_lvl, pend_at=pend_at,
+                      pend_on=pend_on, threshold_at=thr_at)
+
+        # apply verification: putCachedSig(level, value) + goNextLevel
+        due = p.pend_on & (t >= p.pend_at) & ~p.done
+        old = gather2d(p.cache_best, ids, p.pend_lvl)
+        cache = set2d(p.cache_best, ids, p.pend_lvl,
+                      jnp.maximum(old, p.pend_val), ok=due)
+        p = p.replace(cache_best=cache, pend_on=p.pend_on & ~due)
+        hit = due & ~(p.threshold_at > 0) & \
+            (self._total(p.cache_best, p.cpl) >= self.threshold)
+        p = p.replace(threshold_at=jnp.where(
+            hit, t + 2 * self.pairing_time, p.threshold_at))
+        p, nodes, send = self._enter_level(p, nodes, due, t)
+
+        kick = alive & (t == 1) & (p.cpl == self.bits)
+        p, nodes, send0 = self._enter_level(p, nodes, kick, t)
+        send = send | send0
+
+        fired = alive & ~p.done & (p.timeout_at > 0) & (t >= p.timeout_at) & \
+            (p.cpl == p.timeout_lvl)
+        send = (send & alive & ~p.done) | fired
+
+        cc = self.candidate_count
+        dest_req, taken = self._pick_batch(ids, p.cpl, p.used, cc)
+        dest_req = jnp.where(send[:, None], dest_req, -1)
+        sent_some = send & (taken > 0)
+        # Swap value sent with a request = totalNumberOfSigs(cpl + 1)
+        # (:274-278).
+        req_val = self._total(p.cache_best, p.cpl + 1)
+        p = p.replace(
+            used=jnp.where(send, p.used + taken, p.used),
+            timeout_at=jnp.where(sent_some, t + self.timeout, p.timeout_at),
+            timeout_lvl=jnp.where(sent_some, p.cpl, p.timeout_lvl))
+
+        K, F = self.cfg.out_deg, self.cfg.payload_words
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, F), jnp.int32)
+        dest = dest.at[:, :cc].set(dest_req)
+        payload = payload.at[:, :cc, 0].set(SWAP_ASK)
+        payload = payload.at[:, :cc, 1].set(p.cpl[:, None])
+        payload = payload.at[:, :cc, 2].set(req_val[:, None])
+        rd, rl, rv = bufs
+        live_r = jnp.arange(rc)[None, :] < r_cnt[:, None]
+        dest = dest.at[:, cc:cc + rc].set(jnp.where(live_r, rd, -1))
+        payload = payload.at[:, cc:cc + rc, 0].set(SWAP_INFO)
+        payload = payload.at[:, cc:cc + rc, 1].set(rl)
+        payload = payload.at[:, cc:cc + rc, 2].set(rv)
+        sizes = jnp.full((n, K), self.signature_size + 1, jnp.int32)
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
+                                             size=sizes)
+        return p, nodes, out
+
+    def done(self, pstate, nodes):
+        return jnp.all(nodes.down | pstate.done)
+
+
+def cont_if_sanfermin(net, pstate):
+    live = ~net.nodes.down
+    return jnp.any(live & ~pstate.done)
