@@ -1,0 +1,80 @@
+"""ENRGossiping tests — cap distribution, rewiring toward done, churn,
+determinism (ENRGossipingTest.java analogue)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.enr import ENRGossiping
+
+
+def make(seed=0, **kw):
+    args = dict(nodes=40, total_peers=5, max_peers=12,
+                number_of_different_capabilities=5, cap_per_node=2,
+                cap_gossip_time=500, time_to_change=5_000,
+                time_to_leave=20_000, changing_nodes=0.4,
+                network_latency_name="NetworkLatencyByDistanceWJitter")
+    args.update(kw)
+    return ENRGossiping(**args)
+
+
+def test_init_invariants():
+    p = make()
+    net, ps = p.init(0)
+    caps = np.asarray(ps.caps)
+    # Every node has exactly cap_per_node capabilities.
+    assert np.all(caps.sum(1) == 2)
+    # Capabilities are distributed (no orphan capability among the initial
+    # nodes — the reference throws if any cap has a single holder).
+    assert np.all(caps[:40].sum(0) >= 2)
+    # Joiner slots start down with scheduled join times.
+    down = np.asarray(net.nodes.down)
+    assert down[40:].all() and not down[:40].any()
+    assert np.all(np.asarray(ps.join_at)[40:] > 0)
+    # Peer graph symmetric among initial nodes.
+    peers = np.asarray(ps.peers)
+    for i in range(40):
+        for q in peers[i][peers[i] >= 0]:
+            assert i in peers[q], (i, q)
+
+
+def test_run_rewires_and_finishes():
+    p = make()
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(30):
+        net, ps = r.run_ms(net, ps, 500)
+        done = np.asarray(net.nodes.done_at)
+        live = ~np.asarray(net.nodes.down)
+        if (done[live] > 0).all():
+            break
+    frac = (done[live] > 0).mean()
+    # Rewiring should connect a large majority of live nodes to their
+    # capability groups within 15 s.
+    assert frac > 0.8, f"only {frac:.2f} done"
+    assert int(net.dropped) == 0
+
+
+def test_churn_membership():
+    p = make(time_to_leave=4_000)   # joins every 500 ms, quick exits
+    r = Runner(p, donate=False)
+    net, ps = p.init(1)
+    seen_alive = []
+    for _ in range(10):
+        net, ps = r.run_ms(net, ps, 500)
+        seen_alive.append(int((~np.asarray(net.nodes.down)).sum()))
+    # Membership changed over time (joins happened; exits eventually).
+    assert len(set(seen_alive)) > 1, seen_alive
+
+
+def test_determinism():
+    p = make()
+    r = Runner(p, donate=False)
+    net1, ps1 = p.init(3)
+    net2, ps2 = p.init(3)
+    for _ in range(4):
+        net1, ps1 = r.run_ms(net1, ps1, 500)
+        net2, ps2 = r.run_ms(net2, ps2, 500)
+    assert np.array_equal(np.asarray(ps1.peers), np.asarray(ps2.peers))
+    assert np.array_equal(np.asarray(net1.nodes.done_at),
+                          np.asarray(net2.nodes.done_at))

@@ -1,0 +1,93 @@
+"""OptimisticP2PSignature + P2PHandel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.optimistic import OptimisticP2PSignature
+from wittgenstein_tpu.models.p2phandel import (P2PHandel, compressed_size)
+from wittgenstein_tpu.ops import bitset
+
+
+def test_optimistic_run():
+    # OptimisticP2PSignature.main: 1000 nodes, threshold n/2+1, 13 peers,
+    # pairing 3 — scaled down for the test.
+    p = OptimisticP2PSignature(node_count=128, threshold=65,
+                               connection_count=13, pairing_time=3,
+                               network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(10):
+        net, ps = r.run_ms(net, ps, 200)
+        if bool(jnp.all(ps.done)):
+            break
+    assert bool(jnp.all(ps.done))
+    assert int(net.dropped) == 0 and int(net.clamped) == 0
+    done_at = np.asarray(net.nodes.done_at)
+    assert np.all(done_at > 0)
+    card = np.asarray(bitset.popcount(ps.received))
+    assert np.all(card >= 65)
+    # Determinism
+    net2, ps2 = p.init(0)
+    for _ in range(int(net.time) // 200):
+        net2, ps2 = r.run_ms(net2, ps2, 200)
+    assert np.array_equal(np.asarray(net2.nodes.done_at), done_at)
+
+
+def test_compressed_size():
+    # compressedSize doc examples (P2PHandel.java:147-158), 8-bit sets:
+    # 1101 0111 -> 5 (pair {2,3} merges), 1111 1110 -> ... our canonical
+    # dyadic count: full pairs {0,1},{2,3} merge into one level-1 segment.
+    def cs(bits_str, n_sign=16):
+        v = 0
+        for i, c in enumerate(bits_str):
+            if c == "1":
+                v |= 1 << i
+        row = jnp.asarray([[v]], jnp.uint32)
+        return int(compressed_size(row, n_sign)[0])
+
+    # 1101 0111 (bits 0,1,3,4,6,7? — string is bit order LSB-first here):
+    # pairs: (1,1)=full, (0,1), (0,1), (1,1)=full -> 2 singles + 2 segments
+    assert cs("11010111") == 2 + 2
+    # all 8 bits set: one aligned run of 4 pairs -> 1 segment
+    assert cs("11111111") == 1
+    # 0111 0111 (LSB-first): pairs (0,1),(1,1),(0,1),(1,1) -> 2 singles in
+    # partial pairs + 2 non-adjacent full-pair segments
+    assert cs("01110111") == 4
+    # complete set shortcut
+    assert cs("1" * 16, n_sign=16) == 1
+
+
+def test_p2phandel_run():
+    p = P2PHandel(signing_node_count=100, relaying_node_count=20,
+                  threshold=99, connection_count=10, pairing_time=10,
+                  sigs_send_period=50, double_aggregate_strategy=True,
+                  send_sigs_strategy="dif",
+                  network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(20):
+        net, ps = r.run_ms(net, ps, 500)
+        done = np.asarray(net.nodes.done_at)
+        if (done > 0).all():
+            break
+    assert (done > 0).all(), f"{(done > 0).sum()}/{len(done)} done"
+    assert int(net.dropped) == 0
+    card = np.asarray(bitset.popcount(ps.verified))
+    assert np.all(card >= 99)
+
+
+def test_p2phandel_checksigs1():
+    p = P2PHandel(signing_node_count=64, relaying_node_count=0,
+                  threshold=60, connection_count=8, pairing_time=10,
+                  sigs_send_period=50, double_aggregate_strategy=False,
+                  send_sigs_strategy="cmp_diff", send_state=True,
+                  network_latency_name="NetworkNoLatency")
+    r = Runner(p, donate=False)
+    net, ps = p.init(1)
+    for _ in range(20):
+        net, ps = r.run_ms(net, ps, 500)
+        done = np.asarray(net.nodes.done_at)
+        if (done > 0).all():
+            break
+    assert (done > 0).all()

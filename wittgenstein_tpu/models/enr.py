@@ -1,0 +1,400 @@
+"""ENRGossiping — EIP-778 node-record gossip with peer rewiring and churn.
+
+Reference: protocols/ENRGossiping.java (521 lines).  Nodes carry a set of
+capabilities; they gossip versioned Records (StatusFloodMessage semantics:
+newer seq replaces older, core/messages/StatusFloodMessage.java:33-45) every
+`capGossipTime` ms; on receiving a record from an unconnected node they may
+rewire: connect if the node adds capability value (addedValue :258-266,
+score :380-400), evicting their least-valuable peer when full
+(removeWorseIfPossible :402-428).  A changing fraction re-rolls capabilities
+every `timeToChange` (:145-153); a new node joins every `timeToLeave/8` and
+later leaves (addNewNode :155-163, exitNetwork :439-450).  A node is done
+when every one of its capabilities has >= 3 matching peers (score maxed)
+AND its cap-subgraph reaches at least half of that capability's live nodes
+(isFullyConnected :225-246, isPartOfNetwork :330-360); doneAt is RELATIVE:
+max(1, time - startTime) (:324-327).
+
+TPU-native notes:
+* The per-(node, capability) BFS of isPartOfNetwork becomes a boolean
+  transitive closure of the cap-restricted adjacency matrix — log2(N)
+  squarings of an [N, N] bool matrix on the MXU, computed every ms.
+* The flood queue forwards one pending record per node per ms (as the other
+  flood models); record content (the source's capabilities) is gathered at
+  use time — staleness is one in-flight latency, below capGossipTime.
+* The reference's selectChangingNodes quirk — the changing set is drawn
+  from the FIRST `totalPeers` node ids (:145-153) — is reproduced.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders, p2p
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import prng
+
+TAG_CAPS = 0x454E4330
+TAG_JOIN = 0x454E4331
+TAG_EXIT = 0x454E4332
+TAG_GOSS = 0x454E4333
+TAG_CHG = 0x454E4334
+TAG_LINK = 0x454E4335
+
+PEERS_PER_CAP = 3
+
+
+def _draw_caps(seed, n, n_caps, cap_per_node):
+    """capPerNode distinct capabilities per node (generateCap, :124-131):
+    rank a per-(node, cap) hash and take the top capPerNode."""
+    pri = prng.uniform_u32(
+        seed, jnp.arange(n * n_caps, dtype=jnp.int32)).reshape(n, n_caps)
+    order = jnp.argsort(pri, axis=1)
+    rank = jnp.zeros((n, n_caps), jnp.int32).at[
+        jnp.arange(n)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(n_caps, dtype=jnp.int32)[None, :],
+                         (n, n_caps)))
+    return rank < cap_per_node
+
+
+@struct.dataclass
+class ENRState:
+    seed: jnp.ndarray
+    caps: jnp.ndarray         # bool [N, C]
+    peers: jnp.ndarray        # int32 [N, D] (mutable adjacency)
+    degree: jnp.ndarray       # int32 [N]
+    seq: jnp.ndarray          # int32 [N] — own record sequence number
+    seen_seq: jnp.ndarray     # int32 [N, N] — newest seq seen per source
+    pending: jnp.ndarray      # bool [N, N] — records to forward
+    pending_src: jnp.ndarray  # int32 [N, N] — who delivered each record
+    join_at: jnp.ndarray      # int32 [N] (0 = initial member)
+    exit_at: jnp.ndarray      # int32 [N] (0 = never leaves)
+    start_time: jnp.ndarray   # int32 [N]
+    gossip_start: jnp.ndarray  # int32 [N]
+    change_start: jnp.ndarray  # int32 [N] (0 = never changes caps)
+
+
+@register
+class ENRGossiping:
+    """Parameters mirror ENRParameters (ENRGossiping.java:26-106)."""
+
+    def __init__(self, time_to_change=60_000, cap_gossip_time=10_000,
+                 discard_time=100, time_to_leave=60_000, total_peers=5,
+                 nodes=50, changing_nodes=10.0, max_peers=50,
+                 number_of_different_capabilities=5, cap_per_node=3,
+                 node_builder_name=None, network_latency_name=None,
+                 join_slots=None, inbox_cap=16, horizon=1024):
+        if cap_per_node > number_of_different_capabilities:
+            raise ValueError("capPerNode > numberOfDifferentCapabilities")
+        self.n_initial = nodes
+        self.time_to_change = max(1, time_to_change)
+        self.cap_gossip_time = max(1, cap_gossip_time)
+        # discardTime is accepted for parameter parity but inert — the
+        # reference stores and prints it without ever applying it
+        # (ENRGossiping.java:41,94,501-502).
+        self.discard_time = discard_time
+        self.time_to_leave = max(8, time_to_leave)
+        self.total_peers = total_peers
+        self.changing_nodes = changing_nodes
+        self.max_peers = max_peers
+        self.n_caps = number_of_different_capabilities
+        self.cap_per_node = cap_per_node
+        # Joiner arena: one slot per addNewNode firing we provision for.
+        self.join_slots = (8 if join_slots is None else join_slots)
+        self.node_count = nodes + self.join_slots
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        # Peer-list arena width: the initial min-degree construction can
+        # exceed maxPeers (the reference's maxPeers only gates onFlood
+        # connects, :268-270), so size the slots generously.
+        self.arena_deg = max(max_peers, 4 * total_peers, total_peers + 16)
+        self.cfg = EngineConfig(
+            n=self.node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=2, out_deg=self.arena_deg, bcast_slots=1)
+
+    def init(self, seed):
+        n, ni, C, D = (self.node_count, self.n_initial, self.n_caps,
+                       self.arena_deg)
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        is_joiner = ids >= ni
+        nodes = nodes.replace(down=is_joiner)   # joiners start down
+
+        caps = _draw_caps(prng.hash2(seed, TAG_CAPS), n, C,
+                          self.cap_per_node)
+
+        # Initial peer graph over ONLY the ni live nodes (P2PNetwork(
+        # totalPeers, true): minimum-degree construction) — building over
+        # the joiner arena would silently break the min-degree invariant
+        # and couple the t=0 topology to join_slots.
+        peers_i, _, _ = p2p.build_peer_graph(
+            seed, ni, self.total_peers, minimum=True, max_degree=D)
+        peers = jnp.full((n, D), -1, jnp.int32).at[:ni].set(peers_i)
+        degree = jnp.sum(peers >= 0, axis=1).astype(jnp.int32)
+
+        # Joiner k fires at (k+1) * timeToLeave/8 (addNewNode every
+        # timeToLeave/8, :188-189), and exits timeToLeave-bounded later.
+        k = jnp.maximum(ids - ni, 0)
+        join_at = jnp.where(is_joiner, (k + 1) * (self.time_to_leave // 8),
+                            0).astype(jnp.int32)
+        exit_rand = prng.uniform_int(prng.hash2(seed, TAG_EXIT), ids,
+                                     self.time_to_leave)
+        exit_at = jnp.where(is_joiner, join_at + jnp.maximum(exit_rand, 1),
+                            0).astype(jnp.int32)
+
+        # Periodic gossip start: join + rand(capGossipTime) + 1 (:297-303).
+        goss = prng.uniform_int(prng.hash2(seed, TAG_GOSS), ids,
+                                self.cap_gossip_time)
+        gossip_start = (join_at + goss + 1).astype(jnp.int32)
+
+        # Changing set: first int(totalPeers * changingNodes) ids drawn from
+        # [0, totalPeers) — reference quirk (:145-153).
+        n_chg = min(int(self.total_peers * self.changing_nodes),
+                    self.total_peers)
+        chg = ids < 0
+        if n_chg > 0:
+            pri = prng.uniform_u32(prng.hash2(seed, TAG_CHG),
+                                   jnp.arange(self.total_peers,
+                                              dtype=jnp.int32))
+            chosen = jnp.argsort(pri)[:n_chg]
+            chg = chg.at[chosen].set(True)
+        chg_start = prng.uniform_int(prng.hash2(seed, TAG_CHG + 1), ids,
+                                     self.time_to_change) + 1
+        change_start = jnp.where(chg, chg_start, 0).astype(jnp.int32)
+
+        net = init_net(self.cfg, nodes, seed)
+        return net, ENRState(
+            seed=seed, caps=caps, peers=peers, degree=degree,
+            seq=jnp.zeros((n,), jnp.int32),
+            seen_seq=jnp.full((n, n), -1, jnp.int32),
+            pending=jnp.zeros((n, n), bool),
+            pending_src=jnp.full((n, n), -1, jnp.int32),
+            join_at=join_at, exit_at=exit_at,
+            start_time=join_at,
+            gossip_start=gossip_start, change_start=change_start)
+
+    # ------------------------------------------------------------------
+
+    def _score_counts(self, p, caps):
+        """cnt[i, c] = number of i's peers with capability c."""
+        peer_caps = jnp.where((p.peers >= 0)[..., None],
+                              caps[jnp.maximum(p.peers, 0)], False)
+        return jnp.sum(peer_caps, axis=1).astype(jnp.int32)    # [N, C]
+
+    def _score_of(self, caps, cnt):
+        """score(peers) = sum over own caps of min(count, 3) (:395-400)."""
+        return jnp.sum(jnp.where(caps, jnp.minimum(cnt, PEERS_PER_CAP), 0),
+                       axis=-1).astype(jnp.int32)
+
+    def _fully_connected(self, p, nodes, adj):
+        """isFullyConnected (:225-246): score maxed AND each own cap's
+        subgraph reaches >= |capSet|/2 live cap-holders.  `adj` is the
+        symmetric [N, N] edge matrix step() already built."""
+        n, C = self.node_count, self.n_caps
+        alive = ~nodes.down
+        cnt = self._score_counts(p, p.caps)
+        score_ok = self._score_of(p.caps, cnt) >= \
+            jnp.sum(p.caps, axis=1) * PEERS_PER_CAP
+
+        ids = jnp.arange(n, dtype=jnp.int32)
+        ok = jnp.ones((n,), bool)
+        f32 = jnp.float32
+        for c in range(C):
+            m = p.caps[:, c] & alive                       # cap-subgraph
+            a = adj & m[None, :] & m[:, None]
+            # reach[i, j]: j reachable from i through the cap subgraph,
+            # starting from i's cap-peers (i itself need not hold the cap).
+            # True doubling: square the adjacency too, so diameter up to N
+            # is covered in log2(N) steps.
+            r = (adj & m[None, :]).astype(f32)             # direct cap-peers
+            ac = a.astype(f32)
+            for _ in range(max(1, (n - 1).bit_length())):
+                r = jnp.minimum(r + r @ ac, 1.0)
+                ac = jnp.minimum(ac + ac @ ac, 1.0)
+            # explored = self + distinct reached others (:331-360)
+            others = jnp.where(m[None, :], r > 0, False).at[ids, ids].set(
+                False, mode="drop")
+            reached = jnp.sum(others, axis=1).astype(jnp.int32)
+            cap_total = jnp.sum(m).astype(jnp.int32)
+            cap_ok = (~p.caps[:, c]) | ((reached + 1) >= cap_total // 2)
+            ok = ok & cap_ok
+        return score_ok & ok
+
+    def step(self, p: ENRState, nodes, inbox, t, key):
+        n, C, D = self.node_count, self.n_caps, self.arena_deg
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+
+        # ---- membership: joins and exits ----
+        joining = (p.join_at > 0) & (t == p.join_at)
+        leaving = (p.exit_at > 0) & (t == p.exit_at) & ~nodes.down
+        nodes = nodes.replace(down=(nodes.down & ~joining) | leaving)
+        alive = ~nodes.down
+        peers, degree = p2p.disconnect(p.peers, p.degree, leaving)
+
+        # Joiner links: totalPeers random live targets (addNewNode
+        # :155-163); targets' reciprocal slots fill if they have room.
+        if self.join_slots:
+            tries = self.total_peers * 2
+            cand = prng.uniform_int(
+                prng.hash3(p.seed, TAG_JOIN, t),
+                ids[:, None] * tries + jnp.arange(tries)[None, :], n)
+            cand_ok = joining[:, None] & alive[jnp.maximum(cand, 0)] & \
+                (cand != ids[:, None])
+            # take the first total_peers valid candidates
+            rank = jnp.cumsum(cand_ok, axis=1) - cand_ok
+            take = cand_ok & (rank < self.total_peers)
+            slot = jnp.where(take,
+                             degree[:, None] + rank.astype(jnp.int32), D)
+            peers = peers.reshape(-1).at[
+                jnp.where(take & (slot < D), ids[:, None] * D + slot,
+                          n * D).reshape(-1)].set(
+                cand.reshape(-1), mode="drop").reshape(n, D)
+            # The reciprocal (target-side) links are created by the
+            # symmetrization pass below, same ms.
+            degree = jnp.sum(peers >= 0, axis=1).astype(jnp.int32)
+
+        # ---- receive records ----
+        seen_seq, pending, pending_src = p.seen_seq, p.pending, p.pending_src
+        caps, seq = p.caps, p.seq
+        cnt = self._score_counts(p.replace(peers=peers), caps)
+        base_score = self._score_of(caps, cnt)
+        for s in range(S):
+            ok = inbox.valid[:, s] & alive
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            origin = jnp.clip(inbox.data[:, s, 0], 0, n - 1)
+            rseq = inbox.data[:, s, 1]
+            old = seen_seq[ids, origin]
+            newer = ok & (rseq > old) & (origin != ids)
+            seen_seq = seen_seq.at[jnp.where(newer, ids, n),
+                                   jnp.minimum(origin, n - 1)].set(
+                rseq, mode="drop")
+            pending = pending.reshape(-1).at[
+                jnp.where(newer, ids * n + origin, n * n)].set(
+                True, mode="drop").reshape(n, n)
+            pending_src = pending_src.reshape(-1).at[
+                jnp.where(newer, ids * n + origin, n * n)].set(
+                src, mode="drop").reshape(n, n)
+
+            # onFlood connect logic (:305-322)
+            o_caps = caps[origin]                          # [N, C]
+            connected = jnp.any(peers == origin[:, None], axis=1)
+            can = newer & alive[origin] & \
+                (degree[origin] < self.max_peers) & ~connected
+            add_cnt = cnt + o_caps.astype(jnp.int32)
+            gain = self._score_of(caps, jnp.minimum(add_cnt, PEERS_PER_CAP)
+                                  ) - base_score
+            want = can & (gain > 0)
+            has_room = degree < self.max_peers
+            # full -> try replacing the worst peer (removeWorse, :402-428)
+            peer_caps = jnp.where((peers >= 0)[..., None],
+                                  caps[jnp.maximum(peers, 0)], False)
+            repl_cnt = (cnt[:, None, :] - peer_caps.astype(jnp.int32) +
+                        o_caps[:, None, :].astype(jnp.int32))   # [N, D, C]
+            repl_score = jnp.sum(
+                jnp.where(caps[:, None, :],
+                          jnp.minimum(repl_cnt, PEERS_PER_CAP), 0),
+                axis=2)                                         # [N, D]
+            repl_score = jnp.where(peers >= 0, repl_score, -1)
+            best_repl = jnp.argmax(repl_score, axis=1)
+            best_gain = jnp.take_along_axis(repl_score, best_repl[:, None],
+                                            axis=1)[:, 0] - base_score
+            do_repl = want & ~has_room & (best_gain > 0)
+            # drop the replaced link (one side; the other side's stale slot
+            # is cleaned by the periodic symmetrization below)
+            peers = jnp.where(
+                (do_repl[:, None] &
+                 (jnp.arange(D)[None, :] == best_repl[:, None])),
+                -1, peers)
+            do_conn = (want & has_room) | do_repl
+            free_slot = jnp.argmax(peers < 0, axis=1)
+            has_free = jnp.any(peers < 0, axis=1)
+            do_conn = do_conn & has_free
+            peers = peers.reshape(-1).at[
+                jnp.where(do_conn, ids * D + free_slot, n * D)].set(
+                origin, mode="drop").reshape(n, D)
+            # reciprocal side: origin gains us if it has a free slot —
+            # deferred to the symmetrization pass below.
+            degree = jnp.sum(peers >= 0, axis=1).astype(jnp.int32)
+            cnt = self._score_counts(p.replace(peers=peers), caps)
+            base_score = self._score_of(caps, cnt)
+
+        # ---- symmetrize: ensure every link is mutual (createLink adds both
+        # directions; removeLink removes both).  One pass per ms. ----
+        has_edge = jnp.zeros((n, n), bool).reshape(-1).at[
+            jnp.where(peers >= 0, ids[:, None] * n + jnp.maximum(peers, 0),
+                      n * n).reshape(-1)].set(True, mode="drop").reshape(n, n)
+        mutual = has_edge & has_edge.T
+        asym_in = has_edge.T & ~has_edge          # they list us, we don't
+        # accept reciprocal links while we have room, in id order
+        order_gain = jnp.cumsum(asym_in, axis=1)
+        room = jnp.maximum(self.max_peers - degree, 0)
+        accept = asym_in & (order_gain <= room[:, None])
+        final_edge = mutual | (accept & has_edge.T) | \
+            (accept.T & has_edge)
+        # rebuild peer lists from the edge matrix (id order)
+        rank_e = jnp.cumsum(final_edge, axis=1) - 1
+        slot_ok = final_edge & (rank_e < D)
+        peers = jnp.full((n, D), -1, jnp.int32).reshape(-1).at[
+            jnp.where(slot_ok, ids[:, None] * D + rank_e, n * D).reshape(-1)
+        ].set(jnp.broadcast_to(ids[None, :], (n, n)).reshape(-1),
+              mode="drop").reshape(n, D)
+        degree = jnp.sum(peers >= 0, axis=1).astype(jnp.int32)
+
+        # ---- capability changes (changeCap, :373-378) ----
+        chg_due = alive & (p.change_start > 0) & (t >= p.change_start) & \
+            ((t - p.change_start) % self.time_to_change == 0)
+        new_caps = _draw_caps(prng.hash3(p.seed, TAG_CHG + 2, t), n, C,
+                              self.cap_per_node)
+        caps = jnp.where(chg_due[:, None], new_caps, caps)
+
+        # ---- gossip own record (broadcastCapabilities, :369-371) ----
+        goss_due = alive & (t >= p.gossip_start) & \
+            ((t - p.gossip_start) % self.cap_gossip_time == 0)
+        bump = goss_due | chg_due
+        seq = seq + bump.astype(jnp.int32)
+        # own record rides the same pending queue (origin = self)
+        pending = pending.at[ids, ids].set(
+            jnp.where(bump, True, pending[ids, ids]))
+        pending_src = pending_src.at[ids, ids].set(
+            jnp.where(bump, ids, pending_src[ids, ids]))
+
+        # ---- forward one pending record per node per ms ----
+        pend_live = pending & alive[:, None]
+        has = jnp.any(pend_live, axis=1)
+        pick = jnp.argmax(pend_live, axis=1).astype(jnp.int32)
+        exclude = jnp.where(pick == ids, -1,
+                            pending_src.reshape(-1)[ids * n + pick])
+        payload = jnp.stack(
+            [pick, seen_seq[ids, pick]], axis=1).astype(jnp.int32)
+        payload = jnp.where((pick == ids)[:, None],
+                            jnp.stack([ids, seq], axis=1), payload)
+        dest, pl, size, delay = p2p.flood_fanout(
+            self.cfg, peers, has, exclude, payload, p.seed, t,
+            local_delay=10, delay_between=10)
+        pending = pending.at[ids, pick].set(
+            jnp.where(has, False, pending[ids, pick]))
+
+        # ---- done check (setDoneAt, :324-327; relative time) ----
+        full = self._fully_connected(
+            p.replace(peers=peers, degree=degree, caps=caps), nodes,
+            final_edge)
+        done_now = alive & full & (nodes.done_at == 0)
+        nodes = nodes.replace(done_at=jnp.where(
+            done_now, jnp.maximum(1, t - p.start_time),
+            nodes.done_at).astype(jnp.int32))
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=pl,
+                                             size=size, delay=delay)
+        return (p.replace(caps=caps, peers=peers, degree=degree, seq=seq,
+                          seen_seq=seen_seq, pending=pending,
+                          pending_src=pending_src), nodes, out)
+
+
+def cont_if_enr(net, pstate):
+    live = ~net.nodes.down
+    return jnp.any(live & (net.nodes.done_at == 0))

@@ -1,0 +1,171 @@
+"""OptimisticP2PSignature — naive signature flooding with verify-at-the-end.
+
+Reference: protocols/OptimisticP2PSignature.java (193 lines).  Every node
+floods its own signature; a node forwards each first-seen signature to all
+its peers except the sender, with a +1 ms send delay
+(onSig, :113-135); at `threshold` distinct signatures it stops forwarding
+and sets doneAt = time + 2*pairingTime (:128-131) — the optimistic
+aggregate-then-verify costing model described at :14-18.
+
+TPU-native state: `received` is an [N, W]-word bitset; the forward queue
+drains one signature id per node per ms (the reference forwards every new
+sig in the same event; a same-ms burst here spreads over the next few ms —
+statistical equivalence, SURVEY §7.4.3).  The first-arrival source is kept
+per signature for the exclude-sender rule, which bounds memory at
+[N, N] int32 — this protocol "sends a lot of messages so uses a lot of
+memory and [is] slow to test" (:19) in the reference too; it runs at
+hundreds-to-low-thousands of nodes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders, p2p
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset
+
+U32 = jnp.uint32
+
+
+@struct.dataclass
+class OptSigState:
+    seed: jnp.ndarray
+    peers: jnp.ndarray        # int32 [N, D]
+    degree: jnp.ndarray       # int32 [N]
+    received: jnp.ndarray     # u32 [N, W] verifiedSignatures
+    pending: jnp.ndarray      # u32 [N, W] — received, not yet forwarded
+    pending_src: jnp.ndarray  # int32 [N, N] — first sender per sig
+    done: jnp.ndarray         # bool [N]
+
+
+@register
+class OptimisticP2PSignature:
+    """Parameters mirror OptimisticP2PSignatureParameters (:32-74)."""
+
+    def __init__(self, node_count=100, threshold=99, connection_count=20,
+                 pairing_time=1, node_builder_name=None,
+                 network_latency_name=None, max_degree=None, inbox_cap=128,
+                 drain_rate=4, fanout_pacing_ms=1, horizon=512):
+        if node_count > 4096:
+            raise ValueError("OptimisticP2PSignature keeps an [N, N] "
+                             "first-sender matrix; use <= 4096 nodes")
+        self.node_count = node_count
+        self.threshold = threshold
+        self.connection_count = connection_count
+        self.pairing_time = pairing_time
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        self.max_degree = max_degree or max(2 * connection_count,
+                                            connection_count + 8)
+        # The reference forwards every new signature in the same event; a
+        # fixed-shape outbox forwards up to drain_rate queued signatures per
+        # ms instead — size it so the early avalanche doesn't strand sigs
+        # behind nodes that reach their threshold and stop forwarding.
+        self.drain_rate = drain_rate
+        # Spreading consecutive peer sends 1 ms apart bounds the per-(node,
+        # ms) delivery burst at the avalanche peak (the reference delivers
+        # unbounded same-ms bursts; its per-ms bucket is a linked list).
+        self.fanout_pacing_ms = fanout_pacing_ms
+        self.w = bitset.n_words(node_count)
+        self.cfg = EngineConfig(n=node_count, horizon=horizon,
+                                inbox_cap=inbox_cap, payload_words=1,
+                                out_deg=self.max_degree * drain_rate,
+                                bcast_slots=1)
+
+    def init(self, seed):
+        n, w = self.node_count, self.w
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        # P2PNetwork(connectionCount, false): average-degree construction.
+        peers, degree, _ = p2p.build_peer_graph(
+            seed, n, self.connection_count, minimum=False,
+            max_degree=self.max_degree)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        own = bitset.one_bit(ids, w)
+        net = init_net(self.cfg, nodes, seed)
+        return net, OptSigState(
+            seed=seed, peers=peers, degree=degree,
+            received=own, pending=own,
+            pending_src=jnp.broadcast_to(ids[:, None], (n, n)),
+            done=jnp.zeros((n,), bool))
+
+    def step(self, p: OptSigState, nodes, inbox, t, key):
+        n, w = self.node_count, self.w
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+
+        received, pending, pending_src = (p.received, p.pending,
+                                          p.pending_src)
+        for s in range(S):
+            ok = inbox.valid[:, s] & ~p.done & ~nodes.down
+            sig = jnp.clip(inbox.data[:, s, 0], 0, n - 1)
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            bit = bitset.one_bit(sig, w)
+            new = ok & ~bitset.intersects(received, bit)
+            received = jnp.where(new[:, None], received | bit, received)
+            pending = jnp.where(new[:, None], pending | bit, pending)
+            flat = ids * n + sig
+            pending_src = pending_src.reshape(-1).at[
+                jnp.where(new, flat, n * n)].set(src, mode="drop",
+                                                 unique_indices=True
+                                                 ).reshape(n, n)
+
+        # done at threshold: stop forwarding, doneAt = t + 2*pairing
+        # (:128-131).  Signatures queued before done are still dropped
+        # (onSig checks !done before forwarding).
+        count = bitset.popcount(received)
+        done_now = ~p.done & (count >= self.threshold)
+        done = p.done | done_now
+        nodes = nodes.replace(done_at=jnp.where(
+            done_now & (nodes.done_at == 0),
+            jnp.maximum(1, t + 2 * self.pairing_time),
+            nodes.done_at).astype(jnp.int32))
+        pending = jnp.where(done[:, None], U32(0), pending)
+
+        # Forward up to drain_rate pending sigs per node per ms (lowest id
+        # first), each fanned out to all peers except its first sender.
+        D = self.max_degree
+        dests, pls, sizes_, delays = [], [], [], []
+        fan_cfg = EngineConfig(n=n, out_deg=D, payload_words=1)
+        for _ in range(self.drain_rate):
+            has = jnp.any(pending != 0, axis=1)
+            word_has = pending != 0
+            first_word = jnp.argmax(word_has, axis=1).astype(jnp.int32)
+            word = jnp.take_along_axis(pending, first_word[:, None],
+                                       axis=1)[:, 0]
+            low = word & (~word + U32(1))      # lowest set bit
+            bitpos = 31 - jax.lax.clz(
+                jnp.maximum(low, U32(1)).astype(jnp.int32))
+            pick = jnp.clip(first_word * 32 + bitpos.astype(jnp.int32),
+                            0, n - 1)
+            exclude = pending_src.reshape(-1)[ids * n + pick]
+            payload = pick[:, None].astype(jnp.int32)
+            d_, p_, s_, dl_ = p2p.flood_fanout(
+                fan_cfg, p.peers, has, exclude, payload, p.seed, t,
+                local_delay=1, delay_between=self.fanout_pacing_ms,
+                size=4 + 48)
+            dests.append(d_); pls.append(p_)
+            sizes_.append(s_); delays.append(dl_)
+            clear = bitset.one_bit(pick, w)
+            pending = jnp.where(has[:, None], pending & ~clear, pending)
+
+        out = empty_outbox(self.cfg).replace(
+            dest=jnp.concatenate(dests, axis=1),
+            payload=jnp.concatenate(pls, axis=1),
+            size=jnp.concatenate(sizes_, axis=1),
+            delay=jnp.concatenate(delays, axis=1))
+        return (p.replace(received=received, pending=pending,
+                          pending_src=pending_src, done=done), nodes, out)
+
+    def done_pred(self, pstate, nodes):
+        return jnp.all(nodes.down | pstate.done)
+
+
+def cont_if_optimistic(net, pstate):
+    live = ~net.nodes.down
+    return jnp.any(live & ~pstate.done)

@@ -1,0 +1,323 @@
+"""P2PHandel — gossip BLS aggregation with peer-state tracking.
+
+Reference: protocols/P2PHandel.java (520 lines).  Mechanism (SURVEY.md
+§2.4): nodes keep a bitset view of every peer's verified set; every
+`sigsSendPeriod` ms each live node picks the peer with the largest diff
+(verified \\ peerState) and sends it that diff (bestDest/sendSigs,
+:334-379); incoming sets queue for verification; every `pairingTime` ms the
+queue is either scanned for the best new set (checkSigs1, :412-447) or
+fully or-aggregated and verified in one go (checkSigs2, :449-479, the
+default `doubleAggregateStrategy`); a verification completes 2*pairingTime
+later (updateVerifiedSignatures, :285-300); reaching the threshold sets
+doneAt and pushes the final aggregate to every peer still below threshold
+(sendFinalSigToPeers, :302-315).  Optional State broadcasts keep peers'
+views fresh (sendState, :120-143).  `relayingNodeCount` nodes relay without
+signing (:478-489).
+
+Send-size strategies {all, dif, cmp_all, cmp_diff} (:25-34) model
+signature-range compression.  `compressedSize` (:160-197) counts signatures
+after merging aligned full ranges of 2 bits; we compute the canonical
+dyadic decomposition over the pair tree — same compression model, minimal
+aligned segments (the reference's greedy left-to-right walk differs by at
+most one segment per run; statistical equivalence, SURVEY §7.4.3).
+
+TPU-native state: peer views are [N, D, W] bitset rows; the toVerify set is
+an or-accumulator row for checkSigs2 and a [N, Q, W] queue for checkSigs1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core import builders, p2p
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+from ..ops.flat import gather_rows, set2d, set_rows
+
+U32 = jnp.uint32
+TAG_RELAY = 0x52454C59
+
+ALL, DIF, CMP_ALL, CMP_DIFF = "all", "dif", "cmp_all", "cmp_diff"
+
+
+def compressed_size(bits_rows, n_signing):
+    """Canonical aligned-range compression count (compressedSize,
+    P2PHandel.java:160-197, range size 2): full aligned dyadic blocks of
+    pairs count once; bits in partial pairs count individually.  Fully
+    complete sets cost exactly 1 (:167-171)."""
+    pc = bitset.popcount(bits_rows)
+    # pairs[k]: [..., n/2^k] "block fully set" masks, built level by level.
+    w = bits_rows.shape[-1]
+    lvl = []
+    # Level 0: pairs of bits. even/odd bit masks within words.
+    even = bits_rows & U32(0x55555555)
+    odd = bits_rows & U32(0xAAAAAAAA)
+    full_pair = ((even << U32(1)) & odd)               # bit 2k+1 set if pair k full
+    # count of full pairs per row:
+    full = jax.lax.population_count(full_pair)
+    n_full_pairs = jnp.sum(full.astype(jnp.int32), axis=-1)
+    bits_in_partial = pc - 2 * n_full_pairs
+    # Segments among full pairs: canonical dyadic decomposition counted via
+    # levels: a level-k block (2^k pairs) is a segment iff full at k and its
+    # buddy is not full at k (i.e. parent not full).  Number of segments =
+    # sum over levels of (full_k - 2 * full_{k+1}).
+    # Work on a bool array of pairs [..., P].
+    P = w * 16
+    pair_idx = jnp.arange(P, dtype=jnp.int32)
+    word_i = pair_idx // 16
+    bit_i = (pair_idx % 16) * 2 + 1
+    pairs = (jnp.take(full_pair, word_i, axis=-1) >>
+             bit_i.astype(U32)) & U32(1)
+    pairs = pairs.astype(jnp.int32)                    # [..., P]
+    segments = jnp.zeros(pc.shape, jnp.int32)
+    cur = pairs
+    while cur.shape[-1] >= 1:
+        cnt = jnp.sum(cur, axis=-1)
+        if cur.shape[-1] == 1:
+            segments = segments + cnt
+            break
+        if cur.shape[-1] % 2:
+            # Odd level length: the last block has no buddy — pad with an
+            # empty block so 0::2/1::2 pair true dyadic buddies.
+            cur = jnp.concatenate(
+                [cur, jnp.zeros(cur.shape[:-1] + (1,), cur.dtype)], axis=-1)
+        nxt = cur[..., 0::2] * cur[..., 1::2]          # parent full
+        segments = segments + (cnt - 2 * jnp.sum(nxt, axis=-1))
+        cur = nxt
+    total = bits_in_partial + segments
+    return jnp.where(pc >= n_signing, 1, jnp.maximum(total, 1))
+
+
+@struct.dataclass
+class P2PHandelState:
+    seed: jnp.ndarray
+    peers: jnp.ndarray         # int32 [N, D]
+    degree: jnp.ndarray       # int32 [N]
+    just_relay: jnp.ndarray   # bool [N]
+    verified: jnp.ndarray     # u32 [N, W]
+    peer_state: jnp.ndarray   # u32 [N, D, W] — our view of each peer
+    acc: jnp.ndarray          # u32 [N, W] — checkSigs2 or-accumulator
+    has_acc: jnp.ndarray      # bool [N]
+    q_sig: jnp.ndarray        # u32 [N, Q, W] — checkSigs1 queue
+    q_used: jnp.ndarray       # bool [N, Q]
+    pend_sig: jnp.ndarray     # u32 [N, W]
+    pend_at: jnp.ndarray      # int32 [N]
+    pend_on: jnp.ndarray      # bool [N]
+
+
+@register
+class P2PHandel:
+    """Parameters mirror P2PHandelParameters (P2PHandel.java:37-112)."""
+
+    def __init__(self, signing_node_count=100, relaying_node_count=20,
+                 threshold=99, connection_count=40, pairing_time=100,
+                 sigs_send_period=1000, double_aggregate_strategy=True,
+                 send_sigs_strategy=DIF, send_state=False,
+                 node_builder_name=None, network_latency_name=None,
+                 max_degree=None, queue_cap=8, inbox_cap=32, horizon=2048):
+        self.n_sign = signing_node_count
+        self.n_relay = relaying_node_count
+        self.node_count = signing_node_count + relaying_node_count
+        self.threshold = threshold
+        self.connection_count = connection_count
+        self.pairing_time = pairing_time
+        self.period = sigs_send_period
+        self.double_agg = double_aggregate_strategy
+        self.strategy = send_sigs_strategy
+        self.send_state = send_state
+        self.queue_cap = queue_cap
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        self.max_degree = max_degree or max(4 * connection_count,
+                                            connection_count + 16)
+        # Signature bits live in the full node-id space: the reference's
+        # signers are "all nodes not chosen as relays", whatever their ids
+        # (init :478-489), and its BitSet grows on demand.
+        self.w = bitset.n_words(self.node_count)
+        self.cfg = EngineConfig(
+            n=self.node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=1, out_deg=self.max_degree + 1, bcast_slots=1)
+
+    def init(self, seed):
+        n, w, D, Q = self.node_count, self.w, self.max_degree, self.queue_cap
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        peers, degree, _ = p2p.build_peer_graph(
+            seed, n, self.connection_count, minimum=False, max_degree=D)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        # relayingNodeCount distinct random relays (P2PHandel.init:482-487).
+        pri = prng.uniform_u32(prng.hash2(seed, TAG_RELAY), ids)
+        just_relay = jnp.zeros((n,), bool).at[
+            jnp.argsort(pri)[:self.n_relay]].set(True)
+        own = jnp.where(~just_relay[:, None], bitset.one_bit(ids, w), U32(0))
+        net = init_net(self.cfg, nodes, seed)
+        return net, P2PHandelState(
+            seed=seed, peers=peers, degree=degree, just_relay=just_relay,
+            verified=own,
+            peer_state=jnp.zeros((n, D, w), U32),
+            acc=jnp.zeros((n, w), U32), has_acc=jnp.zeros((n,), bool),
+            q_sig=jnp.zeros((n, Q, w), U32),
+            q_used=jnp.zeros((n, Q), bool),
+            pend_sig=jnp.zeros((n, w), U32),
+            pend_at=jnp.zeros((n,), jnp.int32),
+            pend_on=jnp.zeros((n,), bool))
+
+    # ------------------------------------------------------------------
+
+    def _peer_slot(self, peers, src):
+        """Index d with peers[i, d] == src[i] (or D if absent)."""
+        hit = peers == src[:, None]
+        return jnp.where(jnp.any(hit, axis=1),
+                         jnp.argmax(hit, axis=1), peers.shape[1])
+
+    def step(self, p: P2PHandelState, nodes, inbox, t, key):
+        n, w, D, Q = self.node_count, self.w, self.max_degree, self.queue_cap
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+        alive = ~nodes.down
+
+        # ---- receive: State (kind 1) or SendSigs (kind 0) carrying the
+        # sender's set; sets ride in a snapshot-free way: the payload is
+        # (kind, unused) and the actual bits are the sender's CURRENT
+        # verified set — we gather it directly (single-process simulation;
+        # in-flight staleness is ~latency, same order as the reference's
+        # cloned bitsets).
+        peer_state, acc, has_acc = p.peer_state, p.acc, p.has_acc
+        q_sig, q_used = p.q_sig, p.q_used
+        for s in range(S):
+            ok = inbox.valid[:, s] & alive
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            kind = inbox.data[:, s, 0]
+            sig = p.verified[src]                       # [N, W] sender's set
+            slot = self._peer_slot(p.peers, src)
+            in_peers = ok & (slot < D)
+            # peersState[from] |= sigs (onPeerState :280 / onNewSig :327-331)
+            upd = gather_rows(peer_state, ids, jnp.minimum(slot, D - 1))
+            upd = upd | sig
+            peer_state = set_rows(peer_state, ids, jnp.minimum(slot, D - 1),
+                                  upd, ok=in_peers)
+            is_sigs = ok & (kind == 0)
+            if self.double_agg:
+                acc = jnp.where(is_sigs[:, None], acc | sig, acc)
+                has_acc = has_acc | is_sigs
+            else:
+                free = ~q_used
+                any_free = jnp.any(free, axis=1)
+                qslot = jnp.where(any_free, jnp.argmax(free, axis=1), 0)
+                ins = is_sigs & any_free   # full queue drops (rare; Q-sized)
+                q_sig = set_rows(q_sig, ids, qslot, sig, ok=ins)
+                q_used = set2d(q_used, ids, qslot, True, ok=ins)
+
+        # ---- conditional checkSigs every pairingTime (init :492-494) ----
+        due = alive & (t >= 1) & ((t - 1) % self.pairing_time == 0) & \
+            (nodes.done_at == 0) & ~p.pend_on
+        if self.double_agg:
+            new_bits = acc & ~p.verified
+            go = due & has_acc & jnp.any(new_bits != 0, axis=1)
+            pend_sig = jnp.where(go[:, None], acc, p.pend_sig)
+            acc = jnp.where(due[:, None], U32(0), acc)
+            has_acc = has_acc & ~due
+        else:
+            gain = bitset.popcount(
+                jnp.where(q_used[..., None], q_sig & ~p.verified[:, None, :],
+                          U32(0)))                       # [N, Q]
+            best = jnp.argmax(gain, axis=1)
+            best_gain = jnp.take_along_axis(gain, best[:, None],
+                                            axis=1)[:, 0]
+            go = due & (best_gain > 0)
+            pend_sig = jnp.where(go[:, None],
+                                 gather_rows(q_sig, ids, best), p.pend_sig)
+            # curation: drop zero-gain entries; picked one removed
+            q_used = jnp.where(due[:, None] & (gain == 0), False, q_used)
+            q_used = set2d(q_used, ids, best, False, ok=go)
+        pend_at = jnp.where(go, t + 2 * self.pairing_time, p.pend_at)
+        pend_on = p.pend_on | go
+
+        # ---- apply verification (updateVerifiedSignatures :285-300) ----
+        app = pend_on & (t >= pend_at)
+        old_card = bitset.popcount(p.verified)
+        verified = jnp.where(app[:, None], p.verified | pend_sig, p.verified)
+        new_card = bitset.popcount(verified)
+        improved = app & (new_card > old_card)
+        pend_on = pend_on & ~app
+        reach = improved & (nodes.done_at == 0) & (new_card >= self.threshold)
+        nodes = nodes.replace(done_at=jnp.where(
+            reach, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
+        # Burst flags are step-local: set and fully consumed this ms (the
+        # reference sends inside updateVerifiedSignatures).
+        final_burst = reach
+        state_burst = (improved & ~reach & (nodes.done_at == 0)
+                       & self.send_state)
+
+        # ---- outbox: burst sends + periodic sendSigs ----
+        K = self.cfg.out_deg
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, 1), jnp.int32)
+        sizes = jnp.ones((n, K), jnp.int32)
+        peer_ok = p.peers >= 0                            # [N, D]
+        psrc = jnp.clip(p.peers, 0, n - 1)
+
+        # final sig to peers below threshold (:302-315), size 1 — fires the
+        # same step the threshold is reached (reference sends it inside
+        # updateVerifiedSignatures).
+        lag = bitset.popcount(peer_state) < self.threshold  # [N, D]
+        fsend = final_burst[:, None] & peer_ok & lag
+        peer_state = jnp.where(fsend[..., None],
+                               peer_state | verified[:, None, :], peer_state)
+        # state broadcast (sendStateToPeers :317-320 + init kick :489-491)
+        skick = alive & (t == 1) & self.send_state
+        ssend = (state_burst | skick) & ~final_burst
+        sdest = jnp.where(ssend[:, None] & peer_ok, psrc, -1)
+        dest = dest.at[:, :D].set(jnp.where(fsend, psrc, sdest))
+        payload = payload.at[:, :D, 0].set(
+            jnp.where(fsend, 0, 1))
+        st_size = jnp.maximum(1, (self.n_sign + 7) // 8)
+        sizes = sizes.at[:, :D].set(jnp.where(fsend, 1, st_size))
+
+        # periodic sendSigs (:334-379): best peer by diff cardinality
+        per = alive & (t >= 1) & ((t - 1) % self.period == 0) & \
+            (nodes.done_at == 0)
+        diff = jnp.where(peer_ok[..., None],
+                         verified[:, None, :] & ~peer_state, U32(0))
+        dcard = bitset.popcount(diff)                     # [N, D]
+        bestp = jnp.argmax(dcard, axis=1)
+        bestc = jnp.take_along_axis(dcard, bestp[:, None], axis=1)[:, 0]
+        send1 = per & (bestc > 0)
+        d1 = jnp.where(send1,
+                       jnp.take_along_axis(psrc, bestp[:, None],
+                                           axis=1)[:, 0], -1)
+        if self.strategy == DIF:
+            msize = bestc
+        elif self.strategy == CMP_ALL:
+            msize = compressed_size(verified, self.n_sign)
+        elif self.strategy == CMP_DIFF:
+            bdiff = gather_rows(diff, ids, bestp)
+            msize = jnp.minimum(compressed_size(verified, self.n_sign),
+                                compressed_size(bdiff, self.n_sign))
+        else:                                             # ALL
+            msize = bitset.popcount(verified)
+        dest = dest.at[:, D].set(d1)
+        payload = payload.at[:, D, 0].set(0)
+        sizes = sizes.at[:, D].set(jnp.maximum(1, msize))
+        # we assume the peer receives it (:352-355)
+        peer_state = jnp.where(
+            (send1[:, None] & (jnp.arange(D)[None, :] == bestp[:, None])
+             )[..., None],
+            peer_state | verified[:, None, :], peer_state)
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
+                                             size=sizes)
+        return (p.replace(peer_state=peer_state, acc=acc, has_acc=has_acc,
+                          q_sig=q_sig, q_used=q_used, verified=verified,
+                          pend_sig=pend_sig, pend_at=pend_at,
+                          pend_on=pend_on),
+                nodes, out)
+
+
+def cont_if_p2phandel(net, pstate):
+    live = ~net.nodes.down
+    return jnp.any(live & (net.nodes.done_at == 0))
