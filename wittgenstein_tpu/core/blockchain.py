@@ -1,0 +1,202 @@
+"""Blockchain core layer: block arena + per-node chain state.
+
+Reference surface (SURVEY.md §2.1): core/Block.java (height, id, parent,
+producer, valid, proposalTime; isAncestor :69-79, hasDirectLink :86-100),
+core/BlockChainNode.java (blocks by id/father/height, head, abstract
+fork-choice `best` :50, onBlock dedup/validity :29-45), and
+core/BlockChainNetwork.java (observer node, SendBlock message :22-41, full
+head re-broadcast on endPartition :47-55, printStat :57-104).
+
+TPU-native design (SURVEY §7.2.6): blocks live in one global **arena** of
+fixed capacity A — a struct-of-arrays of int records; the block id IS the
+arena slot (the reference's global `blockId` counter, Block.java:10).
+Per-node chain knowledge is a `[N, A/32]` received-bitset plus a `[N]` head
+index.  Ancestor logic is vectorized parent-pointer walking under
+`lax.while_loop` (bounded by the chain height).  Protocols attach their own
+parallel columns (difficulty, uncles, attestations...) next to the arena.
+
+Chain *statistics* (blocks per producer, rewards, tx counts) are host-side
+numpy walks over the frozen arena — they run once per experiment, not per
+simulated ms (printStat parity, BlockChainNetwork.java:57-104).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops import bitset
+
+U32 = jnp.uint32
+
+
+@struct.dataclass
+class Arena:
+    """Global block table.  Slot 0 is the genesis block."""
+
+    height: jnp.ndarray    # int32 [A]
+    parent: jnp.ndarray    # int32 [A] (-1 for genesis)
+    producer: jnp.ndarray  # int32 [A] (-1 for genesis)
+    valid: jnp.ndarray     # bool [A]
+    time: jnp.ndarray      # int32 [A] — proposalTime (engine ticks)
+    n: jnp.ndarray         # int32 scalar — blocks allocated (incl. genesis)
+    dropped: jnp.ndarray   # int32 scalar — allocations lost to a full arena
+
+    @property
+    def capacity(self):
+        return self.height.shape[0]
+
+
+def make_arena(capacity: int, genesis_height: int = 0) -> Arena:
+    return Arena(
+        height=jnp.zeros((capacity,), jnp.int32).at[0].set(genesis_height),
+        parent=jnp.full((capacity,), -1, jnp.int32),
+        producer=jnp.full((capacity,), -1, jnp.int32),
+        valid=jnp.zeros((capacity,), bool).at[0].set(True),
+        time=jnp.zeros((capacity,), jnp.int32),
+        n=jnp.asarray(1, jnp.int32),
+        dropped=jnp.asarray(0, jnp.int32),
+    )
+
+
+def alloc(arena: Arena, want, parent, producer, t, valid=None):
+    """Allocate one block per requesting node (want [N] bool).
+
+    Returns (arena, ids [N]) where ids[i] = -1 if i allocated nothing.
+    Slot order follows node order within the tick — deterministic.
+    """
+    a = arena.capacity
+    nreq = want.shape[0]
+    rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    slot = arena.n + rank
+    ok = want & (slot < a)
+    slot_w = jnp.where(ok, slot, a)
+    height = jnp.where(parent >= 0, arena.height[jnp.maximum(parent, 0)] + 1,
+                       1)
+    if valid is None:
+        valid = jnp.ones((nreq,), bool)
+    arena = arena.replace(
+        height=arena.height.at[slot_w].set(height, mode="drop"),
+        parent=arena.parent.at[slot_w].set(parent, mode="drop"),
+        producer=arena.producer.at[slot_w].set(producer, mode="drop"),
+        valid=arena.valid.at[slot_w].set(valid, mode="drop"),
+        time=arena.time.at[slot_w].set(
+            jnp.broadcast_to(t, (nreq,)).astype(jnp.int32), mode="drop"),
+        n=arena.n + jnp.sum(ok).astype(jnp.int32),
+        dropped=arena.dropped + jnp.sum(want & ~ok).astype(jnp.int32),
+    )
+    return arena, jnp.where(ok, slot, -1)
+
+
+def walk_to_height(arena: Arena, b, h):
+    """Vectorized `while (cur.height > h) cur = cur.parent` (Block.java:
+    72-78).  b, h broadcastable int32 arrays; -1 propagates."""
+    b = jnp.asarray(b, jnp.int32)
+    h = jnp.broadcast_to(jnp.asarray(h, jnp.int32), b.shape)
+
+    def cond(cur):
+        return jnp.any((cur >= 0) & (arena.height[jnp.maximum(cur, 0)] > h))
+
+    def body(cur):
+        step = (cur >= 0) & (arena.height[jnp.maximum(cur, 0)] > h)
+        return jnp.where(step, arena.parent[jnp.maximum(cur, 0)], cur)
+
+    return jax.lax.while_loop(cond, body, b)
+
+
+def is_ancestor(arena: Arena, a, b):
+    """True where block a is a strict ancestor of block b (Block.java:
+    69-79)."""
+    a = jnp.asarray(a, jnp.int32)
+    up = walk_to_height(arena, b, arena.height[jnp.maximum(a, 0)])
+    return (up == a) & (jnp.asarray(b) != a)
+
+
+def has_direct_link(arena: Arena, a, b):
+    """True where one of a, b is an ancestor of (or equal to) the other
+    (Block.java:86-100)."""
+    eq = jnp.asarray(a) == jnp.asarray(b)
+    return eq | is_ancestor(arena, a, b) | is_ancestor(arena, b, a)
+
+
+def common_ancestor(arena: Arena, a, b):
+    """Lowest common ancestor of two blocks (vectorized)."""
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    ha = arena.height[jnp.maximum(a, 0)]
+    hb = arena.height[jnp.maximum(b, 0)]
+    h = jnp.minimum(ha, hb)
+    a = walk_to_height(arena, a, h)
+    b = walk_to_height(arena, b, h)
+
+    def cond(st):
+        x, y = st
+        return jnp.any((x != y) & (x >= 0) & (y >= 0))
+
+    def body(st):
+        x, y = st
+        step = (x != y) & (x >= 0) & (y >= 0)
+        return (jnp.where(step, arena.parent[jnp.maximum(x, 0)], x),
+                jnp.where(step, arena.parent[jnp.maximum(y, 0)], y))
+
+    a, b = jax.lax.while_loop(cond, body, (a, b))
+    return jnp.where(a == b, a, -1)
+
+
+# ---------------------------------------------------------------- per-node
+
+def n_words(capacity: int) -> int:
+    return bitset.n_words(capacity)
+
+
+def receive_block(received, ids_row, block_id, ok):
+    """Mark block_id received for the masked nodes; returns (received,
+    was_new [N])."""
+    w = received.shape[-1]
+    bit = bitset.one_bit(jnp.maximum(block_id, 0), w)
+    known = bitset.intersects(received, bit)
+    new = ok & (block_id >= 0) & ~known
+    return jnp.where(new[:, None], received | bit, received), new
+
+
+# ---------------------------------------------------------------- host side
+
+def to_numpy(arena: Arena) -> dict:
+    return {k: np.asarray(getattr(arena, k))
+            for k in ("height", "parent", "producer", "valid", "time")} | {
+            "n": int(arena.n)}
+
+
+def chain_ids(arena_np: dict, head: int) -> list:
+    """Block ids on the chain from head down to (excluding) genesis."""
+    out, cur = [], int(head)
+    while cur > 0:
+        out.append(cur)
+        cur = int(arena_np["parent"][cur])
+    return out
+
+
+def print_stat(arena_np: dict, head: int, node_info=None, small=True,
+               out=print):
+    """printStat parity (BlockChainNetwork.java:57-104): blocks in the
+    observer's chain, per-producer counts."""
+    chain = chain_ids(arena_np, head)
+    producers = {}
+    for b in chain:
+        if not small:
+            out(f"block: h:{arena_np['height'][b]}, id={b}, "
+                f"creationTime:{arena_np['time'][b]}, "
+                f"producer={arena_np['producer'][b]}, "
+                f"parent:{arena_np['parent'][b]}")
+        producers.setdefault(int(arena_np["producer"][b]), []).append(b)
+    if not small:
+        out(f"block count:{len(chain)} on {arena_np['n']}")
+    for pid in sorted(producers):
+        line = f"producer {pid}; {len(producers[pid])} blocks"
+        if node_info:
+            line += f"; {node_info(pid)}"
+        out(line)
+    return {"blocks_in_chain": len(chain),
+            "per_producer": {k: len(v) for k, v in producers.items()}}

@@ -42,7 +42,7 @@ TAG_JOIN = 0x454E4331
 TAG_EXIT = 0x454E4332
 TAG_GOSS = 0x454E4333
 TAG_CHG = 0x454E4334
-TAG_LINK = 0x454E4335
+TAG_CHG_START = 0x454E4336
 
 PEERS_PER_CAP = 3
 
@@ -162,7 +162,7 @@ class ENRGossiping:
                                               dtype=jnp.int32))
             chosen = jnp.argsort(pri)[:n_chg]
             chg = chg.at[chosen].set(True)
-        chg_start = prng.uniform_int(prng.hash2(seed, TAG_CHG + 1), ids,
+        chg_start = prng.uniform_int(prng.hash2(seed, TAG_CHG_START), ids,
                                      self.time_to_change) + 1
         change_start = jnp.where(chg, chg_start, 0).astype(jnp.int32)
 
@@ -186,8 +186,11 @@ class ENRGossiping:
         return jnp.sum(peer_caps, axis=1).astype(jnp.int32)    # [N, C]
 
     def _score_of(self, caps, cnt):
-        """score(peers) = sum over own caps of min(count, 3) (:395-400)."""
-        return jnp.sum(jnp.where(caps, jnp.minimum(cnt, PEERS_PER_CAP), 0),
+        """score(peers) (ENRGossiping.java:395-409): the reference walks the
+        found-list WITH duplicates — a capability held by k matching peers
+        contributes k * min(k, 3)."""
+        return jnp.sum(jnp.where(caps,
+                                 cnt * jnp.minimum(cnt, PEERS_PER_CAP), 0),
                        axis=-1).astype(jnp.int32)
 
     def _fully_connected(self, p, nodes, adj):
@@ -261,6 +264,7 @@ class ENRGossiping:
         # ---- receive records ----
         seen_seq, pending, pending_src = p.seen_seq, p.pending, p.pending_src
         caps, seq = p.caps, p.seq
+        removed = jnp.zeros((n, n), bool)   # links dropped by removeWorse
         cnt = self._score_counts(p.replace(peers=peers), caps)
         base_score = self._score_of(caps, cnt)
         for s in range(S):
@@ -304,8 +308,13 @@ class ENRGossiping:
             best_gain = jnp.take_along_axis(repl_score, best_repl[:, None],
                                             axis=1)[:, 0] - base_score
             do_repl = want & ~has_room & (best_gain > 0)
-            # drop the replaced link (one side; the other side's stale slot
-            # is cleaned by the periodic symmetrization below)
+            # drop the replaced link; record it so the symmetric rebuild
+            # removes BOTH directions (removeLink, :415-424)
+            repl_peer = jnp.take_along_axis(
+                jnp.maximum(peers, 0), best_repl[:, None], axis=1)[:, 0]
+            removed = removed.reshape(-1).at[
+                jnp.where(do_repl, ids * n + repl_peer, n * n)].set(
+                True, mode="drop").reshape(n, n)
             peers = jnp.where(
                 (do_repl[:, None] &
                  (jnp.arange(D)[None, :] == best_repl[:, None])),
@@ -328,14 +337,11 @@ class ENRGossiping:
         has_edge = jnp.zeros((n, n), bool).reshape(-1).at[
             jnp.where(peers >= 0, ids[:, None] * n + jnp.maximum(peers, 0),
                       n * n).reshape(-1)].set(True, mode="drop").reshape(n, n)
-        mutual = has_edge & has_edge.T
-        asym_in = has_edge.T & ~has_edge          # they list us, we don't
-        # accept reciprocal links while we have room, in id order
-        order_gain = jnp.cumsum(asym_in, axis=1)
-        room = jnp.maximum(self.max_peers - degree, 0)
-        accept = asym_in & (order_gain <= room[:, None])
-        final_edge = mutual | (accept & has_edge.T) | \
-            (accept.T & has_edge)
+        # createLink adds BOTH directions unconditionally (:150-158,
+        # :362-366) — maxPeers only gates the onFlood connect decision, so
+        # the union of the two directed views is the true edge set (a node
+        # may temporarily exceed maxPeers, as in the reference).
+        final_edge = (has_edge | has_edge.T) & ~(removed | removed.T)
         # rebuild peer lists from the edge matrix (id order)
         rank_e = jnp.cumsum(final_edge, axis=1) - 1
         slot_ok = final_edge & (rank_e < D)
@@ -348,7 +354,7 @@ class ENRGossiping:
         # ---- capability changes (changeCap, :373-378) ----
         chg_due = alive & (p.change_start > 0) & (t >= p.change_start) & \
             ((t - p.change_start) % self.time_to_change == 0)
-        new_caps = _draw_caps(prng.hash3(p.seed, TAG_CHG + 2, t), n, C,
+        new_caps = _draw_caps(prng.hash3(p.seed, TAG_CHG_START + 1, t), n, C,
                               self.cap_per_node)
         caps = jnp.where(chg_due[:, None], new_caps, caps)
 

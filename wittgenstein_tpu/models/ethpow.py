@@ -1,0 +1,561 @@
+"""Ethereum Proof-of-Work mining with honest and selfish-miner strategies.
+
+Reference: protocols/ethpow/ — ETHPoW.java (375), ETHMiner.java (309),
+ETHSelfishMiner.java (138), ETHSelfishMiner2.java (104).  Mechanism
+(SURVEY.md §2.4): every miner runs a 10 ms periodic mining tick — a
+bernoulli draw with p = solveIn10ms(difficulty) from its hash power
+(ETHMiner.mine10ms :118-129, solveIn10ms :225-231); blocks carry
+Constantinople difficulty + bomb (ETHPoW.calculateDifficulty :283-296) and
+up to two uncles chosen from received sibling blocks (possibleUncles
+:66-115, UncleCmp :97-115); fork choice is total difficulty
+(POWBlockComparator :300-310, best :337-348); strategy hooks
+(sendMinedBlock / switchMining / onMinedBlock / onReceivedBlock) implement
+the Eyal-Sirer selfish miner and a total-difficulty-aware variant
+(ETHSelfishMiner.java:28-115, ETHSelfishMiner2.java:12-80).
+
+TPU-native design:
+* One engine tick = `tick_ms` (default 10) simulated ms — the reference's
+  mining period; latencies are ceil-scaled into ticks (class _TickScaled).
+* Blocks live in the shared arena (core/blockchain.py) + POW columns:
+  scaled difficulty (raw / 2^21 fits int32; relative error < 1e-8), total
+  difficulty relative to genesis as an exact int32 fixed-point pair, two
+  uncle slots.
+* Strategies are a per-node enum {HONEST, SELFISH, SELFISH2} executed with
+  masks — all miners run the same vectorized step.
+* sendAll of a block is one broadcast-table entry (O(1) state); multi-block
+  releases (sendAllMined) drain one block per tick, parents first — a
+  <= few-tick stagger, negligible against the ~13 s block interval.
+* Miners always restart mining on their current head, which for a selfish
+  miner includes its private chain (it onBlock()s its own blocks) — the
+  reference's explicit startNewMining(privateMinerBlock) lands on the same
+  block except in transient races (statistical equivalence, SURVEY §7.4.3).
+
+Operational note: keep Runner chunks <= ~10_000 ticks on TPU — this model's
+step body is control-flow heavy (strategy while_loops) and very long
+single scans have crashed the current TPU runtime; chunking costs nothing.
+Blockchain sims run at 5-10k nodes max in the reference (CasperIMD.java:714)
+and N~10 miners here, so the TPU win comes from vmapping seeds/sweeps, not
+from node-axis width.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import blockchain as bc
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+
+U32 = jnp.uint32
+TAG_MINE = 0x504F5731
+
+HONEST, SELFISH, SELFISH2 = 0, 1, 2
+STRATEGIES = {"": HONEST, None: HONEST, "ETHMiner": HONEST,
+              "ETHSelfishMiner": SELFISH, "ETHSelfishMiner2": SELFISH2}
+
+GENESIS_HEIGHT = 7_951_081                  # POWBlock genesis (:158-165)
+GENESIS_DIFF_RAW = 1_949_482_043_446_410
+DIFF_SHIFT = 21                             # raw difficulty / 2^21 -> int32
+GENESIS_DIFF_S = int(round(GENESIS_DIFF_RAW / 2 ** DIFF_SHIFT))
+TOTAL_HASH_POWER = 200 * 1024               # GH/s (ETHPoW.init :72)
+
+
+class _TickScaled:
+    """Wraps a ms latency model: output is ceil-divided into engine ticks."""
+
+    def __init__(self, inner, tick_ms):
+        self.inner = inner
+        self.tick_ms = tick_ms
+        self.name = f"TickScaled({inner!r}, {tick_ms})"
+
+    def validate(self, nodes):
+        v = getattr(self.inner, "validate", None)
+        if v is not None:
+            v(nodes)
+
+    def extended(self, nodes, src, dst, delta):
+        ms = self.inner.extended(nodes, src, dst, delta)
+        return -(-ms // self.tick_ms)
+
+    def __repr__(self):
+        return self.name
+
+
+
+def _td_gt(p, a, b):
+    """total_difficulty[a] > total_difficulty[b], exact (int32 pair)."""
+    aw_, bw_ = jnp.maximum(a, 0), jnp.maximum(b, 0)
+    return ((p.td_hi[aw_] > p.td_hi[bw_]) |
+            ((p.td_hi[aw_] == p.td_hi[bw_]) & (p.td_lo[aw_] > p.td_lo[bw_])))
+
+
+def _td_eq(p, a, b):
+    aw_, bw_ = jnp.maximum(a, 0), jnp.maximum(b, 0)
+    return (p.td_hi[aw_] == p.td_hi[bw_]) & (p.td_lo[aw_] == p.td_lo[bw_])
+
+
+@struct.dataclass
+class PoWState:
+    seed: jnp.ndarray
+    arena: bc.Arena
+    diff_s: jnp.ndarray        # int32 [A] — scaled block difficulty
+    # Total difficulty above genesis, EXACT fixed point: value =
+    # td_hi * 2^30 + td_lo in 2^DIFF_SHIFT raw units (float32 ulp outgrows
+    # per-block deltas after a few thousand blocks; the selfish-miner
+    # experiments run for hundreds of simulated hours).
+    td_hi: jnp.ndarray         # int32 [A]
+    td_lo: jnp.ndarray         # int32 [A], in [0, 2^30)
+    u1: jnp.ndarray            # int32 [A] uncle slots (-1 = none)
+    u2: jnp.ndarray
+    received: jnp.ndarray      # u32 [N, Aw]
+    head: jnp.ndarray          # int32 [N]
+    min_father: jnp.ndarray    # int32 [N] (-1 = not mining)
+    min_u1: jnp.ndarray        # int32 [N]
+    min_u2: jnp.ndarray
+    min_diff: jnp.ndarray      # int32 [N] scaled difficulty of the candidate
+    thr: jnp.ndarray           # f32 [N] solveIn10ms probability
+    mined_unsent: jnp.ndarray  # u32 [N, Aw] — minedToSend
+    release: jnp.ndarray       # u32 [N, Aw] — queued sendAll broadcasts
+    private_blk: jnp.ndarray   # int32 [N] (-1 = none)
+    others_head: jnp.ndarray   # int32 [N]
+    hash_power: jnp.ndarray    # int32 [N] GH/s
+    strategy: jnp.ndarray      # int32 [N]
+
+
+@register
+class ETHPoW:
+    """Parameters mirror ETHPoWParameters (ETHPoW.java:14-42).  Node 0 is
+    the observer (no hash power); the byzantine miner is node 1 (:66-68)."""
+
+    def __init__(self, number_of_miners=10, byz_class_name=None,
+                 byz_mining_ratio=0.0, node_builder_name=None,
+                 network_latency_name=None, tick_ms=10, capacity=4096,
+                 inbox_cap=2, bcast_slots=12, horizon=1024):
+        if byz_class_name not in STRATEGIES:
+            raise ValueError(f"unknown byzantine miner {byz_class_name!r}; "
+                             f"known: {sorted(k for k in STRATEGIES if k)}")
+        self.n_miners = number_of_miners
+        self.node_count = number_of_miners
+        self.byz_strategy = STRATEGIES[byz_class_name]
+        # Any non-empty byzClassName gives node 1 the byz hash power — the
+        # reference's honest control experiment uses byzClassName=ETHMiner
+        # with a nonzero ratio (ETHPoW.java:72-90, tryMiner).
+        self.has_byz = byz_class_name not in (None, "")
+        self.byz_ratio = byz_mining_ratio if self.has_byz else 0.0
+        self.tick_ms = tick_ms
+        self.capacity = capacity
+        self.aw = bc.n_words(capacity)
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = _TickScaled(
+            latency_mod.get_by_name(network_latency_name), tick_ms)
+        self.cfg = EngineConfig(
+            n=self.node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=1, out_deg=1, bcast_slots=bcast_slots)
+
+    def init(self, seed):
+        n, a, aw = self.node_count, self.capacity, self.aw
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        # Hash power split (ETHPoW.init :71-75); node 0 observes (0 GH/s).
+        byz_hp = int(TOTAL_HASH_POWER * self.byz_ratio)
+        honest_ct = max(1, (self.n_miners - 1) - (1 if byz_hp else 0))
+        honest_hp = (TOTAL_HASH_POWER - byz_hp) // honest_ct
+        hp = jnp.full((n,), honest_hp, jnp.int32)
+        hp = hp.at[0].set(0)
+        strategy = jnp.zeros((n,), jnp.int32)
+        if self.has_byz and n > 1:
+            hp = hp.at[1].set(byz_hp)
+            strategy = strategy.at[1].set(self.byz_strategy)
+
+        arena = bc.make_arena(a, genesis_height=GENESIS_HEIGHT)
+        net = init_net(self.cfg, nodes, seed)
+        genesis_bit = bitset.one_bit(jnp.zeros((n,), jnp.int32), aw)
+        return net, PoWState(
+            seed=seed, arena=arena,
+            diff_s=jnp.zeros((a,), jnp.int32).at[0].set(GENESIS_DIFF_S),
+            td_hi=jnp.zeros((a,), jnp.int32),
+            td_lo=jnp.zeros((a,), jnp.int32),
+            u1=jnp.full((a,), -1, jnp.int32),
+            u2=jnp.full((a,), -1, jnp.int32),
+            received=genesis_bit,
+            head=jnp.zeros((n,), jnp.int32),
+            min_father=jnp.full((n,), -1, jnp.int32),
+            min_u1=jnp.full((n,), -1, jnp.int32),
+            min_u2=jnp.full((n,), -1, jnp.int32),
+            min_diff=jnp.zeros((n,), jnp.int32),
+            thr=jnp.zeros((n,), jnp.float32),
+            mined_unsent=jnp.zeros((n, aw), U32),
+            release=jnp.zeros((n, aw), U32),
+            private_blk=jnp.full((n,), -1, jnp.int32),
+            others_head=jnp.zeros((n,), jnp.int32),
+            hash_power=hp, strategy=strategy)
+
+    # ------------------------------------------------------------ helpers
+
+    def _best(self, p, cur, alt, me):
+        """Fork choice by total difficulty (best :337-348 + comparator
+        :300-310): invalid loses; strict improvement wins; ties go to own
+        blocks."""
+        a_ok = (alt >= 0) & p.arena.valid[jnp.maximum(alt, 0)]
+        better = a_ok & (_td_gt(p, alt, cur) |
+                         (_td_eq(p, alt, cur) &
+                          (p.arena.producer[jnp.maximum(alt, 0)] == me)))
+        return jnp.where(better, alt, cur)
+
+    def _depth(self, p, b, me):
+        """Own blocks mined in a row from b (ETHMiner.depth :55-64)."""
+        def cond(st):
+            cur, _ = st
+            return jnp.any((cur >= 0) &
+                           (p.arena.producer[jnp.maximum(cur, 0)] == me))
+
+        def body(st):
+            cur, d = st
+            step = (cur >= 0) & (p.arena.producer[jnp.maximum(cur, 0)] == me)
+            return (jnp.where(step, p.arena.parent[jnp.maximum(cur, 0)], cur),
+                    d + step.astype(jnp.int32))
+
+        _, d = jax.lax.while_loop(cond, body,
+                                  (b, jnp.zeros_like(b)))
+        return d
+
+    def _release_chain(self, p, top, me):
+        """Queue `top` and its own unsent ancestors for broadcast
+        (the sendBlock loop, ETHSelfishMiner.java:105-110)."""
+        aw = self.aw
+
+        def cond(st):
+            cur, _, _ = st
+            unsent = bitset.get_bit(st[1], jnp.maximum(cur, 0))
+            return jnp.any((cur >= 0) &
+                           (p.arena.producer[jnp.maximum(cur, 0)] == me) &
+                           unsent)
+
+        def body(st):
+            cur, unsent_b, rel = st
+            on = (cur >= 0) & \
+                (p.arena.producer[jnp.maximum(cur, 0)] == me) & \
+                bitset.get_bit(unsent_b, jnp.maximum(cur, 0))
+            bit = jnp.where(on[:, None],
+                            bitset.one_bit(jnp.maximum(cur, 0), aw), U32(0))
+            return (jnp.where(on, p.arena.parent[jnp.maximum(cur, 0)], cur),
+                    unsent_b & ~bit, rel | bit)
+
+        _, unsent, rel = jax.lax.while_loop(
+            cond, body, (top, p.mined_unsent, p.release))
+        return unsent, rel
+
+    def _possible_uncle_of(self, p, father, b):
+        """isPossibleUncle against a block mined on `father` (:253-262):
+        height within 7 of the new block, parent on father's chain."""
+        hb = p.arena.height[jnp.maximum(b, 0)]
+        hf = p.arena.height[jnp.maximum(father, 0)]
+        in_range = (b >= 0) & (father >= 0) & (hb <= hf) & (hb >= hf - 6)
+        anc = bc.walk_to_height(p.arena, father, hb)
+        sib = p.arena.parent[jnp.maximum(anc, 0)] == \
+            p.arena.parent[jnp.maximum(b, 0)]
+        return in_range & sib & (anc != b)
+
+    def _start_mining(self, p, need, t):
+        """startNewMining (:131-140): pick <= 2 uncles, compute difficulty
+        and the 10ms success probability."""
+        n, a = self.node_count, self.capacity
+        ids = jnp.arange(n, dtype=jnp.int32)
+        f = p.head                                          # mine on head
+        hf = p.arena.height[jnp.maximum(f, 0)]
+
+        # Ancestors anc[k] at height hf - k, k = 0..7, and their uncles
+        # form the already-included set (possibleUncles :66-76).
+        anc = [f]
+        for _ in range(7):
+            anc.append(jnp.where(anc[-1] >= 0,
+                                 p.arena.parent[jnp.maximum(anc[-1], 0)], -1))
+        anc_arr = jnp.stack(anc, axis=1)                    # [N, 8]
+        inc = jnp.concatenate(
+            [anc_arr,
+             p.u1[jnp.maximum(anc_arr, 0)], p.u2[jnp.maximum(anc_arr, 0)]],
+            axis=1)                                         # [N, 24]
+
+        blocks = jnp.arange(a, dtype=jnp.int32)[None, :]    # [1, A]
+        hb = p.arena.height[None, :]
+        k = hf[:, None] - hb                                # level index
+        anc_at = jnp.take_along_axis(anc_arr, jnp.clip(k, 0, 7), axis=1)
+        sib = p.arena.parent[jnp.maximum(anc_at, 0)] == p.arena.parent
+        # received bit per (node, block):
+        word = p.received[:, (jnp.arange(a) // 32)]
+        got = ((word >> (jnp.arange(a) % 32).astype(U32)) & U32(1)) != 0
+        cand = (got & p.arena.valid[None, :] &
+                (blocks < p.arena.n) & (blocks > 0) &
+                (k >= 0) & (k <= 6) & sib &
+                jnp.all(blocks[:, :, None] != inc[:, None, :], axis=2))
+
+        # UncleCmp (:97-115): own uncles first (higher height first), then
+        # others lowest height first.
+        mine = p.arena.producer[None, :] == ids[:, None]
+        big = jnp.int32(1 << 24)
+        key = jnp.where(mine, (1 << 20) - hb + hf[:, None],
+                        (1 << 21) + hb - hf[:, None] + 7)
+        key = jnp.where(cand, key, big)
+        u1 = jnp.argmin(key, axis=1).astype(jnp.int32)
+        k1 = jnp.take_along_axis(key, u1[:, None], axis=1)[:, 0]
+        key2 = jnp.where(jnp.arange(a)[None, :] == u1[:, None], big, key)
+        u2 = jnp.argmin(key2, axis=1).astype(jnp.int32)
+        k2 = jnp.take_along_axis(key2, u2[:, None], axis=1)[:, 0]
+        u1 = jnp.where(k1 < big, u1, -1)
+        u2 = jnp.where(k2 < big, u2, -1)
+
+        # Constantinople difficulty (:283-296), scaled by 2^DIFF_SHIFT.
+        fd = p.diff_s[jnp.maximum(f, 0)]
+        gap = ((t - p.arena.time[jnp.maximum(f, 0)]) * self.tick_ms) // 9000
+        y = jnp.where(p.u1[jnp.maximum(f, 0)] >= 0, 2, 1)
+        ugap = jnp.maximum(-99, y - gap)
+        diff = (fd // 2048) * ugap
+        periods = (hf + 1 - 4_999_999) // 100_000
+        bomb = jnp.where(periods > 1,
+                         jnp.where(periods - 2 >= DIFF_SHIFT,
+                                   jnp.int32(1) << jnp.clip(
+                                       periods - 2 - DIFF_SHIFT, 0, 30), 0),
+                         diff)
+        all_d = fd + diff + bomb
+
+        # solveIn10ms (:225-231): 1 - (1-1/d)^(hp*2^30/100 per tick).
+        thr = 1.0 - jnp.exp(-(p.hash_power.astype(jnp.float32) * (1 << 9)) /
+                            (100.0 * all_d.astype(jnp.float32)))
+
+        return p.replace(
+            min_father=jnp.where(need, f, p.min_father),
+            min_u1=jnp.where(need, u1, p.min_u1),
+            min_u2=jnp.where(need, u2, p.min_u2),
+            min_diff=jnp.where(need, all_d, p.min_diff),
+            thr=jnp.where(need, thr, p.thr))
+
+    # ---------------------------------------------------------------- step
+
+    def step(self, p: PoWState, nodes, inbox, t, key):
+        n, a, aw = self.node_count, self.capacity, self.aw
+        ids = jnp.arange(n, dtype=jnp.int32)
+        S = inbox.src.shape[1]
+        alive = ~nodes.down
+
+        # ---- receive blocks (onBlock :195-221 + strategy hooks) ----
+        for s in range(S):
+            ok = inbox.valid[:, s] & alive
+            b = jnp.clip(inbox.data[:, s, 0], 0, a - 1)
+            received, new = bc.receive_block(p.received, ids, b, ok)
+            p = p.replace(received=received)
+            old_head = p.head
+            head = self._best(p, p.head, jnp.where(new, b, -1), ids)
+            head_chg = new & (head != old_head)
+            # switchMining is true for every shipped strategy: abort the
+            # candidate on a new head, or when the block could improve our
+            # uncle set (:203-216).
+            uncle_hit = new & (p.min_father >= 0) & \
+                self._possible_uncle_of(p, p.min_father, b)
+            p = p.replace(
+                head=head,
+                min_father=jnp.where(head_chg | uncle_hit, -1,
+                                     p.min_father))
+
+            # onReceivedBlock — selfish strategies (:55-115 / S2 :55-80).
+            selfish = new & (p.strategy > 0)
+            oh = self._best(p, p.others_head, jnp.where(selfish, b, -1), ids)
+            oh_chg = selfish & (oh != p.others_head) & (oh == b)
+            p = p.replace(others_head=oh)
+            priv_h = jnp.where(p.private_blk >= 0,
+                               p.arena.height[jnp.maximum(p.private_blk, 0)],
+                               0)
+            rcv_h = p.arena.height[jnp.maximum(b, 0)]
+            delta_p = priv_h - (rcv_h - 1)
+            they_won_1 = oh_chg & (p.strategy == SELFISH) & (delta_p <= 0)
+            they_won_2 = oh_chg & (p.strategy == SELFISH2) & (p.head == b)
+            they_won = they_won_1 | they_won_2
+            # release everything (sendAllMined) and mine on their head
+            unsent, rel = self._release_chain(
+                p, jnp.where(they_won, p.private_blk, -1), ids)
+            p = p.replace(mined_unsent=unsent, release=rel,
+                          min_father=jnp.where(they_won, -1, p.min_father))
+
+            ahead = oh_chg & ~they_won
+            # SELFISH: deltaP 1/2 -> publish from private top; far
+            # ahead -> walk down toward rcv height while parents are
+            # still unsent, guard on total difficulty (:77-103).
+            top = p.private_blk
+            def walk_cond(st):
+                cur, go = st
+                par = p.arena.parent[jnp.maximum(cur, 0)]
+                par_unsent = bitset.get_bit(p.mined_unsent,
+                                            jnp.maximum(par, 0))
+                return jnp.any(go & (cur >= 0) & par_unsent &
+                               (p.arena.height[jnp.maximum(cur, 0)] >
+                                rcv_h))
+
+            def walk_body(st):
+                cur, go = st
+                par = p.arena.parent[jnp.maximum(cur, 0)]
+                par_unsent = bitset.get_bit(p.mined_unsent,
+                                            jnp.maximum(par, 0))
+                step = go & (cur >= 0) & par_unsent & \
+                    (p.arena.height[jnp.maximum(cur, 0)] > rcv_h)
+                return jnp.where(step, par, cur), go
+
+            walk_go = ahead & (p.strategy == SELFISH) & (delta_p > 2)
+            top_w, _ = jax.lax.while_loop(walk_cond, walk_body,
+                                          (top, walk_go))
+            top = jnp.where(walk_go, top_w, top)
+            # difficulty guard when heights still differ (:93-101)
+            at_rcv = bc.walk_to_height(p.arena, top, rcv_h)
+            guard_fail = (p.strategy == SELFISH) & (delta_p > 2) & \
+                (p.arena.height[jnp.maximum(top, 0)] != rcv_h) & \
+                _td_gt(p, b, at_rcv)
+            # SELFISH2: walk while parent strictly beats rcv (:66-71)
+            def w2_cond(st):
+                cur, go = st
+                par = p.arena.parent[jnp.maximum(cur, 0)]
+                return jnp.any(go & (par >= 0) &
+                               (p.arena.height[jnp.maximum(cur, 0)] >=
+                                rcv_h) &
+                               _td_gt(p, par, b))
+
+            def w2_body(st):
+                cur, go = st
+                par = p.arena.parent[jnp.maximum(cur, 0)]
+                step = go & (par >= 0) & \
+                    (p.arena.height[jnp.maximum(cur, 0)] >= rcv_h) & \
+                    _td_gt(p, par, b)
+                return jnp.where(step, par, cur), go
+
+            w2_go = ahead & (p.strategy == SELFISH2)
+            top2, _ = jax.lax.while_loop(w2_cond, w2_body,
+                                         (p.private_blk, w2_go))
+            top = jnp.where(w2_go, top2, top)
+
+            do_rel = ahead & ~guard_fail
+            unsent, rel = self._release_chain(
+                p, jnp.where(do_rel, top, -1), ids)
+            oh2 = self._best(p, p.others_head,
+                             jnp.where(do_rel, top, -1), ids)
+            p = p.replace(mined_unsent=unsent, release=rel,
+                          others_head=oh2)
+
+        # ---- mining tick (mine10ms :118-129) ----
+        miner = alive & (p.hash_power > 0)
+        need = miner & (p.min_father < 0)
+        p = self._start_mining(p, need, t)
+        u = prng.uniform_float(prng.hash3(p.seed, TAG_MINE, t), ids)
+        found = miner & (p.min_father >= 0) & (u < p.thr)
+
+        arena, blk = bc.alloc(p.arena, found, p.min_father, ids, t)
+        bw = jnp.maximum(blk, 0)
+        fw = jnp.maximum(p.min_father, 0)
+        p = p.replace(
+            arena=arena,
+            diff_s=p.diff_s.at[
+                jnp.where(found, blk, a)].set(p.min_diff, mode="drop"),
+            td_hi=p.td_hi.at[jnp.where(found, blk, a)].set(
+                p.td_hi[fw] + ((p.td_lo[fw] + p.min_diff) >> 30),
+                mode="drop"),
+            td_lo=p.td_lo.at[jnp.where(found, blk, a)].set(
+                (p.td_lo[fw] + p.min_diff) & ((1 << 30) - 1),
+                mode="drop"),
+            u1=p.u1.at[jnp.where(found, blk, a)].set(p.min_u1, mode="drop"),
+            u2=p.u2.at[jnp.where(found, blk, a)].set(p.min_u2, mode="drop"))
+
+        received, _ = bc.receive_block(p.received, ids, blk, found)
+        head = self._best(p.replace(received=received), p.head,
+                          jnp.where(found, blk, -1), ids)
+        p = p.replace(received=received, head=head,
+                      min_father=jnp.where(found, -1, p.min_father))
+
+        # honest: send at +1 tick (sendBlock :152-160); selfish: keep.
+        hon_found = found & (p.strategy == HONEST)
+        bit = jnp.where(hon_found[:, None], bitset.one_bit(bw, aw), U32(0))
+        release = p.release | bit
+        sel_found = found & (p.strategy > 0)
+        mined_unsent = p.mined_unsent | jnp.where(
+            sel_found[:, None], bitset.one_bit(bw, aw), U32(0))
+        private_blk = jnp.where(sel_found, blk, p.private_blk)
+        p = p.replace(release=release, mined_unsent=mined_unsent,
+                      private_blk=private_blk)
+
+        # selfish onMinedBlock (:38-53): at deltaP == 0 with two own blocks
+        # in a row, publish the private chain.
+        priv_h = jnp.where(p.private_blk >= 0,
+                           p.arena.height[jnp.maximum(p.private_blk, 0)], 0)
+        oth_h = p.arena.height[jnp.maximum(p.others_head, 0)]
+        depth2 = self._depth(p, p.private_blk, ids) == 2
+        pub = sel_found & (priv_h - (oth_h - 1) == 0) & depth2
+        unsent, rel = self._release_chain(
+            p, jnp.where(pub, p.private_blk, -1), ids)
+        oh = self._best(p, p.others_head,
+                        jnp.where(pub, p.private_blk, -1), ids)
+        p = p.replace(mined_unsent=unsent, release=rel, others_head=oh)
+
+        # ---- drain one queued broadcast per node per tick ----
+        rel_any = jnp.any(p.release != 0, axis=1)
+        word_has = p.release != 0
+        first_word = jnp.argmax(word_has, axis=1).astype(jnp.int32)
+        word = jnp.take_along_axis(p.release, first_word[:, None],
+                                   axis=1)[:, 0]
+        low = word & (~word + U32(1))
+        bitpos = 31 - jax.lax.clz(jnp.maximum(low, U32(1)).astype(jnp.int32))
+        send_blk = jnp.clip(first_word * 32 + bitpos, 0, a - 1)
+        clear = bitset.one_bit(send_blk, aw)
+        p = p.replace(release=jnp.where(rel_any[:, None],
+                                        p.release & ~clear, p.release))
+
+        out = empty_outbox(self.cfg).replace(
+            bcast=rel_any,
+            bcast_payload=send_blk[:, None].astype(jnp.int32),
+            bcast_size=jnp.ones((n,), jnp.int32))
+        return p, nodes, out
+
+
+# ------------------------------------------------------------- host stats
+
+def rewards_by_miner(pstate, head: int, until_height: int = 0) -> dict:
+    """allRewardsById (ETHPoW.java:219-230): walk the chain from `head`,
+    2.0 per block + uncle rewards (rewards() :183-198)."""
+    arena = bc.to_numpy(pstate.arena)
+    u1 = np.asarray(pstate.u1)
+    u2 = np.asarray(pstate.u2)
+    out: dict = {}
+    cur = int(head)
+    while cur > 0 and arena["height"][cur] > until_height:
+        prod = int(arena["producer"][cur])
+        rwd = 2.0
+        p_extra = 0.0
+        for u in (int(u1[cur]), int(u2[cur])):
+            if u >= 0:
+                u_r = 2.0 * (arena["height"][u] + 8 - arena["height"][cur]) \
+                    / 8
+                out[int(arena["producer"][u])] = \
+                    out.get(int(arena["producer"][u]), 0.0) + u_r
+                p_extra += 2.0 / 32
+        out[prod] = out.get(prod, 0.0) + rwd + p_extra
+        cur = int(arena["parent"][cur])
+    return out
+
+
+def uncle_rate(pstate, head: int, until_height: int = 0) -> float:
+    """uncleRate (ETHPoW.java:241-252): uncles / (uncles + head.height -
+    first.height), walking down to (excluding) until_height."""
+    arena = bc.to_numpy(pstate.arena)
+    u1 = np.asarray(pstate.u1)
+    u2 = np.asarray(pstate.u2)
+    uncles, cur, first = 0, int(head), None
+    head_h = int(arena["height"][int(head)])
+    while cur > 0 and arena["height"][cur] > until_height:
+        uncles += int(u1[cur] >= 0) + int(u2[cur] >= 0)
+        first = cur
+        cur = int(arena["parent"][cur])
+    if first is None:
+        return 0.0
+    return uncles / max(1, uncles + head_h - int(arena["height"][first]))

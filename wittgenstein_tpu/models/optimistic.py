@@ -125,7 +125,10 @@ class OptimisticP2PSignature:
             done_now & (nodes.done_at == 0),
             jnp.maximum(1, t + 2 * self.pairing_time),
             nodes.done_at).astype(jnp.int32))
-        pending = jnp.where(done[:, None], U32(0), pending)
+        # Sigs accepted before crossing the threshold were already
+        # committed to forwarding by the reference (onSig forwards at
+        # accept time, before setting done) — the queue keeps draining;
+        # only NEW receipts stop (the ~done gate in the receive loop).
 
         # Forward up to drain_rate pending sigs per node per ms (lowest id
         # first), each fanned out to all peers except its first sender.

@@ -104,9 +104,13 @@ class P2PHandelState:
     has_acc: jnp.ndarray      # bool [N]
     q_sig: jnp.ndarray        # u32 [N, Q, W] — checkSigs1 queue
     q_used: jnp.ndarray       # bool [N, Q]
-    pend_sig: jnp.ndarray     # u32 [N, W]
-    pend_at: jnp.ndarray      # int32 [N]
-    pend_on: jnp.ndarray      # bool [N]
+    # Two in-flight verification slots: checkSigs fires every pairingTime
+    # and each verification lands 2*pairingTime later, so the reference
+    # pipeline holds up to two at once (P2PHandel.java:503-505 +
+    # Network.java:553-566).
+    pend_sig: jnp.ndarray     # u32 [N, 2, W]
+    pend_at: jnp.ndarray      # int32 [N, 2]
+    pend_on: jnp.ndarray      # bool [N, 2]
 
 
 @register
@@ -162,9 +166,9 @@ class P2PHandel:
             acc=jnp.zeros((n, w), U32), has_acc=jnp.zeros((n,), bool),
             q_sig=jnp.zeros((n, Q, w), U32),
             q_used=jnp.zeros((n, Q), bool),
-            pend_sig=jnp.zeros((n, w), U32),
-            pend_at=jnp.zeros((n,), jnp.int32),
-            pend_on=jnp.zeros((n,), bool))
+            pend_sig=jnp.zeros((n, 2, w), U32),
+            pend_at=jnp.zeros((n, 2), jnp.int32),
+            pend_on=jnp.zeros((n, 2), bool))
 
     # ------------------------------------------------------------------
 
@@ -212,13 +216,16 @@ class P2PHandel:
                 q_sig = set_rows(q_sig, ids, qslot, sig, ok=ins)
                 q_used = set2d(q_used, ids, qslot, True, ok=ins)
 
-        # ---- conditional checkSigs every pairingTime (init :492-494) ----
+        # ---- conditional checkSigs every pairingTime (init :492-494);
+        # picks go into a free pipeline slot (two can be in flight) ----
+        free_slot = jnp.argmin(p.pend_on.astype(jnp.int32), axis=1)
+        has_free = ~jnp.all(p.pend_on, axis=1)
         due = alive & (t >= 1) & ((t - 1) % self.pairing_time == 0) & \
-            (nodes.done_at == 0) & ~p.pend_on
+            (nodes.done_at == 0) & has_free
         if self.double_agg:
             new_bits = acc & ~p.verified
             go = due & has_acc & jnp.any(new_bits != 0, axis=1)
-            pend_sig = jnp.where(go[:, None], acc, p.pend_sig)
+            picked = acc
             acc = jnp.where(due[:, None], U32(0), acc)
             has_acc = has_acc & ~due
         else:
@@ -229,20 +236,25 @@ class P2PHandel:
             best_gain = jnp.take_along_axis(gain, best[:, None],
                                             axis=1)[:, 0]
             go = due & (best_gain > 0)
-            pend_sig = jnp.where(go[:, None],
-                                 gather_rows(q_sig, ids, best), p.pend_sig)
+            picked = gather_rows(q_sig, ids, best)
             # curation: drop zero-gain entries; picked one removed
             q_used = jnp.where(due[:, None] & (gain == 0), False, q_used)
             q_used = set2d(q_used, ids, best, False, ok=go)
-        pend_at = jnp.where(go, t + 2 * self.pairing_time, p.pend_at)
-        pend_on = p.pend_on | go
+        pend_sig = set_rows(p.pend_sig, ids, free_slot, picked, ok=go)
+        pend_at = set2d(p.pend_at, ids, free_slot,
+                        t + 2 * self.pairing_time, ok=go)
+        pend_on = set2d(p.pend_on, ids, free_slot, True, ok=go)
 
-        # ---- apply verification (updateVerifiedSignatures :285-300) ----
-        app = pend_on & (t >= pend_at)
+        # ---- apply verifications (updateVerifiedSignatures :285-300) ----
+        app = pend_on & (t >= pend_at)                     # [N, 2]
         old_card = bitset.popcount(p.verified)
-        verified = jnp.where(app[:, None], p.verified | pend_sig, p.verified)
+        add = jax.lax.reduce(
+            jnp.where(app[..., None], pend_sig, U32(0)), U32(0),
+            jax.lax.bitwise_or, (1,))
+        verified = jnp.where(jnp.any(app, axis=1)[:, None],
+                             p.verified | add, p.verified)
         new_card = bitset.popcount(verified)
-        improved = app & (new_card > old_card)
+        improved = jnp.any(app, axis=1) & (new_card > old_card)
         pend_on = pend_on & ~app
         reach = improved & (nodes.done_at == 0) & (new_card >= self.threshold)
         nodes = nodes.replace(done_at=jnp.where(
