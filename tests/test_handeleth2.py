@@ -1,0 +1,59 @@
+"""HandelEth2 tests — the analogue of handeleth2/HandelEth2Test.java:
+concurrent aggregations, full contributions, determinism."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.handeleth2 import (
+    PERIOD_TIME, R, HandelEth2)
+
+
+def test_continuous_aggregation():
+    p = HandelEth2(node_count=64, pairing_time=3, level_wait_time=100,
+                   period_duration_ms=50,
+                   network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    net, ps = r.run_ms(net, ps, PERIOD_TIME * 4 + 100)
+    # After 4 period starts, R = 3 run concurrently and height-1..2 ended.
+    assert np.all(np.asarray(ps.agg_done) == 2)
+    assert np.all(np.asarray(ps.active))
+    # Ended aggregations reached the full committee (64 contributions).
+    contrib = np.asarray(ps.contributions)
+    assert np.all(contrib == 2 * 64), contrib[:5]
+    assert int(net.dropped) == 0
+
+
+def test_multi_hash_values():
+    p = HandelEth2(node_count=64, period_duration_ms=50,
+                   network_latency_name="NetworkNoLatency")
+    net, ps = p.init(3)
+    r = Runner(p, donate=False)
+    net, ps = r.run_ms(net, ps, PERIOD_TIME + 100)
+    # ~20% of nodes attest a nonzero hash (geometric draw, HNode.create).
+    oh = np.asarray(ps.own_hash)[:, (1001) % R]
+    frac = (oh > 0).mean()
+    assert 0.05 < frac < 0.4, frac
+    # The completed aggregation covers all nodes across hash values.
+    inc = np.asarray(ps.inc)[:, 1001 % R]       # [N, H, W]
+    card = np.unpackbits(inc.view(np.uint8), axis=-1).sum(axis=(1, 2))
+    assert np.all(card == 64)
+
+
+def test_nodes_down_and_determinism():
+    p = HandelEth2(node_count=64, nodes_down=6,
+                   network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+    net1, ps1 = p.init(1)
+    net2, ps2 = p.init(1)
+    net1, ps1 = r.run_ms(net1, ps1, PERIOD_TIME * 2)
+    net2, ps2 = r.run_ms(net2, ps2, PERIOD_TIME * 2)
+    assert np.array_equal(np.asarray(ps1.inc), np.asarray(ps2.inc))
+    live = ~np.asarray(net1.nodes.down)
+    # Running aggregations reached the live population (58 of 64).
+    inc = np.asarray(ps1.inc)
+    card = np.unpackbits(inc.view(np.uint8), axis=-1).sum(axis=(2, 3))
+    active = np.asarray(ps1.active)
+    assert np.all(card[live][active[live]] >= 50), \
+        card[live][active[live]].min()

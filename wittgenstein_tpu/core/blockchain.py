@@ -61,11 +61,14 @@ def make_arena(capacity: int, genesis_height: int = 0) -> Arena:
     )
 
 
-def alloc(arena: Arena, want, parent, producer, t, valid=None):
+def alloc(arena: Arena, want, parent, producer, t, valid=None, height=None):
     """Allocate one block per requesting node (want [N] bool).
 
     Returns (arena, ids [N]) where ids[i] = -1 if i allocated nothing.
     Slot order follows node order within the tick — deterministic.
+    `height` overrides the default parent.height + 1 (chains with height
+    holes, e.g. Casper's slot-indexed blocks, Block.java allows height >
+    parent.height + 1).
     """
     a = arena.capacity
     nreq = want.shape[0]
@@ -73,8 +76,9 @@ def alloc(arena: Arena, want, parent, producer, t, valid=None):
     slot = arena.n + rank
     ok = want & (slot < a)
     slot_w = jnp.where(ok, slot, a)
-    height = jnp.where(parent >= 0, arena.height[jnp.maximum(parent, 0)] + 1,
-                       1)
+    if height is None:
+        height = jnp.where(parent >= 0,
+                           arena.height[jnp.maximum(parent, 0)] + 1, 1)
     if valid is None:
         valid = jnp.ones((nreq,), bool)
     arena = arena.replace(

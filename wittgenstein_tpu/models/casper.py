@@ -1,0 +1,533 @@
+"""Casper IMD — beacon-chain stage 1 (ethresear.ch RPJ mini-spec).
+
+Reference: protocols/CasperIMD.java (751 lines).  Mechanism (SURVEY.md
+§2.4): 8 s slots; one block producer per slot round-robin (init :476-496),
+`attestersPerRound` attesters vote 4 s into their slot (init :498-507,
+vote :451-459); an attestation (attester, slot, head) implicitly endorses
+the head's ancestors within `cycleLength` slots (Attestation :108-127);
+fork choice walks to the first common ancestor and compares attestation
+counts over the two branches, counting both block-included and directly
+received attestations, random or id tie-break (best :204-257,
+countAttestations :262-288); producers merge every not-yet-included
+attestation into their block (buildBlock :383-428); byzantine producer
+variants: delayed (ByzBlockProducer :511-580), skip-father (SF :583-604),
+skip-if-skipped (NS :610-640), wait-for-father (WF :647-707).
+
+TPU-native design:
+* Blocks in the shared arena; attestations in their own arena with columns
+  (attester, height, head) plus a *precomputed ancestor bitset* over block
+  ids — `attests(b)` becomes one bit probe (the reference builds the same
+  `hs` set at creation, :118-126).
+* Per node: received-blocks bitset, received-attestations bitset, head,
+  and a blocksToReevaluate bitset folded through `best` (bounded picks per
+  event tick) — the reference's lazy reevaluateHead (:348-354).
+* One engine tick = `tick_ms` simulated ms; every protocol event sits on
+  the slot grid, so the heavy fork-choice/build path runs under a
+  `lax.cond` that is false on non-event ticks.
+* The reference's slot-gate for early blocks (onBlock :299-314) computes
+  `delta = time - genesis + height*SLOT >= 0` — the sign makes it always
+  pass, so blocks are never actually delayed; we reproduce that behavior
+  (and note it) rather than the unreachable re-queue path.
+
+Scale note: the reference runs this at 10s-100s of nodes for simulated
+hours (CasperIMD.java:714,726); the TPU win is vmapping seeds, not width.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import blockchain as bc
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+
+U32 = jnp.uint32
+TAG_TIE = 0x43415350
+
+HONEST_BP, BYZ_DELAY, BYZ_SF, BYZ_NS, BYZ_WF = 0, 1, 2, 3, 4
+BYZ_KINDS = {None: BYZ_WF, "": BYZ_WF,          # init() default (:469-471)
+             "ByzBlockProducer": BYZ_DELAY, "ByzBlockProducerSF": BYZ_SF,
+             "ByzBlockProducerNS": BYZ_NS, "ByzBlockProducerWF": BYZ_WF}
+
+KIND_BLOCK, KIND_ATT = 0, 1
+
+
+@struct.dataclass
+class CasperState:
+    seed: jnp.ndarray
+    arena: bc.Arena
+    included: jnp.ndarray      # u32 [A, Tw] — attestations inside each block
+    att_n: jnp.ndarray         # int32 scalar — attestations allocated
+    att_attester: jnp.ndarray  # int32 [T]
+    att_height: jnp.ndarray    # int32 [T] — slot of the attestation
+    att_head: jnp.ndarray      # int32 [T] — head at attest time
+    att_anc: jnp.ndarray       # u32 [T, Aw] — blocks this attestation attests
+    att_dropped: jnp.ndarray   # int32 scalar
+    recv_blk: jnp.ndarray      # u32 [N, Aw]
+    recv_att: jnp.ndarray      # u32 [N, Tw]
+    head: jnp.ndarray          # int32 [N]
+    reeval: jnp.ndarray        # u32 [N, Aw] — blocksToReevaluate
+    emit_at: jnp.ndarray       # int32 [N] (-1 = none) — pending sendAll
+    emit_kind: jnp.ndarray     # int32 [N]
+    emit_id: jnp.ndarray       # int32 [N]
+    to_send: jnp.ndarray       # int32 [N] — byz producer's next height
+    wf_at: jnp.ndarray         # int32 [N] (-1) — WF scheduled build tick
+    wf_father: jnp.ndarray     # int32 [N]
+    # byz statistics (ByzBlockProducer :517-521)
+    on_direct_father: jnp.ndarray   # int32 [N]
+    on_older_ancestor: jnp.ndarray  # int32 [N]
+
+
+@register
+class CasperIMD:
+    """Parameters mirror CasperParemeters (CasperIMD.java:18-72).  Node 0
+    is the observer; node 1 the byzantine producer (byz_kind, byz_delay);
+    nodes 2..blockProducersCount honest producers; then the attesters."""
+
+    SLOT_MS = 8000
+
+    def __init__(self, cycle_length=4, random_on_ties=True,
+                 block_producers_count=2, attesters_per_round=20,
+                 block_construction_time=1000,
+                 attestation_construction_time=1, byz_kind=None, byz_delay=0,
+                 node_builder_name=None, network_latency_name=None,
+                 tick_ms=20, block_capacity=512, att_capacity=4096,
+                 reeval_picks=6, inbox_cap=4, bcast_slots=96, horizon=128):
+        if byz_kind not in BYZ_KINDS:
+            raise ValueError(f"unknown byz producer {byz_kind!r}")
+        if self.SLOT_MS % tick_ms or 4000 % tick_ms:
+            raise ValueError("tick_ms must divide SLOT_DURATION and 4000")
+        self.cycle = cycle_length
+        self.random_on_ties = random_on_ties
+        self.n_bp = block_producers_count
+        self.att_per_round = attesters_per_round
+        self.n_att = attesters_per_round * cycle_length
+        self.node_count = 1 + self.n_bp + self.n_att
+        self.t_block = max(1, block_construction_time // tick_ms)
+        self.t_att = max(1, attestation_construction_time // tick_ms)
+        self.byz_kind = BYZ_KINDS[byz_kind]
+        self.byz_delay = byz_delay
+        self.tick_ms = tick_ms
+        self.slot = self.SLOT_MS // tick_ms          # ticks per slot
+        self.capacity = block_capacity
+        self.att_cap = att_capacity
+        self.aw = bc.n_words(block_capacity)
+        self.tw = bitset.n_words(att_capacity)
+        self.reeval_picks = reeval_picks
+        # horizon is in TICKS: it must exceed the max tick-scaled latency
+        # + the construction delays, and it bounds how long a broadcast
+        # occupies its table slot — size bcast_slots >= atts per horizon.
+        self.builder = builders.get_by_name(node_builder_name)
+        from .ethpow import _TickScaled
+        self.latency = _TickScaled(
+            latency_mod.get_by_name(network_latency_name), tick_ms)
+        self.cfg = EngineConfig(
+            n=self.node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=2, out_deg=1, bcast_slots=bcast_slots)
+
+    def init(self, seed):
+        n, a, t_cap = self.node_count, self.capacity, self.att_cap
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        nodes = nodes.replace(byzantine=(ids == 1) & (self.byz_kind > 0))
+
+        net = init_net(self.cfg, nodes, seed)
+        return net, CasperState(
+            seed=seed, arena=bc.make_arena(a),
+            included=jnp.zeros((a, self.tw), U32),
+            att_n=jnp.asarray(0, jnp.int32),
+            att_attester=jnp.full((t_cap,), -1, jnp.int32),
+            att_height=jnp.zeros((t_cap,), jnp.int32),
+            att_head=jnp.zeros((t_cap,), jnp.int32),
+            att_anc=jnp.zeros((t_cap, self.aw), U32),
+            att_dropped=jnp.asarray(0, jnp.int32),
+            recv_blk=bitset.one_bit(jnp.zeros((n,), jnp.int32), self.aw),
+            recv_att=jnp.zeros((n, self.tw), U32),
+            head=jnp.zeros((n,), jnp.int32),
+            reeval=jnp.zeros((n, self.aw), U32),
+            emit_at=jnp.full((n,), -1, jnp.int32),
+            emit_kind=jnp.zeros((n,), jnp.int32),
+            emit_id=jnp.zeros((n,), jnp.int32),
+            to_send=jnp.ones((n,), jnp.int32),
+            wf_at=jnp.full((n,), -1, jnp.int32),
+            wf_father=jnp.zeros((n,), jnp.int32),
+            on_direct_father=jnp.zeros((n,), jnp.int32),
+            on_older_ancestor=jnp.zeros((n,), jnp.int32),
+        )
+
+    # ------------------------------------------------------------ schedule
+
+    def _producer_due(self, t):
+        """Honest producer i (node id 2..n_bp) fires at slot (i) + k*P
+        (init :489-496, producer index starts after the byz node)."""
+        ids = jnp.arange(self.node_count, dtype=jnp.int32)
+        pi = ids - 1                                 # producer index 1..P-1
+        is_hon_bp = (ids >= 2) & (ids <= self.n_bp)
+        phase = (pi + 1) * self.slot
+        period = self.slot * self.n_bp
+        return is_hon_bp & (t >= phase) & ((t - phase) % period == 0)
+
+    def _byz_due(self, t):
+        ids = jnp.arange(self.node_count, dtype=jnp.int32)
+        is_byz = (ids == 1)
+        phase = self.slot + self.byz_delay // self.tick_ms
+        period = self.slot * self.n_bp
+        if self.byz_kind == BYZ_WF:
+            # WF only kicks off the system with block 1 (:655-663).
+            return is_byz & (t == jnp.maximum(phase, 1))
+        return is_byz & (t >= jnp.maximum(phase, 1)) & \
+            ((t - jnp.maximum(phase, 1)) % period == 0)
+
+    def _attester_due(self, t):
+        ids = jnp.arange(self.node_count, dtype=jnp.int32)
+        ai = ids - (1 + self.n_bp)
+        is_att = ai >= 0
+        phase = (1 + ai % self.cycle) * self.slot + 4000 // self.tick_ms
+        period = self.slot * self.cycle
+        return is_att & (t >= phase) & ((t - phase) % period == 0)
+
+    # ----------------------------------------------------------- fork rule
+
+    def _attests(self, p, h):
+        """[N, T] — does attestation a endorse node i's candidate block h?
+        One bit probe of the precomputed ancestor set (:118-126,:135-137)."""
+        T = self.att_cap
+        att = jnp.arange(T, dtype=jnp.int32)[None, :]
+        word = p.att_anc.reshape(-1)[att * self.aw + (h // 32)[:, None]]
+        return (((word >> (h % 32).astype(U32)[:, None]) & U32(1)) != 0) & \
+            (att < p.att_n)
+
+    def _branch_walk(self, p, start, h_stop):
+        """Walk start -> h_stop (exclusive) collecting: branch block bitset
+        [N, Aw] and the union of included attestations [N, Tw], plus own
+        received attestations whose head lies on the branch
+        (countAttestations :262-288)."""
+        n = self.node_count
+
+        def cond(st):
+            cur = st[0]
+            return jnp.any((cur >= 0) & (cur != h_stop) & (cur != 0))
+
+        def body(st):
+            cur, blocks, atts = st
+            on = (cur >= 0) & (cur != h_stop) & (cur != 0)
+            bit = jnp.where(on[:, None],
+                            bitset.one_bit(jnp.maximum(cur, 0), self.aw),
+                            U32(0))
+            inc = jnp.where(on[:, None],
+                            p.included[jnp.maximum(cur, 0)], U32(0))
+            nxt = jnp.where(on, p.arena.parent[jnp.maximum(cur, 0)], cur)
+            return nxt, blocks | bit, atts | inc
+
+        _, blocks, atts = jax.lax.while_loop(
+            cond, body, (start, jnp.zeros((n, self.aw), U32),
+                         jnp.zeros((n, self.tw), U32)))
+        # own received attestations with head on the branch
+        from ._levels import get_bit_rows
+        head_on = get_bit_rows(blocks,
+                               jnp.broadcast_to(p.att_head[None, :],
+                                                (n, self.att_cap)))
+        T = self.att_cap
+        att_idx = jnp.arange(T, dtype=jnp.int32)
+        own_mask = head_on & (att_idx[None, :] < p.att_n)
+        own_bits = jnp.zeros((n, self.tw), U32)
+        word = att_idx // 32
+        onebit = (U32(1) << (att_idx % 32).astype(U32))
+        # pack [N, T] bool -> [N, Tw] words
+        # distinct power-of-two bits per (row, word): add == bitwise or
+        packed = jnp.zeros((n, self.tw), U32).at[:, word].add(
+            jnp.where(own_mask, onebit[None, :], U32(0)))
+        atts = atts | (packed & p.recv_att)
+        return blocks, atts
+
+    def _count(self, p, tip, h, blocks, atts):
+        """countAttestations(tip, h): attestations on the branch that
+        endorse h."""
+        probe = self._attests(p, h)                   # [N, T]
+        T = self.att_cap
+        att_idx = jnp.arange(T, dtype=jnp.int32)
+        in_set = ((atts.reshape(-1)[
+            jnp.arange(self.node_count)[:, None] * self.tw + att_idx // 32]
+            >> (att_idx % 32).astype(U32)) & U32(1)) != 0
+        return jnp.sum(probe & in_set, axis=1).astype(jnp.int32)
+
+    def _best(self, p, o1, o2, t):
+        """Fork choice (best :204-257), vectorized over nodes."""
+        same = o1 == o2
+        direct = bc.has_direct_link(p.arena, o1, o2)
+        h1 = p.arena.height[jnp.maximum(o1, 0)]
+        h2 = p.arena.height[jnp.maximum(o2, 0)]
+        taller = jnp.where(h1 >= h2, o1, o2)
+
+        h = bc.common_ancestor(p.arena, o1, o2)
+        h = jnp.maximum(h, 0)
+        b1, a1 = self._branch_walk(p, o1, h)
+        b2, a2 = self._branch_walk(p, o2, h)
+        v1 = self._count(p, o1, h, b1, a1)
+        v2 = self._count(p, o2, h, b2, a2)
+        if self.random_on_ties:
+            ids = jnp.arange(self.node_count, dtype=jnp.int32)
+            coin = prng.bernoulli(prng.hash3(p.seed, TAG_TIE, t), ids, 0.5)
+            tie = jnp.where(coin, o1, o2)
+        else:
+            tie = jnp.where(o1 >= o2, o1, o2)        # id compare (:252)
+        voted = jnp.where(v1 > v2, o1, jnp.where(v2 > v1, o2, tie))
+        return jnp.where(same, o1, jnp.where(direct, taller, voted))
+
+    def _reevaluate(self, p, active, t):
+        """Fold `best` over up to reeval_picks candidate blocks
+        (reevaluateHead :348-354)."""
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+        head, reeval = p.head, p.reeval
+        for _ in range(self.reeval_picks):
+            live = reeval & jnp.where(active[:, None], U32(0xFFFFFFFF),
+                                      U32(0))
+            has = jnp.any(live != 0, axis=1)
+            fw = jnp.argmax(live != 0, axis=1).astype(jnp.int32)
+            word = jnp.take_along_axis(live, fw[:, None], axis=1)[:, 0]
+            low = word & (~word + U32(1))
+            bp = 31 - jax.lax.clz(jnp.maximum(low, U32(1)).astype(jnp.int32))
+            cand = jnp.clip(fw * 32 + bp, 0, self.capacity - 1)
+            new_head = self._best(p.replace(head=head), head, cand, t)
+            head = jnp.where(has, new_head, head)
+            reeval = jnp.where(has[:, None],
+                               reeval & ~bitset.one_bit(cand, self.aw),
+                               reeval)
+        return p.replace(head=head, reeval=reeval)
+
+    # ---------------------------------------------------------------- step
+
+    def _build_block(self, p, due, height, base, t):
+        # `height` [N] is the slot-indexed block height (may exceed
+        # parent.height + 1 for byzantine skips).
+        """buildBlock (:383-428): include every received attestation on the
+        base branch (height < new height, within cycleLength) that no
+        ancestor block already included."""
+        n = self.node_count
+        stop_h = jnp.maximum(height - self.cycle, 0)
+        stop = bc.walk_to_height(p.arena, base, stop_h)
+        blocks, atts_all = self._branch_walk(p, base, stop)
+        # atts_all = included-in-branch ∪ (own received w/ head on branch);
+        # included-only union for the dedup:
+        _, inc_only = self._branch_walk(
+            p.replace(recv_att=jnp.zeros_like(p.recv_att)), base, stop)
+        att_idx = jnp.arange(self.att_cap, dtype=jnp.int32)
+        h_ok = (p.att_height[None, :] < height[:, None]) & \
+            (att_idx[None, :] < p.att_n)
+        new_bits = atts_all & ~inc_only
+        # mask by attestation height
+        word = att_idx // 32
+        onebit = (U32(1) << (att_idx % 32).astype(U32))
+        hmask = jnp.zeros((n, self.tw), U32).at[:, word].add(
+            jnp.where(h_ok, onebit[None, :], U32(0)))
+        new_bits = new_bits & hmask
+
+        arena, blk = bc.alloc(p.arena, due, base,
+                              jnp.arange(n, dtype=jnp.int32), t,
+                              height=height)
+        included = p.included.at[jnp.where(due, blk, self.capacity)].set(
+            new_bits, mode="drop")
+        p = p.replace(arena=arena, included=included)
+        # producer's own receipt + head update (head = built block, :432)
+        recv_blk, _ = bc.receive_block(p.recv_blk,
+                                       jnp.arange(n, dtype=jnp.int32),
+                                       blk, due)
+        head = jnp.where(due, jnp.maximum(blk, 0), p.head)
+        return p.replace(recv_blk=recv_blk, head=head), blk
+
+    def step(self, p: CasperState, nodes, inbox, t, key):
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+        alive = ~nodes.down
+        S = inbox.src.shape[1]
+
+        # ---- receive (light, every tick; all updates are idempotent
+        # ORs, so the whole inbox is processed vectorized over slots) ----
+        ok = inbox.valid & alive[:, None]                     # [N, S]
+        kind = inbox.data[:, :, 0]
+        val = inbox.data[:, :, 1]
+        is_blk = ok & (kind == KIND_BLOCK)
+        bid = jnp.clip(val, 0, self.capacity - 1)
+        from ._levels import get_bit_rows
+        new_b = is_blk & ~get_bit_rows(p.recv_blk, bid)
+        blk_bits = jnp.where(new_b[..., None],
+                             bitset.one_bit(bid, self.aw), U32(0))
+        blk_or = jax.lax.reduce(blk_bits, U32(0), jax.lax.bitwise_or, (1,))
+        # blocksToReevaluate: the new blocks + our head (:303-305)
+        add = blk_or | jnp.where(jnp.any(new_b, axis=1)[:, None],
+                                 bitset.one_bit(p.head, self.aw), U32(0))
+
+        is_att = ok & (kind == KIND_ATT)
+        aid = jnp.clip(val, 0, self.att_cap - 1)
+        att_bits = jnp.where(is_att[..., None],
+                             bitset.one_bit(aid, self.tw), U32(0))
+        att_or = jax.lax.reduce(att_bits, U32(0), jax.lax.bitwise_or, (1,))
+        # reevaluate an attestation's head if we hold that block
+        # (onAttestation :330-336)
+        ahead = p.att_head[aid]
+        have = get_bit_rows(p.recv_blk, ahead) & is_att
+        add = add | jax.lax.reduce(
+            jnp.where(have[..., None], bitset.one_bit(ahead, self.aw),
+                      U32(0)), U32(0), jax.lax.bitwise_or, (1,))
+
+        # WF byz producer: on receiving its father (height toSend-1),
+        # schedule a build at perfectDate = SLOT*toSend + delay, or now if
+        # late (ByzBlockProducerWF.onBlock :668-696).
+        if self.byz_kind == BYZ_WF:
+            bh = p.arena.height[bid]
+            hit = jnp.any(new_b & (ids == 1)[:, None] &
+                          (bh == p.to_send[:, None] - 1), axis=1)
+            father = jnp.max(jnp.where(
+                new_b & (bh == p.to_send[:, None] - 1), bid, -1), axis=1)
+            perfect = (self.SLOT_MS // self.tick_ms) * p.to_send + \
+                self.byz_delay // self.tick_ms
+            p = p.replace(
+                wf_at=jnp.where(hit, jnp.maximum(t, perfect), p.wf_at),
+                wf_father=jnp.where(hit, father, p.wf_father))
+
+        p = p.replace(recv_blk=p.recv_blk | blk_or,
+                      recv_att=p.recv_att | att_or,
+                      reeval=p.reeval | add)
+
+        # ---- event ticks (heavy path under cond) ----
+        hon_due = self._producer_due(t) & alive
+        byz_due = self._byz_due(t) & alive
+        att_due = self._attester_due(t) & alive
+        wf_due = (p.wf_at >= 0) & (t >= p.wf_at) & alive
+        # The observer never emits; give it (and anyone with queued
+        # candidates) a slot-boundary reevaluation so heads track the chain
+        # (the reference folds best() inside onBlock itself).
+        obs_due = alive & (t % self.slot == 0) & (t > 0) & \
+            jnp.any(p.reeval != 0, axis=1)
+        any_event = jnp.any(hon_due | byz_due | att_due | wf_due | obs_due)
+
+        def heavy(p):
+            return self._events(p, nodes, hon_due, byz_due, att_due,
+                                wf_due, obs_due, t)
+
+        p = jax.lax.cond(any_event, heavy, lambda q: q, p)
+
+        # ---- pending emission (sendAll at +constructionTime) ----
+        fire = (p.emit_at >= 0) & (t >= p.emit_at)
+        out = empty_outbox(self.cfg).replace(
+            bcast=fire,
+            bcast_payload=jnp.stack(
+                [p.emit_kind, p.emit_id], axis=1).astype(jnp.int32),
+            bcast_size=jnp.ones((n,), jnp.int32))
+        p = p.replace(emit_at=jnp.where(fire, -1, p.emit_at))
+        return p, nodes, out
+
+    def _events(self, p, nodes, hon_due, byz_due, att_due, wf_due,
+                obs_due, t):
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        # reevaluateHead for every node acting this tick (:348-354,:376).
+        acting = hon_due | byz_due | att_due | obs_due
+        p = self._reevaluate(p, acting, t)
+
+        # ---- attesters vote (:451-459): attestation on current head ----
+        slot_now = t // self.slot
+        T = self.att_cap
+        rank = jnp.cumsum(att_due.astype(jnp.int32)) - 1
+        aslot = p.att_n + rank
+        a_ok = att_due & (aslot < T)
+        aslot_w = jnp.where(a_ok, aslot, T)
+        # ancestors of head.parent within cycleLength (:118-126)
+        par = p.arena.parent[jnp.maximum(p.head, 0)]
+        stop_h = jnp.maximum(p.arena.height[jnp.maximum(p.head, 0)] -
+                             self.cycle, 0)
+
+        def anc_cond(st):
+            cur, _ = st
+            return jnp.any((cur >= 0) &
+                           (p.arena.height[jnp.maximum(cur, 0)] >= stop_h))
+
+        def anc_body(st):
+            # genesis (id 0) is included when in range — the reference's hs
+            # walk runs until cur == null (:121-126).
+            cur, acc = st
+            on = (cur >= 0) & (p.arena.height[jnp.maximum(cur, 0)] >= stop_h)
+            bit = jnp.where(on[:, None],
+                            bitset.one_bit(jnp.maximum(cur, 0), self.aw),
+                            U32(0))
+            return jnp.where(on, p.arena.parent[jnp.maximum(cur, 0)], cur), \
+                acc | bit
+
+        _, anc = jax.lax.while_loop(
+            anc_cond, anc_body, (par, jnp.zeros((n, self.aw), U32)))
+        p = p.replace(
+            att_attester=p.att_attester.at[aslot_w].set(ids, mode="drop"),
+            att_height=p.att_height.at[aslot_w].set(slot_now, mode="drop"),
+            att_head=p.att_head.at[aslot_w].set(p.head, mode="drop"),
+            att_anc=p.att_anc.at[aslot_w].set(anc, mode="drop"),
+            att_n=p.att_n + jnp.sum(a_ok).astype(jnp.int32),
+            att_dropped=p.att_dropped + jnp.sum(
+                att_due & ~a_ok).astype(jnp.int32),
+            # own attestation is immediately known to its creator
+            recv_att=p.recv_att | jnp.where(
+                a_ok[:, None], bitset.one_bit(jnp.minimum(aslot, T - 1),
+                                              self.tw), U32(0)),
+            emit_at=jnp.where(a_ok, t + self.t_att, p.emit_at),
+            emit_kind=jnp.where(a_ok, KIND_ATT, p.emit_kind),
+            emit_id=jnp.where(a_ok, jnp.minimum(aslot, T - 1), p.emit_id))
+
+        # ---- honest producers build on head at slot height (:436-440) ----
+        heights = jnp.full((n,), t // self.slot, jnp.int32)
+
+        # ---- byzantine producers (:511-640) ----
+        byz_any = byz_due | wf_due
+        # reevaluateH: head walks down while height >= toSend (:530-536)
+        def rh_cond(st):
+            cur = st
+            return jnp.any(byz_any & (p.arena.height[jnp.maximum(cur, 0)] >=
+                                      p.to_send) & (cur > 0))
+
+        def rh_body(cur):
+            on = byz_any & (p.arena.height[jnp.maximum(cur, 0)] >=
+                            p.to_send) & (cur > 0)
+            return jnp.where(on, p.arena.parent[jnp.maximum(cur, 0)], cur)
+
+        bhead = jax.lax.while_loop(rh_cond, rh_body, p.head)
+        hh = p.arena.height[jnp.maximum(bhead, 0)]
+        direct = hh == p.to_send - 1
+        p = p.replace(
+            on_direct_father=p.on_direct_father +
+            (byz_any & direct).astype(jnp.int32),
+            on_older_ancestor=p.on_older_ancestor +
+            (byz_any & ~direct).astype(jnp.int32))
+        # SF: skip the father (:583-604)
+        if self.byz_kind == BYZ_SF:
+            bhead = jnp.where(byz_any & direct & (bhead != 0),
+                              p.arena.parent[jnp.maximum(bhead, 0)], bhead)
+        # NS: skip if father skipped grandfather (:610-640)
+        if self.byz_kind == BYZ_NS:
+            gp_h = p.arena.height[jnp.maximum(
+                p.arena.parent[jnp.maximum(bhead, 0)], 0)]
+            skip = byz_any & direct & (bhead != 0) & \
+                (gp_h == p.to_send - 3)
+            bhead = jnp.where(skip,
+                              p.arena.parent[jnp.maximum(bhead, 0)], bhead)
+        # WF builds on the received father (:668-696)
+        if self.byz_kind == BYZ_WF:
+            bhead = jnp.where(wf_due, p.wf_father, bhead)
+
+        bp_due = hon_due | byz_any
+        base = jnp.where(byz_any, bhead, p.head)
+        bheights = jnp.where(byz_any, p.to_send, heights)
+        p, blk = self._build_block(p, bp_due, bheights, base, t)
+        p = p.replace(
+            to_send=jnp.where(byz_any, p.to_send + self.n_bp, p.to_send),
+            wf_at=jnp.where(wf_due, -1, p.wf_at),
+            emit_at=jnp.where(bp_due, t + self.t_block, p.emit_at),
+            emit_kind=jnp.where(bp_due, KIND_BLOCK, p.emit_kind),
+            emit_id=jnp.where(bp_due, jnp.maximum(blk, 0), p.emit_id))
+        return p

@@ -1,0 +1,495 @@
+"""Dfinity consensus — block producers, attester committees, random beacon.
+
+Reference: protocols/Dfinity.java (480 lines).  Mechanism (SURVEY.md §2.4):
+three roles — block producers propose when the random beacon selects their
+round (onRandomBeaconOnce :253-260), attester committees vote on proposals
+and assemble a "signed" block at majority (onProposal :295-316, onVote
+:276-283, sendBlock :285-293), and a random-beacon committee exchanges
+signatures per height, emitting the beacon at majority
+(onRandomBeaconExchange :364-372, sendRB :374-380); each block received by
+a beacon node starts the next height's beacon exchange, paced by
+`roundTime` (onBlock :385-409).  Fork choice: higher block wins; ties keep
+the current head (DfinityBlockComparator :106-128 — its producer tie-break
+compares a producer with itself, so the comparator returns >= 0 and `best`
+keeps o1; reproduced).  The main() demo exercises map partitions
+(:452-465) — see `partition_by_x` / `heal_partition`.
+
+TPU-native notes: votes and beacon exchanges accumulate as voter bitsets
+([N, A, Vw] / [N, H, Rw]); majority triggers are evaluated once per tick
+after the whole inbox lands (within-tick message order coarsening —
+statistical equivalence, SURVEY §7.4.3).  Unicast fan-outs (proposal /
+vote to every attester, exchange to every beacon node) queue per node and
+drain one batch per tick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import blockchain as bc
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+
+U32 = jnp.uint32
+ROUND_TIME_MS = 3000
+
+K_PROPOSAL, K_VOTE, K_RB_EXCH, K_RB_RESULT, K_BLOCK = 0, 1, 2, 3, 4
+
+
+@struct.dataclass
+class DfinityState:
+    seed: jnp.ndarray
+    arena: bc.Arena
+    recv_blk: jnp.ndarray      # u32 [N, Aw]
+    head: jnp.ndarray          # int32 [N]
+    last_beacon: jnp.ndarray   # int32 [N]
+    # attesters
+    votes: jnp.ndarray         # u32 [N, A, Vw] — voter sets per block
+    vote_for_h: jnp.ndarray    # int32 [N] (-1 = none)
+    buffered: jnp.ndarray      # u32 [N, Aw] — future proposals
+    maj_height: jnp.ndarray    # u32 [N, Hw] — committeeMajorityHeight
+    # beacon nodes
+    rb_height: jnp.ndarray     # int32 [N]
+    rb_last_sent: jnp.ndarray  # int32 [N]
+    exchanged: jnp.ndarray     # u32 [N, H, Rw] — per-height exchange sets
+    # outgoing queues
+    q_vote: jnp.ndarray        # u32 [N, Aw] — blocks to vote on (to attesters)
+    q_prop: jnp.ndarray        # int32 [N] (-1) — proposal to send
+    q_prop_at: jnp.ndarray     # int32 [N]
+    q_exch_h: jnp.ndarray      # int32 [N] (-1) — beacon exchange height
+    q_exch_at: jnp.ndarray     # int32 [N]
+    q_bcast_blk: jnp.ndarray   # u32 [N, Aw] — SendBlock broadcasts
+    q_rb_h: jnp.ndarray        # int32 [N] (-1) — beacon result broadcast
+    wait_for_h: jnp.ndarray    # int32 [N] (-1) — producer waiting for parent
+
+
+@register
+class Dfinity:
+    """Parameters mirror DfinityParameters (Dfinity.java:14-75).  Node
+    layout: 0 = observer, then attesters, block producers, beacon nodes."""
+
+    def __init__(self, block_producers_count=10, attesters_count=10,
+                 attesters_per_round=10, block_construction_time=1,
+                 attestation_construction_time=1,
+                 percentage_dead_attester=0, node_builder_name=None,
+                 network_latency_name=None, tick_ms=10, block_capacity=512,
+                 inbox_cap=None, bcast_slots=160, horizon=64):
+        self.n_bp = block_producers_count
+        self.bp_per_round = 5
+        self.bp_rounds = max(1, block_producers_count // self.bp_per_round)
+        self.n_att = attesters_count
+        self.att_per_round = attesters_per_round
+        self.att_rounds = max(1, attesters_count // attesters_per_round)
+        self.n_rb = attesters_per_round
+        self.majority = attesters_per_round // 2 + 1
+        self.t_block = max(1, block_construction_time // tick_ms)
+        self.t_att = max(1, attestation_construction_time // tick_ms)
+        self.dead_att_pct = percentage_dead_attester
+        self.tick_ms = tick_ms
+        self.round_ticks = ROUND_TIME_MS // tick_ms
+        self.node_count = 1 + self.n_att + self.n_bp + self.n_rb
+        self.capacity = block_capacity
+        self.aw = bc.n_words(block_capacity)
+        self.vw = bitset.n_words(self.node_count)
+        self.hw = bc.n_words(block_capacity)      # heights bounded by blocks
+        self.builder = builders.get_by_name(node_builder_name)
+        from .ethpow import _TickScaled
+        self.latency = _TickScaled(
+            latency_mod.get_by_name(network_latency_name), tick_ms)
+        # Broadcast budget: every attester re-broadcasts each committee
+        # block and every beacon node each beacon result, all alive for
+        # `horizon` ticks — size the table for two overlapping waves.
+        k = max(self.n_att, self.n_rb)            # one fan-out batch per tick
+        self.cfg = EngineConfig(
+            n=self.node_count, horizon=horizon,
+            inbox_cap=inbox_cap or (self.n_att + self.bp_per_round + 8),
+            payload_words=2, out_deg=k, bcast_slots=bcast_slots)
+
+    # role masks ------------------------------------------------------
+    def _roles(self):
+        ids = np.arange(self.node_count)
+        att = (ids >= 1) & (ids <= self.n_att)
+        bp = (ids > self.n_att) & (ids <= self.n_att + self.n_bp)
+        rb = ids > self.n_att + self.n_bp
+        return (jnp.asarray(att), jnp.asarray(bp), jnp.asarray(rb))
+
+    def _my_round(self):
+        ids = np.arange(self.node_count)
+        att_round = np.where((ids >= 1) & (ids <= self.n_att),
+                             (ids - 1) % self.att_rounds, -1)
+        bp_round = np.where((ids > self.n_att) &
+                            (ids <= self.n_att + self.n_bp),
+                            (ids - 1 - self.n_att) % self.bp_rounds, -1)
+        return jnp.asarray(att_round), jnp.asarray(bp_round)
+
+    def init(self, seed):
+        n, a = self.node_count, self.capacity
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        att, bp, rb = self._roles()
+        if self.dead_att_pct:
+            pri = prng.uniform_u32(prng.hash2(seed, 0x44454144), ids)
+            k = (self.n_att * self.dead_att_pct) // 100
+            att_pri = jnp.where(att, pri, jnp.uint32(0xFFFFFFFF))
+            dead = jnp.zeros((n,), bool).at[jnp.argsort(att_pri)[:k]].set(
+                True)
+            nodes = nodes.replace(down=dead)
+
+        net = init_net(self.cfg, nodes, seed)
+        return net, DfinityState(
+            seed=seed, arena=bc.make_arena(a),
+            recv_blk=bitset.one_bit(jnp.zeros((n,), jnp.int32), self.aw),
+            head=jnp.zeros((n,), jnp.int32),
+            last_beacon=jnp.zeros((n,), jnp.int32),
+            votes=jnp.zeros((n, a, self.vw), U32),
+            vote_for_h=jnp.full((n,), -1, jnp.int32),
+            buffered=jnp.zeros((n, self.aw), U32),
+            maj_height=jnp.zeros((n, self.hw), U32),
+            rb_height=jnp.ones((n,), jnp.int32),
+            rb_last_sent=jnp.zeros((n,), jnp.int32),
+            exchanged=jnp.zeros((n, a, bitset.n_words(self.n_rb)), U32),
+            q_vote=jnp.zeros((n, self.aw), U32),
+            q_prop=jnp.full((n,), -1, jnp.int32),
+            q_prop_at=jnp.zeros((n,), jnp.int32),
+            q_exch_h=jnp.full((n,), -1, jnp.int32),
+            q_exch_at=jnp.zeros((n,), jnp.int32),
+            q_bcast_blk=jnp.zeros((n, self.aw), U32),
+            q_rb_h=jnp.full((n,), -1, jnp.int32),
+            wait_for_h=jnp.full((n,), -1, jnp.int32),
+        )
+
+    # ------------------------------------------------------------ helpers
+
+    def _best(self, p, cur, alt):
+        """Comparator :106-128: valid, then height; ties keep cur."""
+        ok = (alt >= 0) & p.arena.valid[jnp.maximum(alt, 0)]
+        hc = p.arena.height[jnp.maximum(cur, 0)]
+        ha = p.arena.height[jnp.maximum(alt, 0)]
+        return jnp.where(ok & (ha > hc), alt, cur)
+
+    def _on_beacon(self, p, h, okmask, t):
+        """onRandomBeacon once-per-height dispatch (:203-211)."""
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+        att, bp, rb = self._roles()
+        att_round, bp_round = self._my_round()
+        once = okmask & (p.last_beacon < h)
+        p = p.replace(last_beacon=jnp.where(once, h, p.last_beacon))
+        rd = h                                      # rd value = height (:375)
+
+        # producer (:253-260): selected and parent in hand -> propose
+        head_h = p.arena.height[jnp.maximum(p.head, 0)]
+        sel_bp = once & bp & (rd % self.bp_rounds == bp_round)
+        now = sel_bp & (head_h == h - 1)
+        p = p.replace(
+            q_prop=jnp.where(now, -2, p.q_prop),
+            q_prop_at=jnp.where(now, t + self.t_block, p.q_prop_at),
+            wait_for_h=jnp.where(sel_bp & ~now, h - 1, p.wait_for_h))
+
+        # attester (:336-355): start voting, vote buffered proposals of h
+        hbit_has = bitset.get_bit(p.maj_height,
+                                  jnp.clip(h, 0, self.capacity - 1))
+        sel_att = once & att & (rd % self.att_rounds == att_round) & \
+            ~hbit_has
+        p = p.replace(vote_for_h=jnp.where(sel_att, h, p.vote_for_h))
+        # buffered proposals at height h -> queue votes
+        buf_bits = p.buffered
+        h_match = p.arena.height[None, :] == h[:, None]
+        bmask = _mask_blocks(h_match, self.capacity)
+        q_vote = jnp.where(sel_att[:, None], p.q_vote | (buf_bits & bmask),
+                           p.q_vote)
+        p = p.replace(q_vote=q_vote,
+                      buffered=jnp.where(sel_att[:, None], U32(0),
+                                         p.buffered))
+
+        # beacon node fast-forward (:414-420)
+        ff = once & rb & (h > p.rb_height)
+        p = p.replace(rb_height=jnp.where(ff, h, p.rb_height),
+                      rb_last_sent=jnp.where(ff, p.rb_height,
+                                             p.rb_last_sent))
+        return p
+
+    # ---------------------------------------------------------------- step
+
+    def step(self, p: DfinityState, nodes, inbox, t, key):
+        n = self.node_count
+        ids = jnp.arange(n, dtype=jnp.int32)
+        alive = ~nodes.down
+        att, bp, rb = self._roles()
+        S = inbox.src.shape[1]
+
+        # init kick (:447-449): beacon nodes broadcast height 1 at t == 1
+        kick = alive & rb & (t == 1) & (p.rb_last_sent == 0)
+        p = p.replace(q_rb_h=jnp.where(kick, 1, p.q_rb_h),
+                      rb_last_sent=jnp.where(kick, 1, p.rb_last_sent))
+
+        # ---- receive, fully vectorized over the S inbox slots: every
+        # update is either an OR-reduce across slots or a scatter-add of
+        # bits that are distinct within the tick (one vote per (sender,
+        # block), one exchange per (sender, height)). ----
+        ok = inbox.valid & alive[:, None]                     # [N, S]
+        kind = inbox.data[:, :, 0]
+        val = jnp.clip(inbox.data[:, :, 1], 0, self.capacity - 1)
+        src = jnp.clip(inbox.src, 0, n - 1)
+
+        # -- BLOCK (onBlock for every role) --
+        from ._levels import get_bit_rows
+        is_blk = ok & (kind == K_BLOCK)
+        new_b = is_blk & ~get_bit_rows(p.recv_blk, val)
+        blk_or = jax.lax.reduce(
+            jnp.where(new_b[..., None], bitset.one_bit(val, self.aw),
+                      U32(0)), U32(0), jax.lax.bitwise_or, (1,))
+        recv_blk = p.recv_blk | blk_or
+        bh_all = p.arena.height[val]                          # [N, S]
+        # head: highest received block this tick vs current (ties keep cur)
+        cand_h = jnp.max(jnp.where(new_b, bh_all, -1), axis=1)
+        cand_slot = jnp.argmax(jnp.where(new_b, bh_all, -1), axis=1)
+        cand = jnp.take_along_axis(val, cand_slot[:, None], axis=1)[:, 0]
+        head_h0 = p.arena.height[jnp.maximum(p.head, 0)]
+        take = (cand_h > head_h0) & jnp.any(new_b, axis=1)
+        head = jnp.where(take, cand, p.head)
+        head_h = jnp.where(take, cand_h, head_h0)
+        # attester bookkeeping (:319-333)
+        hbits = jax.lax.reduce(
+            jnp.where((new_b & att[:, None])[..., None],
+                      bitset.one_bit(jnp.clip(bh_all, 0,
+                                              self.capacity - 1), self.hw),
+                      U32(0)), U32(0), jax.lax.bitwise_or, (1,))
+        vote_cancel = jnp.any(new_b & (bh_all == p.vote_for_h[:, None]),
+                              axis=1) & att
+        # producer waiting for its parent (:243-249)
+        fire = bp & jnp.any(new_b, axis=1) & (head_h == p.wait_for_h)
+        # beacon: catch rb_height up to the new head (:385-409); the
+        # reference advances once per received block — catching up to
+        # head+1 in one tick is the multi-block-per-tick equivalent.
+        start = rb & jnp.any(new_b, axis=1) & (head_h >= p.rb_height)
+        rb_height = jnp.where(start, head_h + 1, p.rb_height)
+        rb_idx = ids - (1 + self.n_att + self.n_bp)
+        ownbit = bitset.one_bit(jnp.maximum(rb_idx, 0),
+                                bitset.n_words(self.n_rb))
+        hclip = jnp.clip(rb_height, 0, self.capacity - 1)
+        olde = p.exchanged[ids, hclip]
+        exchanged = p.exchanged.at[jnp.where(start, ids, n), hclip].set(
+            olde | ownbit, mode="drop")
+        par = p.arena.parent[jnp.maximum(head, 0)]
+        wt = p.arena.time[jnp.maximum(par, 0)] + 2 * self.round_ticks
+        wt = jnp.where(wt <= t, t + self.t_att, wt)
+        p = p.replace(
+            recv_blk=recv_blk, head=head,
+            maj_height=p.maj_height | hbits,
+            vote_for_h=jnp.where(vote_cancel, -1, p.vote_for_h),
+            q_prop=jnp.where(fire, -2, p.q_prop),
+            q_prop_at=jnp.where(fire, t + self.t_block, p.q_prop_at),
+            wait_for_h=jnp.where(fire, -1, p.wait_for_h),
+            rb_height=rb_height, exchanged=exchanged,
+            q_exch_h=jnp.where(start, rb_height, p.q_exch_h),
+            q_exch_at=jnp.where(start, wt, p.q_exch_at))
+
+        # -- PROPOSAL (:295-316) --
+        is_prop = ok & att[:, None] & (kind == K_PROPOSAL)
+        live_vote = is_prop & (p.vote_for_h[:, None] == bh_all)
+        ownvote = bitset.one_bit(ids, self.vw)                # [N, Vw]
+        vbase = (ids[:, None] * self.capacity + val) * self.vw
+        widx = vbase[..., None] + jnp.arange(self.vw)[None, None, :]
+        widx = jnp.where(live_vote[..., None], widx,
+                         n * self.capacity * self.vw)
+        # own-vote bits are distinct per (node, block): accumulate via add
+        vote_add = jnp.zeros_like(p.votes).reshape(-1).at[
+            widx.reshape(-1)].add(
+            jnp.broadcast_to(ownvote[:, None, :], widx.shape).reshape(-1),
+            mode="drop").reshape(p.votes.shape)
+        q_vote = p.q_vote | jax.lax.reduce(
+            jnp.where(live_vote[..., None], bitset.one_bit(val, self.aw),
+                      U32(0)), U32(0), jax.lax.bitwise_or, (1,))
+        buffered = p.buffered | jax.lax.reduce(
+            jnp.where((is_prop & ~live_vote &
+                       (bh_all > head_h[:, None]))[..., None],
+                      bitset.one_bit(val, self.aw), U32(0)),
+            U32(0), jax.lax.bitwise_or, (1,))
+        p = p.replace(q_vote=q_vote, buffered=buffered)
+
+        # -- VOTE (:276-283): scatter sender bits (distinct per tick) --
+        is_vote = ok & att[:, None] & (kind == K_VOTE)
+        sbit_v = bitset.one_bit(src, self.vw)                 # [N, S, Vw]
+        vidx = ((ids[:, None] * self.capacity + val) * self.vw)[
+            ..., None] + jnp.arange(self.vw)[None, None, :]
+        vidx = jnp.where(is_vote[..., None], vidx,
+                         n * self.capacity * self.vw)
+        vote_add = vote_add.reshape(-1).at[vidx.reshape(-1)].add(
+            sbit_v.reshape(-1), mode="drop").reshape(p.votes.shape)
+
+        # -- RB exchange (:364-372) --
+        is_ex = ok & rb[:, None] & (kind == K_RB_EXCH)
+        fresh = is_ex & (val >= p.rb_height[:, None]) & \
+            (val > p.rb_last_sent[:, None])
+        rb_src = jnp.clip(src - (1 + self.n_att + self.n_bp), 0,
+                          self.n_rb - 1)
+        rw = bitset.n_words(self.n_rb)
+        ebit = bitset.one_bit(rb_src, rw)                     # [N, S, Rw]
+        eidx = ((ids[:, None] * self.capacity + val) * rw)[..., None] + \
+            jnp.arange(rw)[None, None, :]
+        eidx = jnp.where(fresh[..., None], eidx, n * self.capacity * rw)
+        exch_add = jnp.zeros_like(p.exchanged).reshape(-1).at[
+            eidx.reshape(-1)].add(ebit.reshape(-1),
+                                  mode="drop").reshape(p.exchanged.shape)
+        p = p.replace(exchanged=jax.tree.map(jnp.bitwise_or, p.exchanged,
+                                             exch_add))
+
+        # -- beacon result: once-per-height dispatch (highest wins) --
+        beacon_h = jnp.max(jnp.where(ok & (kind == K_RB_RESULT), val, -1),
+                           axis=1)
+        p = self._on_beacon(p, beacon_h, beacon_h >= 0, t)
+
+        # merge tick votes + majority checks (:276-316)
+        votes = jax.tree.map(lambda a, b: a | b, p.votes, vote_add)
+        p = p.replace(votes=votes)
+        vh = p.vote_for_h
+        # blocks at our vote height with majority support
+        counts = bitset.popcount(votes)             # [N, A]
+        h_eq = p.arena.height[None, :] == vh[:, None]
+        maj = (counts >= self.majority) & h_eq & \
+            (vh >= 0)[:, None] & att[:, None] & alive[:, None]
+        any_maj = jnp.any(maj, axis=1)
+        maj_blk = jnp.argmax(maj, axis=1).astype(jnp.int32)
+        # sendBlock (:285-293): broadcast, mark heights, stop voting
+        p = p.replace(
+            q_bcast_blk=p.q_bcast_blk | jnp.where(
+                any_maj[:, None], bitset.one_bit(maj_blk, self.aw), U32(0)),
+            maj_height=p.maj_height | jnp.where(
+                any_maj[:, None],
+                bitset.one_bit(jnp.clip(p.arena.height[maj_blk], 0,
+                                        self.capacity - 1), self.hw),
+                U32(0)),
+            vote_for_h=jnp.where(any_maj, -1, p.vote_for_h))
+
+        # beacon majority (:364-380)
+        hclip = jnp.clip(p.rb_height, 0, self.capacity - 1)
+        exch_cnt = bitset.popcount(p.exchanged[ids, hclip])
+        rb_maj = alive & rb & (exch_cnt >= self.majority) & \
+            (p.rb_height > p.rb_last_sent)
+        p = p.replace(
+            q_rb_h=jnp.where(rb_maj, p.rb_height, p.q_rb_h),
+            rb_last_sent=jnp.where(rb_maj, p.rb_height, p.rb_last_sent))
+
+        # ---- producer proposal build (createProposal :222-241) ----
+        build = (p.q_prop == -2) & (t >= p.q_prop_at) & alive
+        heads = p.head
+        arena, blk = bc.alloc(p.arena, build, heads, ids, t)
+        p = p.replace(arena=arena,
+                      q_prop=jnp.where(build, jnp.maximum(blk, 0), p.q_prop))
+        recv, _ = bc.receive_block(p.recv_blk, ids, blk, build)
+        p = p.replace(recv_blk=recv)
+
+        # ---- outbox ----
+        K = self.cfg.out_deg
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, 2), jnp.int32)
+        att_ids = 1 + jnp.arange(self.n_att, dtype=jnp.int32)
+        rb_ids = 1 + self.n_att + self.n_bp + \
+            jnp.arange(self.n_rb, dtype=jnp.int32)
+
+        # proposal batch to all attesters
+        send_prop = (p.q_prop >= 0) & alive
+        dest = dest.at[:, :self.n_att].set(
+            jnp.where(send_prop[:, None], att_ids[None, :], -1))
+        payload = payload.at[:, :self.n_att, 0].set(
+            jnp.where(send_prop[:, None], K_PROPOSAL, 0))
+        payload = payload.at[:, :self.n_att, 1].set(p.q_prop[:, None])
+        p = p.replace(q_prop=jnp.where(send_prop, -1, p.q_prop))
+
+        # else: one vote batch per tick to all attesters
+        has_v = jnp.any(p.q_vote != 0, axis=1) & ~send_prop & alive
+        fw = jnp.argmax(p.q_vote != 0, axis=1).astype(jnp.int32)
+        word = jnp.take_along_axis(p.q_vote, fw[:, None], axis=1)[:, 0]
+        low = word & (~word + U32(1))
+        bpos = 31 - jax.lax.clz(jnp.maximum(low, U32(1)).astype(jnp.int32))
+        vblk = jnp.clip(fw * 32 + bpos, 0, self.capacity - 1)
+        dest = dest.at[:, :self.n_att].set(
+            jnp.where(has_v[:, None], att_ids[None, :],
+                      dest[:, :self.n_att]))
+        payload = payload.at[:, :self.n_att, 0].set(
+            jnp.where(has_v[:, None], K_VOTE,
+                      payload[:, :self.n_att, 0]))
+        payload = payload.at[:, :self.n_att, 1].set(
+            jnp.where(has_v[:, None], vblk[:, None],
+                      payload[:, :self.n_att, 1]))
+        p = p.replace(q_vote=jnp.where(
+            has_v[:, None], p.q_vote & ~bitset.one_bit(vblk, self.aw),
+            p.q_vote))
+
+        # beacon exchange batch to all beacon nodes
+        send_ex = (p.q_exch_h >= 0) & (t >= p.q_exch_at) & alive
+        dest = dest.at[:, :self.n_rb].set(
+            jnp.where(send_ex[:, None], rb_ids[None, :],
+                      dest[:, :self.n_rb]))
+        payload = payload.at[:, :self.n_rb, 0].set(
+            jnp.where(send_ex[:, None], K_RB_EXCH,
+                      payload[:, :self.n_rb, 0]))
+        payload = payload.at[:, :self.n_rb, 1].set(
+            jnp.where(send_ex[:, None], p.q_exch_h[:, None],
+                      payload[:, :self.n_rb, 1]))
+        p = p.replace(q_exch_h=jnp.where(send_ex, -1, p.q_exch_h))
+
+        # broadcasts: beacon result first, else one queued block
+        has_blk = jnp.any(p.q_bcast_blk != 0, axis=1)
+        fw2 = jnp.argmax(p.q_bcast_blk != 0, axis=1).astype(jnp.int32)
+        word2 = jnp.take_along_axis(p.q_bcast_blk, fw2[:, None],
+                                    axis=1)[:, 0]
+        low2 = word2 & (~word2 + U32(1))
+        bpos2 = 31 - jax.lax.clz(jnp.maximum(low2, U32(1)).astype(jnp.int32))
+        bblk = jnp.clip(fw2 * 32 + bpos2, 0, self.capacity - 1)
+        do_rb = (p.q_rb_h >= 0) & alive
+        do_blk = has_blk & ~do_rb & alive
+        bcast = do_rb | do_blk
+        bpayload = jnp.stack(
+            [jnp.where(do_rb, K_RB_RESULT, K_BLOCK),
+             jnp.where(do_rb, p.q_rb_h, bblk)], axis=1).astype(jnp.int32)
+        p = p.replace(
+            q_rb_h=jnp.where(do_rb, -1, p.q_rb_h),
+            q_bcast_blk=jnp.where(
+                do_blk[:, None],
+                p.q_bcast_blk & ~bitset.one_bit(bblk, self.aw),
+                p.q_bcast_blk))
+
+        out = empty_outbox(self.cfg).replace(
+            dest=dest, payload=payload,
+            bcast=bcast, bcast_payload=bpayload,
+            bcast_size=jnp.ones((n,), jnp.int32))
+        return p, nodes, out
+
+
+def _mask_blocks(h_match, capacity):
+    """Pack an [N, A] bool into [N, Aw] words."""
+    n = h_match.shape[0]
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    word = idx // 32
+    onebit = (U32(1) << (idx % 32).astype(U32))
+    return jnp.zeros((n, bc.n_words(capacity)), U32).at[:, word].add(
+        jnp.where(h_match, onebit[None, :], U32(0)))
+
+
+def partition_by_x(net, ratio: float):
+    """Network.partition (:693-707): nodes left of ratio*MAX_X form
+    partition 1."""
+    from ..core.state import MAX_X
+    cut = int(ratio * MAX_X)
+    part = jnp.where(net.nodes.x <= cut, 1, 0).astype(jnp.int32)
+    return net.replace(nodes=net.nodes.replace(partition=part))
+
+
+def heal_partition(net, pstate):
+    """BlockChainNetwork.endPartition (:47-55): clear partitions and have
+    every node re-broadcast its head."""
+    net = net.replace(nodes=net.nodes.replace(
+        partition=jnp.zeros_like(net.nodes.partition)))
+    aw = pstate.q_bcast_blk.shape[1]
+    pstate = pstate.replace(
+        q_bcast_blk=pstate.q_bcast_blk | bitset.one_bit(pstate.head, aw))
+    return net, pstate

@@ -290,8 +290,7 @@ class ENRGossiping:
             can = newer & alive[origin] & \
                 (degree[origin] < self.max_peers) & ~connected
             add_cnt = cnt + o_caps.astype(jnp.int32)
-            gain = self._score_of(caps, jnp.minimum(add_cnt, PEERS_PER_CAP)
-                                  ) - base_score
+            gain = self._score_of(caps, add_cnt) - base_score
             want = can & (gain > 0)
             has_room = degree < self.max_peers
             # full -> try replacing the worst peer (removeWorse, :402-428)
@@ -299,10 +298,8 @@ class ENRGossiping:
                                   caps[jnp.maximum(peers, 0)], False)
             repl_cnt = (cnt[:, None, :] - peer_caps.astype(jnp.int32) +
                         o_caps[:, None, :].astype(jnp.int32))   # [N, D, C]
-            repl_score = jnp.sum(
-                jnp.where(caps[:, None, :],
-                          jnp.minimum(repl_cnt, PEERS_PER_CAP), 0),
-                axis=2)                                         # [N, D]
+            repl_score = self._score_of(caps[:, None, :],
+                                        repl_cnt)               # [N, D]
             repl_score = jnp.where(peers >= 0, repl_score, -1)
             best_repl = jnp.argmax(repl_score, axis=1)
             best_gain = jnp.take_along_axis(repl_score, best_repl[:, None],
@@ -326,6 +323,11 @@ class ENRGossiping:
             peers = peers.reshape(-1).at[
                 jnp.where(do_conn, ids * D + free_slot, n * D)].set(
                 origin, mode="drop").reshape(n, D)
+            # a re-created link cancels an earlier same-ms removal (the
+            # reference's remove-then-create ordering keeps the last op)
+            removed = removed.reshape(-1).at[
+                jnp.where(do_conn, ids * n + origin, n * n)].set(
+                False, mode="drop").reshape(n, n)
             # reciprocal side: origin gains us if it has a free slot —
             # deferred to the symmetrization pass below.
             degree = jnp.sum(peers >= 0, axis=1).astype(jnp.int32)

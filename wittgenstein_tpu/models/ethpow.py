@@ -25,10 +25,9 @@ TPU-native design:
 * sendAll of a block is one broadcast-table entry (O(1) state); multi-block
   releases (sendAllMined) drain one block per tick, parents first — a
   <= few-tick stagger, negligible against the ~13 s block interval.
-* Miners always restart mining on their current head, which for a selfish
-  miner includes its private chain (it onBlock()s its own blocks) — the
-  reference's explicit startNewMining(privateMinerBlock) lands on the same
-  block except in transient races (statistical equivalence, SURVEY §7.4.3).
+* Selfish miners extend their private chain (mine_private) exactly as the
+  reference's startNewMining(privateMinerBlock); "they won" switches the
+  mining base back to the public head.
 
 Operational note: keep Runner chunks <= ~10_000 ticks on TPU — this model's
 step body is control-flow heavy (strategy while_loops) and very long
@@ -123,6 +122,7 @@ class PoWState:
     mined_unsent: jnp.ndarray  # u32 [N, Aw] — minedToSend
     release: jnp.ndarray       # u32 [N, Aw] — queued sendAll broadcasts
     private_blk: jnp.ndarray   # int32 [N] (-1 = none)
+    mine_private: jnp.ndarray  # bool [N] — mining base is the private chain
     others_head: jnp.ndarray   # int32 [N]
     hash_power: jnp.ndarray    # int32 [N] GH/s
     strategy: jnp.ndarray      # int32 [N]
@@ -195,6 +195,7 @@ class ETHPoW:
             mined_unsent=jnp.zeros((n, aw), U32),
             release=jnp.zeros((n, aw), U32),
             private_blk=jnp.full((n,), -1, jnp.int32),
+            mine_private=jnp.zeros((n,), bool),
             others_head=jnp.zeros((n,), jnp.int32),
             hash_power=hp, strategy=strategy)
 
@@ -269,7 +270,11 @@ class ETHPoW:
         and the 10ms success probability."""
         n, a = self.node_count, self.capacity
         ids = jnp.arange(n, dtype=jnp.int32)
-        f = p.head                                          # mine on head
+        # Honest miners extend their head; a selfish miner keeps extending
+        # its private chain until "they won" switches it back to the public
+        # head (onMinedBlock :52 / onReceivedBlock :74-76).
+        f = jnp.where(p.mine_private & (p.private_blk >= 0), p.private_blk,
+                      p.head)
         hf = p.arena.height[jnp.maximum(f, 0)]
 
         # Ancestors anc[k] at height hf - k, k = 0..7, and their uncles
@@ -319,6 +324,9 @@ class ETHPoW:
         ugap = jnp.maximum(-99, y - gap)
         diff = (fd // 2048) * ugap
         periods = (hf + 1 - 4_999_999) // 100_000
+        # periods <= 1 falls back to `diff`, not 0 — the reference's own
+        # quirk (calculateDifficulty :290-293); unreachable at this genesis
+        # height (periods ~ 29) but kept formula-for-formula.
         bomb = jnp.where(periods > 1,
                          jnp.where(periods - 2 >= DIFF_SHIFT,
                                    jnp.int32(1) << jnp.clip(
@@ -381,6 +389,7 @@ class ETHPoW:
             unsent, rel = self._release_chain(
                 p, jnp.where(they_won, p.private_blk, -1), ids)
             p = p.replace(mined_unsent=unsent, release=rel,
+                          mine_private=p.mine_private & ~they_won,
                           min_father=jnp.where(they_won, -1, p.min_father))
 
             ahead = oh_chg & ~they_won
@@ -483,10 +492,14 @@ class ETHPoW:
             sel_found[:, None], bitset.one_bit(bw, aw), U32(0))
         private_blk = jnp.where(sel_found, blk, p.private_blk)
         p = p.replace(release=release, mined_unsent=mined_unsent,
-                      private_blk=private_blk)
+                      private_blk=private_blk,
+                      mine_private=p.mine_private | sel_found)
 
         # selfish onMinedBlock (:38-53): at deltaP == 0 with two own blocks
-        # in a row, publish the private chain.
+        # in a row, publish the private chain.  (The reference's deltaP
+        # formula makes this trigger require others being two ahead of the
+        # mining base at found-time — a rare race there and here; kept
+        # formula-for-formula.)
         priv_h = jnp.where(p.private_blk >= 0,
                            p.arena.height[jnp.maximum(p.private_blk, 0)], 0)
         oth_h = p.arena.height[jnp.maximum(p.others_head, 0)]

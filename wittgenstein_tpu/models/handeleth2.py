@@ -1,0 +1,550 @@
+"""HandelEth2 — Handel aggregation of Eth2 attestation committees.
+
+Reference: protocols/handeleth2/ (HandelEth2.java 150, HNode.java 360,
+HLevel.java 347, Attestation.java 32, AggToVerify.java 48,
+SendAggregation.java 70, HandelEth2Parameters.java 69).  Mechanism
+(SURVEY.md §2.4): a new aggregation starts every PERIOD_TIME = 6 s and runs
+PERIOD_AGG_TIME = 18 s, so three run concurrently (HNode.runningAggs);
+attestations are multi-valued — each node attests a hash drawn
+geometrically (80% hash 0, HNode.create :62-73) and aggregates are kept
+per hash, merged when disjoint, else the best of {ours, theirs+known
+individuals} wins (HLevel.mergeIncoming :225-261, sizeIfMerged :158-193);
+dissemination backs off exponentially (activeCycle fires when cycleCount %
+3^(contacted/levelCount) == 0, HLevel :84-87); one shared verification
+core round-robins the running aggregations every pairingTime
+(HNode.verify :264-294); completing a level's incoming triggers the upper
+levels' fast path (updateVerifiedSignatures :176-202, fastPath :90-92).
+
+TPU-native design (reuses the Handel level machinery):
+* Three process slots per node (slot = height mod 3); per-hash incoming /
+  individual bitsets are [N, R, H, W] rows with all levels packed into
+  disjoint ranges (the same one-row trick as models/handel.py).
+* A level's outgoing set per hash is DERIVED: incoming & block(level-1)
+  (updateAllOutgoing rebuilds outgoing from the lower levels' incoming,
+  HNode :205-227) — messages carry (height, level, flags, hash) and the
+  receiver gathers the sender's current rows (snapshot-free; staleness is
+  one latency, as the other models).
+* Verification selection: the reference's window logic is half-implemented
+  (bestInside is never assigned, HLevel.bestToVerify :277-330, with an
+  explicit "todo: we're not respecting the window's limits"), so the
+  effective rule is "best sizeIfMerged after curation" — implemented
+  directly; curWindowsSize bookkeeping is therefore omitted.
+* Level-1-first then best-size selection stands in for the reference's
+  lastLevelVerified rotation (statistical equivalence, SURVEY §7.4.3).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import builders
+from ..core import latency as latency_mod
+from ..core.protocol import register
+from ..core.state import EngineConfig, empty_outbox, init_net
+from ..ops import bitset, prng
+from ..ops.flat import gather2d
+from ._levels import LevelMixin, get_bit_rows, sibling_base
+
+U32 = jnp.uint32
+PERIOD_TIME = 6000
+PERIOD_AGG_TIME = PERIOD_TIME * 3
+R = PERIOD_AGG_TIME // PERIOD_TIME          # concurrent aggregations
+
+TAG_HASH = 0x48453248
+TAG_BAD = 0x48453242
+TAG_START = 0x48453253
+TAG_EMIT = 0x48453245
+
+
+@struct.dataclass
+class HandelEth2State:
+    seed: jnp.ndarray
+    start_delta: jnp.ndarray   # int32 [N] desynchronizedStart draw
+    pairing: jnp.ndarray       # int32 [N]
+    height: jnp.ndarray        # int32 [N] — current height counter
+    # per process slot r = height % R:
+    active: jnp.ndarray        # bool [N, R]
+    p_height: jnp.ndarray      # int32 [N, R]
+    p_start: jnp.ndarray       # int32 [N, R]
+    own_hash: jnp.ndarray      # int32 [N, R]
+    inc: jnp.ndarray           # u32 [N, R, H, W] incoming per hash (packed)
+    ind: jnp.ndarray           # u32 [N, R, H, W] individual contributions
+    finished: jnp.ndarray      # u32 [N, R, W] finishedPeers
+    demoted: jnp.ndarray       # u32 [N, R, W] reception-rank demotions
+    contacted: jnp.ndarray     # int32 [N, R, L]
+    cycle: jnp.ndarray         # int32 [N, R, L]
+    pos: jnp.ndarray           # int32 [N, R, L]
+    fast_pending: jnp.ndarray  # int32 [N, R] — level bitmask to fast-path
+    # shared verification queue:
+    q_from: jnp.ndarray        # int32 [N, Q] (-1 empty)
+    q_lvl: jnp.ndarray         # int32 [N, Q]
+    q_slot: jnp.ndarray        # int32 [N, Q] — process slot
+    q_height: jnp.ndarray      # int32 [N, Q]
+    q_hash: jnp.ndarray        # int32 [N, Q] — sender's own hash
+    q_rank: jnp.ndarray        # int32 [N, Q]
+    q_sig: jnp.ndarray         # u32 [N, Q, H, W]
+    pend_on: jnp.ndarray       # bool [N]
+    pend_at: jnp.ndarray       # int32 [N]
+    pend_from: jnp.ndarray     # int32 [N]
+    pend_lvl: jnp.ndarray      # int32 [N]
+    pend_slot: jnp.ndarray     # int32 [N]
+    pend_hash: jnp.ndarray     # int32 [N]
+    pend_sig: jnp.ndarray      # u32 [N, H, W]
+    # stats (HNode.aggDone / contributionsTotal)
+    agg_done: jnp.ndarray      # int32 [N]
+    contributions: jnp.ndarray  # int32 [N]
+
+
+@register
+class HandelEth2(LevelMixin):
+    """Parameters mirror HandelEth2Parameters (:5-69)."""
+
+    def __init__(self, node_count=64, pairing_time=3, level_wait_time=100,
+                 period_duration_ms=50, nodes_down=0,
+                 node_builder_name=None, network_latency_name=None,
+                 desynchronized_start=0, hash_values=4, queue_cap=16,
+                 inbox_cap=16, horizon=1024):
+        if node_count & (node_count - 1):
+            raise ValueError("power-of-two node counts only "
+                             "(HandelEth2Parameters :56-58)")
+        if not (0 <= nodes_down < node_count):
+            raise ValueError(f"nodeCount={node_count}")
+        self.node_count = node_count
+        self.pairing_time = pairing_time
+        self.level_wait = level_wait_time
+        self.period = period_duration_ms
+        self.nodes_down = nodes_down
+        self.desync = desynchronized_start
+        self.n_hash = hash_values
+        self.queue_cap = queue_cap
+        self.builder = builders.get_by_name(node_builder_name)
+        self.latency = latency_mod.get_by_name(network_latency_name)
+        self.bits = max(1, int(math.log2(node_count)))
+        self.levels = self.bits + 1
+        self.w = bitset.n_words(node_count)
+        self.half = np.array([0] + [1 << (l - 1)
+                                    for l in range(1, self.levels)], np.int32)
+        # K: per process one send per level + a fast-path batch
+        k = R * (self.levels - 1) + self.bits
+        self.cfg = EngineConfig(n=node_count, horizon=horizon,
+                                inbox_cap=inbox_cap, payload_words=4,
+                                out_deg=k, bcast_slots=1)
+
+    # ------------------------------------------------------------ helpers
+
+    def _emission_peer(self, seed, ids, level, pos):
+        """pos-th peer of the level in emission order (peersPerLevel is a
+        fixed shuffle per node, HandelEth2.java init)."""
+        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 1)
+        base = sibling_base(ids, jnp.maximum(half, 1))
+        key = prng.hash3(prng.hash2(seed, TAG_EMIT), ids, level)
+        perm = prng.bij_perm_dyn(key, jnp.where(pos < half, pos, 0),
+                                 jnp.maximum(level - 1, 0))
+        return base + perm
+
+    def _own_hash_draw(self, seed, ids, height):
+        """Geometric hash draw: P(h) = 0.8 * 0.2^h (HNode.create :62-73),
+        clipped to n_hash - 1."""
+        u = prng.uniform_float(prng.hash3(seed, TAG_HASH, height), ids)
+        # h = floor(log(1-u)/log(0.2)) equivalent: count of 0.2 successes
+        h = jnp.zeros_like(ids)
+        pr = jnp.float32(1.0)
+        for k in range(1, self.n_hash):
+            pr = pr * 0.2
+            h = h + (u < pr).astype(jnp.int32)
+        return h
+
+    def _size_if_merged(self, rows_inc, rows_ind, sig, lmask):
+        """sizeIfMerged (HLevel :158-193) per hash, vectorized: disjoint ->
+        sum; overlapping -> max(ours, theirs | individuals).  All inputs
+        masked to the level range."""
+        our = rows_inc & lmask
+        their = sig & lmask
+        indiv = rows_ind & lmask
+        disj = ~bitset.intersects(our, their)
+        merged_alt = their | indiv
+        per_hash = jnp.where(
+            bitset.popcount(their) == 0, bitset.popcount(our),
+            jnp.where(disj, bitset.popcount(our) + bitset.popcount(their),
+                      jnp.maximum(bitset.popcount(merged_alt),
+                                  bitset.popcount(our))))
+        return jnp.sum(per_hash, axis=-1)            # sum over hash axis
+
+    # ---------------------------------------------------------------- init
+
+    def init(self, seed):
+        n, w, L, Q, H = (self.node_count, self.w, self.levels,
+                         self.queue_cap, self.n_hash)
+        seed = jnp.asarray(seed, jnp.int32)
+        nodes = self.builder.build(seed, n)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        if self.nodes_down:
+            pri = prng.uniform_u32(prng.hash2(seed, TAG_BAD), ids)
+            down = jnp.zeros((n,), bool).at[
+                jnp.argsort(pri)[:self.nodes_down]].set(True)
+            nodes = nodes.replace(down=down)
+        start_delta = (prng.uniform_int(prng.hash2(seed, TAG_START), ids,
+                                        self.desync)
+                       if self.desync else jnp.zeros((n,), jnp.int32))
+        pairing = jnp.maximum(
+            1, (self.pairing_time * nodes.speed_ratio)).astype(jnp.int32)
+
+        net = init_net(self.cfg, nodes, seed)
+
+        def zi(*shape):
+            return jnp.zeros(shape, jnp.int32)
+
+        pstate = HandelEth2State(
+            seed=seed, start_delta=start_delta, pairing=pairing,
+            height=jnp.full((n,), 1000, jnp.int32),
+            active=jnp.zeros((n, R), bool),
+            p_height=zi(n, R), p_start=zi(n, R), own_hash=zi(n, R),
+            inc=jnp.zeros((n, R, H, w), U32),
+            ind=jnp.zeros((n, R, H, w), U32),
+            finished=jnp.zeros((n, R, w), U32),
+            demoted=jnp.zeros((n, R, w), U32),
+            contacted=zi(n, R, L), cycle=zi(n, R, L), pos=zi(n, R, L),
+            fast_pending=zi(n, R),
+            q_from=jnp.full((n, Q), -1, jnp.int32),
+            q_lvl=zi(n, Q), q_slot=zi(n, Q), q_height=zi(n, Q),
+            q_hash=zi(n, Q), q_rank=zi(n, Q),
+            q_sig=jnp.zeros((n, Q, H, w), U32),
+            pend_on=jnp.zeros((n,), bool), pend_at=zi(n),
+            pend_from=jnp.full((n,), -1, jnp.int32),
+            pend_lvl=zi(n), pend_slot=zi(n), pend_hash=zi(n),
+            pend_sig=jnp.zeros((n, H, w), U32),
+            agg_done=zi(n), contributions=zi(n),
+        )
+        return net, pstate
+
+    # ---------------------------------------------------------------- step
+
+    def step(self, p: HandelEth2State, nodes, inbox, t, key):
+        n, w, L, Q, H = (self.node_count, self.w, self.levels,
+                         self.queue_cap, self.n_hash)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        alive = ~nodes.down
+
+        # ---- aggregation lifecycle: every PERIOD_TIME from start_delta
+        # (HandelEth2.init registers startNewAggregation periodically) ----
+        born = alive & (t >= p.start_delta + 1) & \
+            ((t - (p.start_delta + 1)) % PERIOD_TIME == 0)
+        new_h = p.height + 1
+        slot = new_h % R
+        # the reused slot's previous aggregation ends now (stopAggregation)
+        old_active = gather2d(p.active, ids, slot)
+        # best result size = full row cardinality of the last level view
+        old_inc = jnp.take_along_axis(
+            p.inc, slot[:, None, None, None].clip(0),
+            axis=1)[:, 0]                                  # [N, H, W]
+        old_size = jnp.sum(bitset.popcount(old_inc), axis=-1) + 0
+        ended = born & old_active
+        p = p.replace(
+            agg_done=p.agg_done + ended.astype(jnp.int32),
+            contributions=p.contributions +
+            jnp.where(ended, old_size, 0))
+
+        own_hash = self._own_hash_draw(p.seed, ids, new_h)
+        # level-0 incoming: own bit under own hash
+        ob = bitset.one_bit(ids, w)                        # [N, W]
+        hash_onehot = (jnp.arange(H)[None, :] == own_hash[:, None])
+        own_rows = jnp.where(hash_onehot[..., None], ob[:, None, :], U32(0))
+
+        def reset_slot(arr, value):
+            sl = jnp.where(born, slot, R)
+            return arr.at[ids, sl.clip(0, R - 1)].set(
+                jnp.where(born.reshape((n,) + (1,) * (arr.ndim - 2)),
+                          value, arr[ids, sl.clip(0, R - 1)]))
+
+        p = p.replace(
+            height=jnp.where(born, new_h, p.height),
+            active=reset_slot(p.active, True),
+            p_height=reset_slot(p.p_height, new_h),
+            p_start=reset_slot(p.p_start, t),
+            own_hash=reset_slot(p.own_hash, own_hash),
+            inc=reset_slot(p.inc, own_rows),
+            ind=reset_slot(p.ind, own_rows),
+            finished=reset_slot(p.finished, U32(0)),
+            demoted=reset_slot(p.demoted, U32(0)),
+            contacted=reset_slot(p.contacted, 0),
+            cycle=reset_slot(p.cycle, 0),
+            pos=reset_slot(p.pos, 0),
+            fast_pending=reset_slot(p.fast_pending, 0))
+
+        # ---- receive (onNewAgg :328-357) ----
+        S = inbox.src.shape[1]
+        q_from, q_lvl, q_slot = p.q_from, p.q_lvl, p.q_slot
+        q_height, q_hash, q_rank, q_sig = (p.q_height, p.q_hash, p.q_rank,
+                                           p.q_sig)
+        finished, demoted = p.finished, p.demoted
+        for s in range(S):
+            ok = inbox.valid[:, s] & alive
+            src = jnp.clip(inbox.src[:, s], 0, n - 1)
+            m_h = inbox.data[:, s, 0]
+            m_lvl = jnp.clip(inbox.data[:, s, 1], 0, L - 1)
+            m_fin = inbox.data[:, s, 2]
+            m_hash = jnp.clip(inbox.data[:, s, 3], 0, H - 1)
+            m_slot = (m_h % R).astype(jnp.int32)
+            have = ok & gather2d(p.active, ids, m_slot) & \
+                (gather2d(p.p_height, ids, m_slot) == m_h)
+
+            fin_bit = bitset.one_bit(src, w)
+            fin_rows = finished[ids, m_slot]
+            finished = finished.at[
+                jnp.where(have & (m_fin != 0), ids, n),
+                m_slot].set(fin_rows | fin_bit, mode="drop")
+
+            # reception rank + demotion (:340-346)
+            dem_rows = demoted[ids, m_slot]
+            rank = prng.bij_perm(
+                prng.hash3(p.seed, TAG_EMIT + 1, ids), src, self.bits) + \
+                jnp.where(bitset.intersects(dem_rows, fin_bit), n, 0)
+            demoted = demoted.at[jnp.where(have, ids, n), m_slot].set(
+                dem_rows | fin_bit, mode="drop")
+
+            # reconstruct the sender's outgoing: its incoming rows masked
+            # to levels < m_lvl (block of the sender)
+            sblock = self._sender_block_mask(src, m_lvl)   # [N, W]
+            sig = p.inc[src, m_slot] & sblock[:, None, :]  # [N, H, W]
+            # the sender's own individual attestation rides along
+            s_hash_oh = (jnp.arange(H)[None, :] == m_hash[:, None])
+            sig = sig | jnp.where(s_hash_oh[..., None],
+                                  fin_bit[:, None, :], U32(0))
+
+            # queue insert: replace same (from, level, height), else free,
+            # else evict the highest rank
+            same = (q_from == src[:, None]) & (q_lvl == m_lvl[:, None]) & \
+                (q_height == m_h[:, None])
+            free = q_from < 0
+            worst = jnp.argmax(jnp.where(free, -1, q_rank), axis=1)
+            worst_rank = jnp.take_along_axis(q_rank, worst[:, None],
+                                             axis=1)[:, 0]
+            any_same = jnp.any(same, axis=1)
+            any_free = jnp.any(free, axis=1)
+            slot_q = jnp.where(any_same, jnp.argmax(same, axis=1),
+                               jnp.where(any_free, jnp.argmax(free, axis=1),
+                                         worst))
+            ins = have & (any_same | any_free | (rank < worst_rank))
+            sel = jnp.where(ins, ids, n)
+            q_from = q_from.at[sel, slot_q].set(src, mode="drop")
+            q_lvl = q_lvl.at[sel, slot_q].set(m_lvl, mode="drop")
+            q_slot = q_slot.at[sel, slot_q].set(m_slot, mode="drop")
+            q_height = q_height.at[sel, slot_q].set(m_h, mode="drop")
+            q_hash = q_hash.at[sel, slot_q].set(m_hash, mode="drop")
+            q_rank = q_rank.at[sel, slot_q].set(rank, mode="drop")
+            q_sig = q_sig.at[sel, slot_q].set(sig, mode="drop")
+        p = p.replace(q_from=q_from, q_lvl=q_lvl, q_slot=q_slot,
+                      q_height=q_height, q_hash=q_hash, q_rank=q_rank,
+                      q_sig=q_sig, finished=finished, demoted=demoted)
+
+        # drop queue entries for dead aggregations
+        q_live = (p.q_from >= 0) & \
+            (gather2d(p.p_height, ids[:, None], p.q_slot) == p.q_height)
+        p = p.replace(q_from=jnp.where(q_live, p.q_from, -1))
+
+        # ---- apply pending verification (updateVerifiedSignatures) ----
+        p = self._apply_pending(p, t)
+
+        # ---- pick next verification (verify :264-294) ----
+        p = self._pick_verification(p, t, alive)
+
+        # ---- dissemination + fast path ----
+        p, out = self._disseminate(p, nodes, t, alive)
+        return p, nodes, out
+
+    # ------------------------------------------------------------ phases
+
+    def _apply_pending(self, p, t):
+        n, w, L, H = self.node_count, self.w, self.levels, self.n_hash
+        ids = jnp.arange(n, dtype=jnp.int32)
+        due = p.pend_on & (t >= p.pend_at)
+        sl = jnp.clip(p.pend_slot, 0, R - 1)
+        lvl = p.pend_lvl
+        lmask = self._range_mask_dyn(ids, lvl)             # [N, W]
+        rows_inc = p.inc[ids, sl]                          # [N, H, W]
+        rows_ind = p.ind[ids, sl]
+        sig = p.pend_sig & lmask[:, None, :]
+
+        # mergeIncoming (:225-261) per hash
+        our = rows_inc & lmask[:, None, :]
+        their = sig
+        disj = ~bitset.intersects(our, their)
+        alt = (their | (rows_ind & lmask[:, None, :]))
+        better = bitset.popcount(alt) > bitset.popcount(our)
+        new_level = jnp.where(
+            (bitset.popcount(their) == 0)[..., None], our,
+            jnp.where(disj[..., None], our | their,
+                      jnp.where(better[..., None], alt, our)))
+        merged_rows = (rows_inc & ~lmask[:, None, :]) | new_level
+        # the sender's individual contribution
+        from_bit = bitset.one_bit(jnp.maximum(p.pend_from, 0), w)
+        h_oh = (jnp.arange(H)[None, :] == p.pend_hash[:, None])
+        ind_rows = rows_ind | jnp.where(h_oh[..., None],
+                                        from_bit[:, None, :], U32(0))
+        inc = p.inc.at[jnp.where(due, ids, n), sl].set(merged_rows,
+                                                       mode="drop")
+        ind = p.ind.at[jnp.where(due, ids, n), sl].set(ind_rows,
+                                                       mode="drop")
+        # fast path trigger: level incoming now complete -> queue upper
+        # complete levels (updateVerifiedSignatures :176-202)
+        halfs = jnp.asarray(self.half)
+        lvl_card = jnp.sum(bitset.popcount(new_level), axis=-1)
+        complete = due & (lvl_card >= halfs[jnp.clip(lvl, 0, L - 1)])
+        onehot = self._word_onehot(ids)
+        subm = self._subword_masks(ids)
+        hi = ids >> 5
+        union = jax.lax.reduce(merged_rows, U32(0), jax.lax.bitwise_or,
+                               (1,))                       # [N, W] all hashes
+        pc = self._level_pc(union, onehot, subm, hi)       # [N, L]
+        og = 1 + jnp.cumsum(pc, axis=1) - pc
+        og_complete = og >= halfs[None, :]
+        lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+        cand = (og_complete & (lvl_idx > lvl[:, None]) &
+                (halfs[None, :] > 0) & complete[:, None])
+        bits_ = jnp.sum(jnp.where(cand, jnp.int32(1) << lvl_idx, 0),
+                        axis=1).astype(jnp.int32)
+        fast = p.fast_pending.at[ids, sl].add(
+            jnp.where(due, bits_ & ~p.fast_pending[ids, sl], 0))
+        return p.replace(inc=inc, ind=ind, fast_pending=fast,
+                         pend_on=p.pend_on & ~due)
+
+    def _pick_verification(self, p, t, alive):
+        n, w, L, Q, H = (self.node_count, self.w, self.levels,
+                         self.queue_cap, self.n_hash)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        due = alive & ~p.pend_on & (t >= 1) & ((t - 1) % p.pairing == 0)
+
+        filled = p.q_from >= 0
+        rows = ids[:, None]
+        lmask = self._range_mask_dyn(rows, p.q_lvl)        # [N, Q, W]
+        sl = jnp.clip(p.q_slot, 0, R - 1)
+        inc_e = p.inc[rows, sl]                            # [N, Q, H, W]
+        ind_e = p.ind[rows, sl]
+        s = self._size_if_merged(inc_e, ind_e,
+                                 p.q_sig, lmask[:, :, None, :])  # [N, Q]
+        cur = jnp.sum(bitset.popcount(inc_e & lmask[:, :, None, :]),
+                      axis=-1)
+        improving = filled & (s > cur)
+        # curation: drop non-improving entries on due ticks (:306-312)
+        q_from = jnp.where(due[:, None] & filled & ~improving, -1, p.q_from)
+        # level-1 first (:147-151), else best size
+        score = jnp.where(improving, s, -1)
+        l1 = improving & (p.q_lvl == 1)
+        score = jnp.where(l1, score + (1 << 20), score)
+        best = jnp.argmax(score, axis=1)
+        best_ok = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0] > 0
+        do = due & best_ok
+        sel = jnp.where(do, ids, n)
+        g = lambda a: jnp.take_along_axis(a, best[:, None], axis=1)[:, 0]
+        q_from2 = q_from.at[sel, best].set(-1, mode="drop")
+        return p.replace(
+            q_from=q_from2,
+            pend_on=p.pend_on | do,
+            # -1 so the merge lands before the next verify tick (:283-287)
+            pend_at=jnp.where(do, t + jnp.maximum(p.pairing - 1, 1),
+                              p.pend_at),
+            pend_from=jnp.where(do, g(p.q_from), p.pend_from),
+            pend_lvl=jnp.where(do, g(p.q_lvl), p.pend_lvl),
+            pend_slot=jnp.where(do, g(p.q_slot), p.pend_slot),
+            pend_hash=jnp.where(do, g(p.q_hash), p.pend_hash),
+            pend_sig=jnp.where(do[:, None, None],
+                               p.q_sig[ids, best], p.pend_sig))
+
+    def _disseminate(self, p, nodes, t, alive):
+        n, w, L, H = self.node_count, self.w, self.levels, self.n_hash
+        ids = jnp.arange(n, dtype=jnp.int32)
+        halfs = jnp.asarray(self.half)
+        per_due = alive & (t >= 1) & ((t - 1) % self.period == 0)
+
+        K = self.cfg.out_deg
+        dest = jnp.full((n, K), -1, jnp.int32)
+        payload = jnp.zeros((n, K, 4), jnp.int32)
+        sizes = jnp.ones((n, K), jnp.int32)
+
+        onehot = self._word_onehot(ids)
+        subm = self._subword_masks(ids)
+        hi = ids >> 5
+        ko = 0
+        contacted, cycle, pos = p.contacted, p.cycle, p.pos
+        fast_pending = p.fast_pending
+        for r in range(R):
+            act = p.active[:, r] & per_due
+            union = jax.lax.reduce(p.inc[:, r], U32(0), jax.lax.bitwise_or,
+                                   (1,))                   # [N, W]
+            pc = self._level_pc(union, onehot, subm, hi)   # [N, L]
+            og = 1 + jnp.cumsum(pc, axis=1) - pc           # outgoing card
+            inc_complete = pc >= halfs[None, :]
+            og_complete = og >= halfs[None, :]
+            lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+            is_open = ((t - p.p_start[:, r][:, None] >=
+                        (lvl_idx - 1) * self.level_wait) | og_complete) & \
+                (halfs[None, :] > 0)
+            # exponential backoff (activeCycle :84-87)
+            m = contacted[:, r] // max(1, self.bits)      # [N, L] per level
+            period_pow = jnp.power(3.0, jnp.clip(m, 0, 12)).astype(jnp.int32)
+            cyc = cycle[:, r] + (act[:, None] & is_open).astype(jnp.int32)
+            fire = act[:, None] & is_open & \
+                ((cyc % jnp.maximum(period_pow, 1)) == 0)
+            cycle = cycle.at[:, r].set(cyc)
+
+            peer = self._emission_peer(
+                p.seed, ids[:, None], jnp.broadcast_to(lvl_idx, (n, L)),
+                pos[:, r] % jnp.maximum(halfs[None, :], 1))
+            # skip finished peers
+            fin_peer = get_bit_rows(p.finished[:, r], peer)
+            send_l = fire & ~fin_peer & (halfs[None, :] > 0)
+            pos = pos.at[:, r].set(
+                jnp.where(fire, (pos[:, r] + 1) %
+                          jnp.maximum(halfs[None, :], 1), pos[:, r]))
+            contacted = contacted.at[:, r].add(send_l.astype(jnp.int32))
+
+            cols = L - 1
+            dest = dest.at[:, ko:ko + cols].set(
+                jnp.where(send_l, peer, -1)[:, 1:])
+            payload = payload.at[:, ko:ko + cols, 0].set(
+                p.p_height[:, r][:, None])
+            payload = payload.at[:, ko:ko + cols, 1].set(
+                jnp.broadcast_to(lvl_idx, (n, L))[:, 1:])
+            payload = payload.at[:, ko:ko + cols, 2].set(
+                inc_complete.astype(jnp.int32)[:, 1:])
+            payload = payload.at[:, ko:ko + cols, 3].set(
+                p.own_hash[:, r][:, None])
+            ko += cols
+
+        # fast path: drain one queued level of one slot per tick
+        any_fp = p.fast_pending > 0                       # [N, R]
+        r_pick = jnp.argmax(any_fp, axis=1).astype(jnp.int32)
+        has_fp = jnp.any(any_fp, axis=1) & alive
+        fp_bits = gather2d(p.fast_pending, ids, r_pick)
+        lsb = fp_bits & -fp_bits
+        fl = jnp.where(lsb > 0, 31 - jax.lax.clz(jnp.maximum(lsb, 1)),
+                       0).astype(jnp.int32)
+        fhalf = jnp.maximum(halfs[fl], 1)
+        fpos = gather2d(pos.reshape(n, -1), ids,
+                        r_pick * L + fl)
+        kfp = self.bits
+        foffs = (fpos[:, None] + jnp.arange(kfp)[None, :]) % fhalf[:, None]
+        fpeer = self._emission_peer(
+            p.seed, ids[:, None], jnp.broadcast_to(fl[:, None], (n, kfp)),
+            foffs)
+        fok = has_fp[:, None] & (jnp.arange(kfp)[None, :] <
+                                 jnp.minimum(fhalf, kfp)[:, None])
+        dest = dest.at[:, ko:ko + kfp].set(jnp.where(fok, fpeer, -1))
+        payload = payload.at[:, ko:ko + kfp, 0].set(
+            gather2d(p.p_height, ids, r_pick)[:, None])
+        payload = payload.at[:, ko:ko + kfp, 1].set(fl[:, None])
+        payload = payload.at[:, ko:ko + kfp, 2].set(1)
+        payload = payload.at[:, ko:ko + kfp, 3].set(
+            gather2d(p.own_hash, ids, r_pick)[:, None])
+        fast_pending = fast_pending.at[ids, r_pick].set(
+            jnp.where(has_fp, fp_bits & ~lsb, fp_bits))
+
+        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
+                                             size=sizes)
+        return p.replace(contacted=contacted, cycle=cycle, pos=pos,
+                         fast_pending=fast_pending), out

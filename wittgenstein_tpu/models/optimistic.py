@@ -115,9 +115,9 @@ class OptimisticP2PSignature:
                                                  unique_indices=True
                                                  ).reshape(n, n)
 
-        # done at threshold: stop forwarding, doneAt = t + 2*pairing
-        # (:128-131).  Signatures queued before done are still dropped
-        # (onSig checks !done before forwarding).
+        # done at threshold: stop accepting new sigs, doneAt = t +
+        # 2*pairing (:128-131).  Already-queued forwards keep draining —
+        # the reference forwarded them at accept time, before done.
         count = bitset.popcount(received)
         done_now = ~p.done & (count >= self.threshold)
         done = p.done | done_now

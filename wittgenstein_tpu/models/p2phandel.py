@@ -216,6 +216,30 @@ class P2PHandel:
                 q_sig = set_rows(q_sig, ids, qslot, sig, ok=ins)
                 q_used = set2d(q_used, ids, qslot, True, ok=ins)
 
+        # ---- apply verifications FIRST (updateVerifiedSignatures
+        # :285-300): completions land exactly on checkSigs due ticks, and
+        # the freed slot must be pickable this same tick (the reference
+        # task queue applies the +2*pairing task before the conditional
+        # checkSigs of the same ms). ----
+        app = p.pend_on & (t >= p.pend_at)                     # [N, 2]
+        old_card = bitset.popcount(p.verified)
+        add = jax.lax.reduce(
+            jnp.where(app[..., None], p.pend_sig, U32(0)), U32(0),
+            jax.lax.bitwise_or, (1,))
+        verified = jnp.where(jnp.any(app, axis=1)[:, None],
+                             p.verified | add, p.verified)
+        new_card = bitset.popcount(verified)
+        improved = jnp.any(app, axis=1) & (new_card > old_card)
+        p = p.replace(pend_on=p.pend_on & ~app)
+        reach = improved & (nodes.done_at == 0) & (new_card >= self.threshold)
+        nodes = nodes.replace(done_at=jnp.where(
+            reach, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
+        # Burst flags are step-local: set and fully consumed this ms (the
+        # reference sends inside updateVerifiedSignatures).
+        final_burst = reach
+        state_burst = (improved & ~reach & (nodes.done_at == 0)
+                       & self.send_state)
+
         # ---- conditional checkSigs every pairingTime (init :492-494);
         # picks go into a free pipeline slot (two can be in flight) ----
         free_slot = jnp.argmin(p.pend_on.astype(jnp.int32), axis=1)
@@ -223,14 +247,14 @@ class P2PHandel:
         due = alive & (t >= 1) & ((t - 1) % self.pairing_time == 0) & \
             (nodes.done_at == 0) & has_free
         if self.double_agg:
-            new_bits = acc & ~p.verified
+            new_bits = acc & ~verified
             go = due & has_acc & jnp.any(new_bits != 0, axis=1)
             picked = acc
             acc = jnp.where(due[:, None], U32(0), acc)
             has_acc = has_acc & ~due
         else:
             gain = bitset.popcount(
-                jnp.where(q_used[..., None], q_sig & ~p.verified[:, None, :],
+                jnp.where(q_used[..., None], q_sig & ~verified[:, None, :],
                           U32(0)))                       # [N, Q]
             best = jnp.argmax(gain, axis=1)
             best_gain = jnp.take_along_axis(gain, best[:, None],
@@ -244,26 +268,6 @@ class P2PHandel:
         pend_at = set2d(p.pend_at, ids, free_slot,
                         t + 2 * self.pairing_time, ok=go)
         pend_on = set2d(p.pend_on, ids, free_slot, True, ok=go)
-
-        # ---- apply verifications (updateVerifiedSignatures :285-300) ----
-        app = pend_on & (t >= pend_at)                     # [N, 2]
-        old_card = bitset.popcount(p.verified)
-        add = jax.lax.reduce(
-            jnp.where(app[..., None], pend_sig, U32(0)), U32(0),
-            jax.lax.bitwise_or, (1,))
-        verified = jnp.where(jnp.any(app, axis=1)[:, None],
-                             p.verified | add, p.verified)
-        new_card = bitset.popcount(verified)
-        improved = jnp.any(app, axis=1) & (new_card > old_card)
-        pend_on = pend_on & ~app
-        reach = improved & (nodes.done_at == 0) & (new_card >= self.threshold)
-        nodes = nodes.replace(done_at=jnp.where(
-            reach, jnp.maximum(t, 1), nodes.done_at).astype(jnp.int32))
-        # Burst flags are step-local: set and fully consumed this ms (the
-        # reference sends inside updateVerifiedSignatures).
-        final_burst = reach
-        state_burst = (improved & ~reach & (nodes.done_at == 0)
-                       & self.send_state)
 
         # ---- outbox: burst sends + periodic sendSigs ----
         K = self.cfg.out_deg
