@@ -1,0 +1,38 @@
+"""Checkpoint/resume tests: a resumed run must be bit-identical to an
+uninterrupted one."""
+
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.handel import Handel
+from wittgenstein_tpu.utils import checkpoint
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    p = Handel(node_count=128, threshold=115, nodes_down=12,
+               network_latency_name="NetworkLatencyByDistanceWJitter")
+    r = Runner(p, donate=False)
+
+    # Straight run: 1000 ms.
+    net_a, ps_a = p.init(0)
+    for _ in range(4):
+        net_a, ps_a = r.run_ms(net_a, ps_a, 250)
+
+    # Checkpointed run: 500 ms, save, load, 500 ms more.
+    net_b, ps_b = p.init(0)
+    net_b, ps_b = r.run_ms(net_b, ps_b, 250)
+    net_b, ps_b = r.run_ms(net_b, ps_b, 250)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, net_b, ps_b, meta={"time": int(net_b.time)})
+    net_c, ps_c, meta = checkpoint.load(path, p, seed=0)
+    assert meta["time"] == 500
+    for _ in range(2):
+        net_c, ps_c = r.run_ms(net_c, ps_c, 250)
+
+    for name in ("done_at", "msg_received", "bytes_sent"):
+        assert np.array_equal(np.asarray(getattr(net_a.nodes, name)),
+                              np.asarray(getattr(net_c.nodes, name))), name
+    assert np.array_equal(np.asarray(ps_a.ver_ind), np.asarray(ps_c.ver_ind))
+    assert np.array_equal(np.asarray(ps_a.last_agg),
+                          np.asarray(ps_c.last_agg))
+    assert int(net_a.time) == int(net_c.time) == 1000

@@ -1,0 +1,106 @@
+"""Server tests — WServerTest.java parity: protocol discovery, parameter
+templates for every protocol, init→send→runMs→time workflow over HTTP, and
+the external-node bridge with a mock remote (ExternalMockImplementation)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+from wittgenstein_tpu.server import core as score
+from wittgenstein_tpu.server.http import make_server
+
+
+def test_protocol_discovery_and_templates():
+    names = score.list_protocols()
+    assert len(names) >= 16
+    for expected in ("Handel", "GSFSignature", "CasperIMD", "Dfinity",
+                     "ETHPoW", "SanFermin", "Paxos", "Slush", "Snowflake",
+                     "P2PFlood", "ENRGossiping", "PingPong"):
+        assert expected in names
+    # WServerTest.java:66-124 round-trips the parameter JSON for EVERY
+    # registered protocol.
+    for name in names:
+        tpl = score.protocol_parameters(name)
+        assert isinstance(tpl, dict) and tpl, name
+
+
+def test_workflow_in_process():
+    s = score.Server()
+    s.init("PingPong", {"node_count": 64}, seed=0)
+    assert s.time() == 0
+    s.run_ms(300)
+    assert s.time() == 300
+    nodes = s.all_nodes()
+    assert len(nodes) == 64
+    assert sum(n["msgReceived"] for n in nodes) > 0
+    # stop / start round-trip (Server.java:135-143)
+    s.stop_node(5)
+    assert s.node_info(5)["down"]
+    s.start_node(5)
+    assert not s.node_info(5)["down"]
+
+
+def test_external_bridge_mock():
+    # ExternalMockImplementation parity: the "remote" sees deliveries for
+    # the external node and replies with an injected message.
+    s = score.Server()
+    s.init("PingPong", {"node_count": 32}, seed=0)
+    seen = []
+
+    def mock(delivered):
+        seen.extend(delivered)
+        # reply: the external node answers the first sender
+        return [{"from": delivered[0]["to"], "to": delivered[0]["from"],
+                 "payload": [1]}] if delivered else []
+
+    s.set_external(3, mock)
+    assert s.node_info(3)["external"] and s.node_info(3)["down"]
+    s.run_ms(300)
+    # PingPong's witness broadcast reaches node 3 -> the mock saw it.
+    assert seen, "external node received its deliveries"
+    assert all(e["to"] == 3 for e in seen)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_http_round_trip():
+    import threading
+    httpd = make_server(0)
+    port = httpd.server_address[1]
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        names = _get(port, "/w/protocols")
+        assert "PingPong" in names
+        tpl = _get(port, "/w/protocols/PingPong")
+        assert "node_count" in tpl
+        _post(port, "/w/network/init/PingPong", {"node_count": 32})
+        _post(port, "/w/network/runMs/200")
+        assert _get(port, "/w/network/time") == 200
+        nodes = _get(port, "/w/network/nodes")
+        assert len(nodes) == 32
+        n0 = _get(port, "/w/network/nodes/0")
+        assert n0["nodeId"] == 0
+        _post(port, "/w/network/nodes/4/stop")
+        assert _get(port, "/w/network/nodes/4")["down"]
+        _post(port, "/w/network/send",
+              {"from": 1, "to": 2, "payload": [7]})
+        msgs = _get(port, "/w/network/messages")
+        assert isinstance(msgs, list)
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,53 @@
+"""Tools tests — Graph/CSVFormatter/NodeDrawer parity smoke tests
+(GraphTest / CSVFormatterTest / NodeDrawerTest analogues)."""
+
+import os
+
+import numpy as np
+
+from wittgenstein_tpu.core import builders
+from wittgenstein_tpu.tools.csvf import CSVFormatter
+from wittgenstein_tpu.tools.graph import (Graph, Series, clean_series,
+                                          stat_series)
+from wittgenstein_tpu.tools.node_drawer import NodeDrawer
+
+
+def test_csv_formatter():
+    c = CSVFormatter(["a", "b"])
+    c.add(a=1, b=2)
+    c.add(b=4, a=3)
+    c.add(a=5)                       # missing column -> empty cell
+    assert str(c) == "a,b\n1,2\n3,4\n5,\n"
+
+
+def test_stat_and_clean_series():
+    r1 = Series("r1"); r2 = Series("r2")
+    for x, (y1, y2) in enumerate([(1, 3), (2, 4), (5, 5), (5, 5), (5, 5)]):
+        r1.add(x, y1); r2.add(x, y2)
+    st = stat_series("s", [r1, r2])
+    assert st["min"].ys == [1, 2, 5, 5, 5]
+    assert st["max"].ys == [3, 4, 5, 5, 5]
+    assert st["avg"].ys == [2, 3, 5, 5, 5]
+    clean_series([r1, r2])           # trim the shared flat tail
+    assert len(r1.ys) == 3
+
+
+def test_graph_png(tmp_path):
+    g = Graph("t", "x", "y")
+    s = Series("s")
+    for i in range(10):
+        s.add(i, i * i)
+    g.add_series(s)
+    path = str(tmp_path / "g.png")
+    g.save(path)
+    assert os.path.getsize(path) > 1000
+
+
+def test_node_drawer_gif(tmp_path):
+    nodes = builders.NodeBuilder().build(0, 50)
+    d = NodeDrawer(vmin=0, vmax=1, dot=3)
+    for f in range(3):
+        d.draw(nodes, np.linspace(0, 1, 50))
+    path = str(tmp_path / "n.gif")
+    d.save_gif(path)
+    assert os.path.getsize(path) > 1000

@@ -1,0 +1,7 @@
+"""Protocol implementations.  Importing this package fills the protocol
+registry (core/protocol.PROTOCOLS) — the analogue of the reference
+wserver's Spring classpath scan (wserver/Server.java:56-70)."""
+
+from . import (avalanche, casper, dfinity, enr, ethpow, gsf, handel,  # noqa
+               handeleth2, optimistic, p2pflood, p2phandel, paxos,
+               pingpong, sanfermin)

@@ -1,0 +1,149 @@
+"""Handel experiment sweeps — HandelScenarios.java parity.
+
+The reference's default scenario (HandelScenarios.java:61-123): 2048 nodes,
+10% dead, threshold 0.99*live, pairing 4 ms, levelWait 50 ms, period 20 ms,
+fastPath 10, CITIES builder.  Sweeps: node-count log scaling (:324-363),
+tor fraction (:177), desynchronized start (:192), period (:433+).
+
+Every sweep point runs a BATCH of seeds in one device program
+(core/harness.run_multiple_times — the vmapped RunMultipleTimes), and
+results land in a CSVFormatter + Graph PNG.  Run as
+`python -m wittgenstein_tpu.scenarios.handel_scenarios [out_dir]` for a
+small smoke sweep.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core import builders
+from ..core.harness import run_multiple_times
+from ..models.handel import Handel, cont_if_handel
+from ..tools.csvf import CSVFormatter
+from ..tools.graph import Graph, Series
+
+
+def default_params(nodes=2048, dead_ratio=0.10, **overrides):
+    """HandelScenarios.defaultParams (:61-123)."""
+    dead = int(nodes * dead_ratio)
+    params = dict(node_count=nodes, nodes_down=dead,
+                  threshold=int(0.99 * (nodes - dead)),
+                  pairing_time=4, level_wait_time=50,
+                  dissemination_period_ms=20, fast_path=10,
+                  node_builder_name=builders.registry_name(
+                      "cities", True, 0.0),
+                  network_latency_name="NetworkLatencyByDistanceWJitter")
+    params.update(overrides)
+    return params
+
+
+def _run_point(params, seeds, max_time=4000, chunk=250):
+    proto = Handel(**params)
+    t0 = time.perf_counter()
+    res = run_multiple_times(proto, run_count=seeds, max_time=max_time,
+                             chunk=chunk, cont_if=cont_if_handel)
+    wall = time.perf_counter() - t0
+    done_at = np.asarray(res.nets.nodes.done_at)
+    down = np.asarray(res.nets.nodes.down)
+    per_run_done = [done_at[i][~down[i]] for i in range(seeds)]
+    return {
+        "avg_done_ms": float(np.mean([d.mean() for d in per_run_done])),
+        "max_done_ms": float(np.max([d.max() for d in per_run_done])),
+        "frac_done": float(np.mean([(d > 0).mean() for d in per_run_done])),
+        "wall_s": wall,
+        "msg_sent_avg": float(np.asarray(res.nets.nodes.msg_sent).mean()),
+        "bytes_sent_avg": float(
+            np.asarray(res.nets.nodes.bytes_sent).mean()),
+    }
+
+
+def node_scaling(counts=(128, 256, 512, 1024, 2048), seeds=4, out_dir="."):
+    """Log node-count scaling (HandelScenarios.byNodeCount-style,
+    :324-363)."""
+    csv = CSVFormatter(["nodes", "avg_done_ms", "max_done_ms", "wall_s",
+                        "msg_sent_avg"])
+    g = Graph("Handel: time to aggregate vs node count", "nodes",
+              "avg doneAt (ms)")
+    s = Series("avg doneAt")
+    for n in counts:
+        r = _run_point(default_params(nodes=n), seeds)
+        csv.add(nodes=n, **{k: round(v, 1) for k, v in r.items()
+                            if k in csv.columns})
+        s.add(n, r["avg_done_ms"])
+        print(f"nodes={n}: {r}")
+    g.add_series(s)
+    csv.save(f"{out_dir}/handel_node_scaling.csv")
+    g.save(f"{out_dir}/handel_node_scaling.png")
+    return csv
+
+
+def tor_sweep(fractions=(0.0, 0.1, 0.33), nodes=256, seeds=4, out_dir="."):
+    """Tor-like extra-latency fraction sweep (:177)."""
+    csv = CSVFormatter(["tor", "avg_done_ms", "max_done_ms"])
+    for tor in fractions:
+        name = builders.registry_name("random", True, tor)
+        r = _run_point(default_params(nodes=nodes,
+                                      node_builder_name=name), seeds)
+        csv.add(tor=tor, avg_done_ms=round(r["avg_done_ms"], 1),
+                max_done_ms=round(r["max_done_ms"], 1))
+        print(f"tor={tor}: {r}")
+    csv.save(f"{out_dir}/handel_tor.csv")
+    return csv
+
+
+def desync_sweep(starts=(0, 50, 200), nodes=256, seeds=4, out_dir="."):
+    """Desynchronized start sweep (:192)."""
+    csv = CSVFormatter(["desync_ms", "avg_done_ms", "max_done_ms"])
+    for d in starts:
+        r = _run_point(default_params(nodes=nodes,
+                                      desynchronized_start=d), seeds)
+        csv.add(desync_ms=d, avg_done_ms=round(r["avg_done_ms"], 1),
+                max_done_ms=round(r["max_done_ms"], 1))
+        print(f"desync={d}: {r}")
+    csv.save(f"{out_dir}/handel_desync.csv")
+    return csv
+
+
+def period_sweep(periods=(10, 20, 50), nodes=256, seeds=4, out_dir="."):
+    """Dissemination period sweep (:433-604)."""
+    csv = CSVFormatter(["period_ms", "avg_done_ms", "bytes_sent_avg"])
+    for p in periods:
+        r = _run_point(default_params(nodes=nodes,
+                                      dissemination_period_ms=p), seeds)
+        csv.add(period_ms=p, avg_done_ms=round(r["avg_done_ms"], 1),
+                bytes_sent_avg=round(r["bytes_sent_avg"], 1))
+        print(f"period={p}: {r}")
+    csv.save(f"{out_dir}/handel_period.csv")
+    return csv
+
+
+def gen_anim(nodes=256, out_path="handel.gif", frames=20, frame_ms=50):
+    """Animated GIF of aggregation progress (HandelScenarios.genAnim :291,
+    NodeDrawer parity)."""
+    from ..core.network import Runner
+    from ..ops import bitset
+    from ..tools.node_drawer import NodeDrawer
+    params = default_params(nodes=nodes,
+                            node_builder_name=None)
+    proto = Handel(**params)
+    runner = Runner(proto, donate=False)
+    net, ps = proto.init(0)
+    drawer = NodeDrawer(vmin=1, vmax=nodes)
+    for _ in range(frames):
+        net, ps = runner.run_ms(net, ps, frame_ms)
+        vals = np.asarray(bitset.popcount(ps.last_agg | ps.ver_ind))
+        drawer.draw(net.nodes, vals)
+        if bool((np.asarray(net.nodes.done_at)[
+                ~np.asarray(net.nodes.down)] > 0).all()):
+            break
+    drawer.save_gif(out_path, ms_per_frame=120)
+    return out_path
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    node_scaling(counts=(128, 256), seeds=2, out_dir=out)
+    tor_sweep(fractions=(0.0, 0.33), nodes=128, seeds=2, out_dir=out)

@@ -1,0 +1,229 @@
+"""The simulation server — IServer parity (wserver/IServer.java:9-34).
+
+The reference wraps its simulator in a Spring-Boot REST facade
+(wserver/Server.java, ws/WServer.java): discover protocols by classpath
+scan, instantiate one from a WParameters JSON, drive it with runMs, read
+node state and pending messages, stop/start nodes, attach "external" nodes
+whose deliveries are shipped to a remote system that replies with messages
+to inject (core/External.java, Network.java:616-623).
+
+This `Server` is the transport-agnostic core: the protocol registry is the
+`@register` table (the classpath-scan analogue), parameters are the
+protocol constructors' keyword arguments (the WParameters analogue), and
+the external bridge accepts any callable — the HTTP client in
+`server/http.py` (ExternalRest parity) is one such callable, the tests'
+in-process mock (ExternalMockImplementation parity) another.
+
+External-node semantics: a node marked external is stopped in-engine (it no
+longer acts); while any external exists, `run_ms` advances 1 ms at a time,
+peeks each external's deliveries (EnvelopeInfo), hands them to the
+handler, and injects the returned SendMessages — the reference does the
+same per-delivery hop, in-loop (Network.java:616-623).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import network as net_mod
+from ..core.protocol import PROTOCOLS, get_protocol
+from ..core.state import empty_outbox
+
+
+def list_protocols() -> list:
+    """GET /w/protocols (Server.java:56-70)."""
+    return sorted(PROTOCOLS)
+
+
+def protocol_parameters(name: str) -> dict:
+    """GET /w/protocols/{name}: the parameter template with defaults (the
+    WParameters JSON analogue)."""
+    cls = get_protocol(name)
+    sig = inspect.signature(cls.__init__)
+    out = {}
+    for pname, prm in sig.parameters.items():
+        if pname == "self":
+            continue
+        out[pname] = None if prm.default is inspect.Parameter.empty \
+            else prm.default
+    return out
+
+
+class Server:
+    """Mirrors wserver/Server.java's surface, state-pytree edition."""
+
+    def __init__(self):
+        self.protocol = None
+        self.protocol_name = None
+        self.net = None
+        self.pstate = None
+        self.runner = None
+        self.externals = {}           # node id -> handler(list[dict])->list
+
+    # ---- lifecycle (IServer.init / runMs) ----
+
+    def init(self, name: str, params: dict | None = None, seed: int = 0):
+        cls = get_protocol(name)
+        self.protocol = cls(**(params or {}))
+        self.protocol_name = name
+        self.net, self.pstate = self.protocol.init(seed)
+        self.runner = net_mod.Runner(self.protocol, donate=False)
+        self.externals = {}
+
+    def _require(self):
+        if self.protocol is None:
+            raise RuntimeError("no protocol initialized (POST /network/init)")
+
+    def run_ms(self, ms: int) -> None:
+        self._require()
+        if not self.externals:
+            self.net, self.pstate = self.runner.run_ms(self.net, self.pstate,
+                                                       ms)
+            return
+        # With externals attached: single-ms steps + bridge per ms.
+        for _ in range(int(ms)):
+            t = int(self.net.time)
+            for nid, handler in self.externals.items():
+                delivered = self.peek_messages(nid, t)
+                if delivered:
+                    for msg in handler(delivered) or []:
+                        self.send(msg["from"], msg["to"],
+                                  msg.get("payload"), msg.get("delay", 0))
+            self.net, self.pstate = self.runner.run_ms(self.net, self.pstate,
+                                                       1)
+
+    def time(self) -> int:
+        self._require()
+        return int(self.net.time)
+
+    # ---- node state ----
+
+    def node_info(self, nid: int) -> dict:
+        self._require()
+        nid = int(nid)
+        if not (0 <= nid < self.protocol.cfg.n):
+            raise ValueError(f"no node {nid}; network has "
+                             f"{self.protocol.cfg.n} nodes")
+        nd = self.net.nodes
+        return {
+            "nodeId": int(nid),
+            "x": int(nd.x[nid]), "y": int(nd.y[nid]),
+            "city": int(nd.city[nid]),
+            "down": bool(nd.down[nid]),
+            "byzantine": bool(nd.byzantine[nid]),
+            "external": int(nid) in self.externals,
+            "doneAt": int(nd.done_at[nid]),
+            "msgReceived": int(nd.msg_received[nid]),
+            "msgSent": int(nd.msg_sent[nid]),
+            "bytesReceived": int(nd.bytes_received[nid]),
+            "bytesSent": int(nd.bytes_sent[nid]),
+        }
+
+    def all_nodes(self) -> list:
+        self._require()
+        nd = self.net.nodes
+        cols = {k: np.asarray(getattr(nd, v)) for k, v in [
+            ("x", "x"), ("y", "y"), ("city", "city"), ("down", "down"),
+            ("byzantine", "byzantine"), ("doneAt", "done_at"),
+            ("msgReceived", "msg_received"), ("msgSent", "msg_sent"),
+            ("bytesReceived", "bytes_received"),
+            ("bytesSent", "bytes_sent")]}
+        out = []
+        for i in range(self.protocol.cfg.n):
+            row = {k: v[i].item() for k, v in cols.items()}
+            row["nodeId"] = i
+            row["external"] = i in self.externals
+            out.append(row)
+        return out
+
+    def stop_node(self, nid: int) -> None:
+        """POST /network/nodes/{id}/stop (Server.java:135-143)."""
+        self._set_down(nid, True)
+
+    def start_node(self, nid: int) -> None:
+        self._set_down(nid, False)
+
+    def _set_down(self, nid: int, val: bool) -> None:
+        self._require()
+        if not (0 <= int(nid) < self.protocol.cfg.n):
+            raise ValueError(f"no node {nid}")
+        nodes = self.net.nodes
+        self.net = self.net.replace(
+            nodes=nodes.replace(down=nodes.down.at[int(nid)].set(val)))
+
+    # ---- messages ----
+
+    def peek_messages(self, nid: int | None = None,
+                      at: int | None = None) -> list:
+        """GET /network/messages: pending deliveries as EnvelopeInfo dicts
+        (EnvelopeInfo.java; arrivingAt == the peeked ms only — the mailbox
+        is time-bucketed, so we report the next deliverable slice)."""
+        self._require()
+        cfg = self.protocol.cfg
+        t = int(self.net.time) if at is None else int(at)
+        # Externals are stopped in-engine (their deliveries are diverted to
+        # the handler, like Network.java:616-623 skipping action); lift the
+        # down flag for the peek so their inbox is visible.
+        net = self.net
+        if self.externals:
+            down = net.nodes.down
+            for x in self.externals:
+                down = down.at[x].set(False)
+            net = net.replace(nodes=net.nodes.replace(down=down))
+        inbox, _, _ = net_mod.build_inbox(cfg, self.protocol.latency,
+                                          net, jnp.asarray(t))
+        valid = np.asarray(inbox.valid)
+        src = np.asarray(inbox.src)
+        data = np.asarray(inbox.data)
+        out = []
+        rows = range(cfg.n) if nid is None else [int(nid)]
+        for i in rows:
+            for s in np.nonzero(valid[i])[0]:
+                out.append({"from": int(src[i, s]), "to": int(i),
+                            "arrivingAt": t,
+                            "payload": [int(x) for x in data[i, s]]})
+        return out
+
+    def send(self, src: int, dest: int, payload=None, delay: int = 0):
+        """POST /network/send (SendMessage.java): inject a unicast."""
+        self._require()
+        cfg = self.protocol.cfg
+        out = empty_outbox(cfg)
+        pl = jnp.zeros((cfg.payload_words,), jnp.int32)
+        for i, v in enumerate((payload or [])[:cfg.payload_words]):
+            pl = pl.at[i].set(int(v))
+        out = out.replace(
+            dest=out.dest.at[int(src), 0].set(int(dest)),
+            payload=out.payload.at[int(src), 0].set(pl),
+            delay=out.delay.at[int(src), 0].set(int(delay)))
+        # A stopped/external sender still injects (the reference's inject
+        # path goes through Network.send on the external's behalf).
+        was_down = bool(self.net.nodes.down[int(src)])
+        net = self.net
+        if was_down:
+            net = net.replace(nodes=net.nodes.replace(
+                down=net.nodes.down.at[int(src)].set(False)))
+        net = net_mod.enqueue_unicast(cfg, self.protocol.latency, net, out,
+                                      jnp.asarray(int(net.time)))
+        if was_down:
+            net = net.replace(nodes=net.nodes.replace(
+                down=net.nodes.down.at[int(src)].set(True)))
+        self.net = net
+
+    # ---- external bridge (External.java / ExternalRest.java) ----
+
+    def set_external(self, nid: int, handler) -> None:
+        """Mark a node external: stop it in-engine, route its deliveries to
+        `handler(list[EnvelopeInfo]) -> list[SendMessage dict]`."""
+        self._require()
+        self.stop_node(nid)
+        self.externals[int(nid)] = handler
+
+    def clear_external(self, nid: int) -> None:
+        self._require()
+        self.externals.pop(int(nid), None)
+        self.start_node(nid)

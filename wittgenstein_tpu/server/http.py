@@ -1,0 +1,148 @@
+"""REST facade over server.core.Server — WServer endpoint parity.
+
+Mirrors ws/WServer.java:20-100 under the same `/w` prefix using only the
+standard library (the environment bakes no web framework; Spring-Boot's
+role is played by ThreadingHTTPServer):
+
+    GET  /w/protocols                      list registered protocols
+    GET  /w/protocols/{name}               parameter template
+    POST /w/network/init/{name}            body: parameter JSON
+    POST /w/network/runMs/{ms}
+    GET  /w/network/time
+    GET  /w/network/nodes
+    GET  /w/network/nodes/{id}
+    GET  /w/network/messages               pending deliveries (next ms)
+    POST /w/network/nodes/{id}/stop
+    POST /w/network/nodes/{id}/start
+    POST /w/network/nodes/{id}/external    body: {"url": ...} — deliveries
+                                           POSTed there (ExternalRest.java)
+    POST /w/network/send                   body: {from, to, payload, delay}
+
+Run: python -m wittgenstein_tpu.server.http [port]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import core
+
+
+def _external_rest(url: str):
+    """ExternalRest parity (wserver/ExternalRest.java:44-59): POST the
+    EnvelopeInfo list as JSON; the response body is a SendMessage list."""
+
+    def handler(delivered):
+        req = urllib.request.Request(
+            url, data=json.dumps(delivered).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                body = resp.read()
+                return json.loads(body) if body else []
+        except Exception:
+            return []
+
+    return handler
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "wittgenstein-tpu"
+
+    ROUTES = [
+        ("GET", r"^/w/protocols$",
+         lambda s, m, b: core.list_protocols()),
+        ("GET", r"^/w/protocols/([A-Za-z0-9_]+)$",
+         lambda s, m, b: core.protocol_parameters(m.group(1))),
+        ("POST", r"^/w/network/init/([A-Za-z0-9_]+)$",
+         lambda s, m, b: s.srv.init(m.group(1), b or {},
+                                    seed=(b or {}).pop("seed", 0))),
+        ("POST", r"^/w/network/runMs/(\d+)$",
+         lambda s, m, b: s.srv.run_ms(int(m.group(1)))),
+        ("GET", r"^/w/network/time$",
+         lambda s, m, b: s.srv.time()),
+        ("GET", r"^/w/network/nodes$",
+         lambda s, m, b: s.srv.all_nodes()),
+        ("GET", r"^/w/network/nodes/(\d+)$",
+         lambda s, m, b: s.srv.node_info(int(m.group(1)))),
+        ("GET", r"^/w/network/messages$",
+         lambda s, m, b: s.srv.peek_messages()),
+        ("POST", r"^/w/network/nodes/(\d+)/stop$",
+         lambda s, m, b: s.srv.stop_node(int(m.group(1)))),
+        ("POST", r"^/w/network/nodes/(\d+)/start$",
+         lambda s, m, b: s.srv.start_node(int(m.group(1)))),
+        ("POST", r"^/w/network/nodes/(\d+)/external$",
+         lambda s, m, b: s.srv.set_external(
+             int(m.group(1)), _external_rest((b or {})["url"]))),
+        ("POST", r"^/w/network/send$",
+         lambda s, m, b: s.srv.send(b["from"], b["to"], b.get("payload"),
+                                    b.get("delay", 0))),
+    ]
+
+    @property
+    def srv(self) -> core.Server:
+        return self.server.sim_server
+
+    def _dispatch(self, method):
+        body = None
+        ln = int(self.headers.get("Content-Length") or 0)
+        if ln:
+            body = json.loads(self.rfile.read(ln) or b"{}")
+        for meth, pattern, fn in self.ROUTES:
+            if meth != method:
+                continue
+            m = re.match(pattern, self.path)
+            if m:
+                # One simulation, one lock: the engine itself is
+                # single-threaded by contract (Network.java:7-11).
+                with self.server.sim_lock:
+                    try:
+                        result = fn(self, m, body)
+                    except Exception as e:  # surface as a 400, like Spring
+                        self._reply(400, {"error": str(e)})
+                        return
+                self._reply(200, result if result is not None else {"ok": 1})
+                return
+        self._reply(404, {"error": f"no route {method} {self.path}"})
+
+    def _reply(self, status, payload):
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def make_server(port: int = 0) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    httpd.sim_server = core.Server()
+    httpd.sim_lock = threading.Lock()
+    return httpd
+
+
+def main(port: int = 8078):
+    # Protocol registry fills as models import (the classpath-scan analogue)
+    from .. import models  # noqa: F401
+    httpd = make_server(port)
+    print(f"wittgenstein-tpu server on http://127.0.0.1:"
+          f"{httpd.server_address[1]}/w")
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8078)

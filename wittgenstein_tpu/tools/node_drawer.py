@@ -1,0 +1,68 @@
+"""Geo visualization — tools/NodeDrawer.java parity with PIL.
+
+Draws every node at its map position colored red -> green by a value in
+[vmin, vmax] (NodeDrawer.java:215-240); frames accumulate into an animated
+GIF (GifSequenceWriter parity).  The reference blits its bundled
+world-map-2000px.png; we synthesize a graticule background so the package
+stays self-contained.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.state import MAX_X, MAX_Y
+
+
+def _background():
+    from PIL import Image, ImageDraw
+    img = Image.new("RGB", (MAX_X, MAX_Y), (12, 18, 32))
+    d = ImageDraw.Draw(img)
+    for x in range(0, MAX_X, 125):
+        d.line([(x, 0), (x, MAX_Y)], fill=(28, 38, 58))
+    for y in range(0, MAX_Y, 125):
+        d.line([(0, y), (MAX_X, y)], fill=(28, 38, 58))
+    return img
+
+
+class NodeDrawer:
+    """status(nodes) -> per-node value; red (vmin) -> green (vmax)."""
+
+    def __init__(self, vmin: float, vmax: float, dot: int = 4):
+        self.vmin, self.vmax = float(vmin), float(vmax)
+        self.dot = dot
+        self.frames: list = []
+
+    def draw(self, nodes, values, special=None):
+        from PIL import ImageDraw
+        img = _background()
+        d = ImageDraw.Draw(img)
+        xs = np.asarray(nodes.x)
+        ys = np.asarray(nodes.y)
+        down = np.asarray(nodes.down)
+        vals = np.asarray(values, dtype=np.float64)
+        span = max(self.vmax - self.vmin, 1e-9)
+        r = self.dot
+        for i in range(len(xs)):
+            if down[i]:
+                color = (90, 90, 90)
+            else:
+                f = min(max((vals[i] - self.vmin) / span, 0.0), 1.0)
+                color = (int(255 * (1 - f)), int(255 * f), 40)
+            box = (xs[i] - r, ys[i] - r, xs[i] + r, ys[i] + r)
+            if special is not None and special[i]:
+                d.ellipse((box[0] - 2, box[1] - 2, box[2] + 2, box[3] + 2),
+                          outline=(255, 255, 0))
+            d.ellipse(box, fill=color)
+        self.frames.append(img)
+        return img
+
+    def save_png(self, path: str):
+        self.frames[-1].save(path)
+
+    def save_gif(self, path: str, ms_per_frame: int = 150):
+        if not self.frames:
+            raise ValueError("no frames drawn")
+        self.frames[0].save(path, save_all=True,
+                            append_images=self.frames[1:],
+                            duration=ms_per_frame, loop=0)

@@ -1,0 +1,49 @@
+"""Checkpoint / resume for simulation state.
+
+The reference has no checkpointing — its replication mechanism is
+`Protocol.copy()` + `init()` + reseed (core/Protocol.java:14-18,
+RunMultipleTimes.java:45-47; SURVEY.md §5.4 notes the Envelope design
+explicitly enabled-but-never-used on-disk serialization).  Here the whole
+simulation is one state pytree, so checkpointing is exact by construction:
+save the (NetState, pstate) pair, restore it, and the continuation is
+bit-identical to an uninterrupted run (tests/test_checkpoint.py).
+
+Format: a single .npz of flattened pytree leaves (portable, no directory
+trees, loads anywhere numpy does).  `save`/`load` round-trip any pytree of
+jax/numpy arrays; shapes/dtypes are restored exactly.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save(path: str, net, pstate, meta: dict | None = None) -> None:
+    """Write the full simulator state to `path` (.npz)."""
+    leaves, treedef = jax.tree.flatten((net, pstate))
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **arrays)
+
+
+def load(path: str, protocol, seed=0):
+    """Restore (net, pstate, meta).  `protocol` must be constructed with
+    the same parameters as at save time — its `init` supplies the pytree
+    structure the stored leaves are poured back into."""
+    net0, ps0 = protocol.init(seed)
+    _, treedef = jax.tree.flatten((net0, ps0))
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode()) if "__meta__" in z \
+            else {}
+        leaves = []
+        i = 0
+        while f"leaf_{i}" in z:
+            leaves.append(jnp.asarray(z[f"leaf_{i}"]))
+            i += 1
+    net, pstate = jax.tree.unflatten(treedef, leaves)
+    return net, pstate, meta
