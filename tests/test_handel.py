@@ -95,6 +95,59 @@ def test_desynchronized_start():
     assert (np.asarray(net.nodes.done_at) > 0).all()
 
 
+def test_byzantine_suicide():
+    """byzantineSuicide (Handel.java:538-559): byzantine nodes plant invalid
+    sigs that honest nodes burn pairing slots on, then blacklist.  The run
+    must still complete, with blacklists populated and determinism kept."""
+    n, down = 64, 8
+    proto = Handel(node_count=n, threshold=n - down, nodes_down=down,
+                   byzantine_suicide=True, pairing_time=3,
+                   level_wait_time=20, dissemination_period_ms=10,
+                   network_latency_name="NetworkFixedLatency(20)")
+    outs = []
+    for seed in (0, 0):
+        net, p = proto.init(seed)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 1500)
+        outs.append(np.asarray(net.nodes.done_at))
+        live = ~np.asarray(net.nodes.down)
+        assert (outs[-1][live] > 0).all()
+        # Every byzantine sig verified is a blacklist entry on some honest
+        # node; the attack fires as long as ranks fall inside windows.
+        assert int(bitset.popcount(p.blacklist).sum()) > 0
+        # Blacklisted ids are all down (byzantine) nodes.
+        bl = np.asarray(p.blacklist)
+        downs = np.asarray(net.nodes.down)
+        for i in np.where(live)[0][:8]:
+            ids = [b for b in range(n) if bl[i, b // 32] >> (b % 32) & 1]
+            assert all(downs[b] for b in ids)
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_hidden_byzantine():
+    """HiddenByzantine (Handel.java:840-917): useless 1-bit sigs steal
+    verification slots; completion still happens, determinism kept."""
+    n, down = 64, 8
+    proto = Handel(node_count=n, threshold=n - down, nodes_down=down,
+                   hidden_byzantine=True, pairing_time=3,
+                   level_wait_time=20, dissemination_period_ms=10,
+                   network_latency_name="NetworkFixedLatency(20)")
+    outs = []
+    for seed in (0, 0):
+        net, p = proto.init(seed)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 2000)
+        outs.append(np.asarray(net.nodes.done_at))
+        live = ~np.asarray(net.nodes.down)
+        assert (outs[-1][live] > 0).all()
+        # Hidden byzantine bits get merged as valid contributions: some
+        # down-node bits must appear in honest nodes' verified sets.
+        inc = np.asarray(p.last_agg | p.ver_ind)
+        downs = np.where(np.asarray(net.nodes.down))[0]
+        hit = sum(int(inc[i, b // 32] >> (b % 32) & 1)
+                  for i in np.where(live)[0] for b in downs)
+        assert hit > 0
+    assert np.array_equal(outs[0], outs[1])
+
+
 def test_message_filtering_after_done():
     proto = Handel(node_count=64, threshold=63, extra_cycle=5,
                    network_latency_name="NetworkFixedLatency(20)",

@@ -120,7 +120,8 @@ class Handel(LevelMixin):
                  node_builder_name=None, network_latency_name=None,
                  desynchronized_start=0, window_initial=16, window_min=1,
                  window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
-                 emission_lookahead=8):
+                 emission_lookahead=8, byzantine_suicide=False,
+                 hidden_byzantine=False):
         if node_count & (node_count - 1):
             raise ValueError("we support only power-of-two node counts "
                              "(Handel.java:119-121)")
@@ -150,6 +151,11 @@ class Handel(LevelMixin):
         self.window_max = window_max
         self.queue_cap = queue_cap
         self.emission_lookahead = emission_lookahead
+        if (byzantine_suicide or hidden_byzantine) and not nodes_down:
+            raise ValueError("byzantine attacks need nodes_down > 0 "
+                             "(the attacker controls the down nodes)")
+        self.byzantine_suicide = byzantine_suicide
+        self.hidden_byzantine = hidden_byzantine
         self.builder = builders.get_by_name(node_builder_name)
         self.latency = latency_mod.get_by_name(network_latency_name)
 
@@ -180,6 +186,33 @@ class Handel(LevelMixin):
         permutation)."""
         key = prng.hash3(seed, TAG_RANK, i_ids)
         return prng.bij_perm(key, s_ids, self.bits)
+
+    def _byz_candidates(self, p, nodes, excl_bits):
+        """Per (node, level) lowest-reception-rank byzantine (down) peer,
+        excluding senders whose bit is set in `excl_bits` [N, W].  The
+        adversary's peer scan of createSuicideByzantineSig
+        (Handel.java:538-559) and HiddenByzantine.firstByzantine (:844-858),
+        as masked per-level argmin sweeps over the contiguous level ranges.
+        Returns ([N, L] rank — BIG when none, [N, L] id — -1 when none).
+        O(N^2) work: only evaluated when an attack flag is on."""
+        n, L = self.node_count, self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        br = jnp.full((n, L), BIG, jnp.int32)
+        bi = jnp.full((n, L), -1, jnp.int32)
+        for l in range(1, L):
+            half = 1 << (l - 1)
+            base = _sibling_base(ids, half)
+            cand = base[:, None] + jnp.arange(half, dtype=jnp.int32)[None, :]
+            rank = self._rank(p.seed, ids[:, None], cand) + \
+                jnp.where(_get_bit_rows(p.demoted, cand), n, 0)
+            ok = nodes.down[cand] & ~_get_bit_rows(excl_bits, cand)
+            rank = jnp.where(ok, rank, BIG)
+            pos = jnp.argmin(rank, axis=1)
+            best = jnp.take_along_axis(rank, pos[:, None], axis=1)[:, 0]
+            bid = jnp.take_along_axis(cand, pos[:, None], axis=1)[:, 0]
+            br = br.at[:, l].set(best)
+            bi = bi.at[:, l].set(jnp.where(best < BIG, bid, -1))
+        return br, bi
 
     # ---------------------------------------------------------------- init
 
@@ -461,6 +494,19 @@ class Handel(LevelMixin):
         best_slot = jnp.where(in_ok, in_slot, out_slot)        # [N, L]
         has_best = (in_ok | out_ok) & due[:, None]
 
+        # byzantineSuicide (Handel.java:538-559, :577-583): if a still-
+        # unblacklisted byzantine peer's rank falls inside the level's
+        # verification window, the adversary plants an invalid signature
+        # from it, and it preempts the level's honest pick.
+        if self.byzantine_suicide:
+            sbr, sbi = self._byz_candidates(p, nodes, p.blacklist)
+            # Strict < here vs <= in the honest window test above is the
+            # reference's own boundary convention (:545 `rank < maxRank`
+            # vs :597 `rank <= windowIndex + currWindowSize`).
+            s_ok = ((win_lo < BIG) &
+                    (sbr < win_lo + p.curr_window[:, None]))   # [N, L]
+            has_best = has_best | (s_ok & due[:, None])
+
         # chooseBestFromLevels (:788-790): uniform random non-empty level.
         cnt = jnp.sum(has_best, axis=1).astype(jnp.int32)
         r = prng.uniform_int(prng.hash3(p.seed, TAG_LEVEL, t), ids,
@@ -473,6 +519,50 @@ class Handel(LevelMixin):
         vfrom = gather2d(p.q_from, ids, slot)
         vbad = gather2d(p.q_bad, ids, slot)
         vsig = gather_rows(p.q_sig, ids, slot)
+        # keep_entry: the picked QUEUE slot survives (an adversarial sig was
+        # verified instead; the honest entry stays queued, :577-583,:905-913).
+        keep_entry = jnp.zeros_like(do)
+
+        if self.byzantine_suicide:
+            use_s = do & gather2d(s_ok, ids, pick_level)
+            s_id = gather2d(sbi, ids, pick_level)
+            # An s_ok level may have no honest candidate at all; the planted
+            # sig is then the only pick for it.
+            vfrom = jnp.where(use_s, s_id, vfrom)
+            vbad = vbad | use_s
+            vsig = jnp.where(use_s[:, None], U32(0), vsig)
+            keep_entry = keep_entry | use_s
+
+        # HiddenByzantine (Handel.java:840-917): flood with valid but useless
+        # single-signer aggregates from byzantine peers.  If a byzantine peer
+        # outranks the picked signature, the adversary injects a 1-bit sig
+        # from it; a rerun of bestToVerify then either verifies the plant
+        # (wasting the pairing slot) or leaves it polluting the queue.
+        if self.hidden_byzantine:
+            hbr, hbi = self._byz_candidates(p, nodes,
+                                            p.blacklist | total_inc)
+            h_rank = gather2d(hbr, ids, pick_level)
+            h_id = gather2d(hbi, ids, pick_level)
+            honest = do & ~keep_entry
+            # No re-attack while the previous plant for this (id, level) is
+            # still queued (the `last`-in-toVerifyAgg check, :883-893).
+            queued = jnp.any((p.q_from == h_id[:, None]) &
+                             (p.q_lvl == pick_level[:, None]), axis=1)
+            can = (honest & (h_id >= 0) & ~queued &
+                   (h_rank < gather2d(p.q_rank, ids, slot)))   # :898-901
+            # Rerun verdict: the plant is inside its own window; it beats an
+            # outside-window pick outright, an inside pick only on score.
+            # Plant score = aggregate card + 1 (disjoint single bit, :651-664).
+            h_score = gather2d(agg_pc, ids, pick_level) + 1
+            s_picked = gather2d(score, ids, slot)
+            was_in = gather2d(in_ok, ids, pick_level)
+            h_win = can & (~was_in | (h_score > s_picked))
+            h_sig = bitset.one_bit(jnp.maximum(h_id, 0), w)
+            vfrom = jnp.where(h_win, h_id, vfrom)
+            vbad = vbad & ~h_win
+            vsig = jnp.where(h_win[:, None], h_sig, vsig)
+            keep_entry = keep_entry | h_win
+            h_fail = can & ~h_win                               # :905-913
 
         # Window resize (:821-823): grow on good, shrink on bad, clamped to
         # [min, max] then to the level size.
@@ -489,10 +579,28 @@ class Handel(LevelMixin):
 
         # Curation sweep for due nodes + removal of the picked entry.
         q_from = jnp.where(due[:, None] & ~keep, -1, p.q_from)
-        q_from = set2d(q_from, ids, slot, -1, ok=do)
+        q_from = set2d(q_from, ids, slot, -1, ok=do & ~keep_entry)
+        q_lvl, q_rank, q_bad, q_sig = p.q_lvl, p.q_rank, p.q_bad, p.q_sig
+
+        if self.hidden_byzantine:
+            # A failed attack leaves the plant in the queue (:905-913),
+            # in a free slot or evicting the worst-ranked entry.
+            free = q_from < 0
+            any_free = jnp.any(free, axis=1)
+            worst = jnp.argmax(jnp.where(free, -1, q_rank), axis=1)
+            worst_rank = jnp.take_along_axis(q_rank, worst[:, None],
+                                             axis=1)[:, 0]
+            islot = jnp.where(any_free, jnp.argmax(free, axis=1), worst)
+            ins = h_fail & (any_free | (h_rank < worst_rank))
+            q_from = set2d(q_from, ids, islot, h_id, ok=ins)
+            q_lvl = set2d(q_lvl, ids, islot, pick_level, ok=ins)
+            q_rank = set2d(q_rank, ids, islot, h_rank, ok=ins)
+            q_bad = set2d(q_bad, ids, islot, False, ok=ins)
+            q_sig = set_rows(q_sig, ids, islot, h_sig, ok=ins)
 
         return p.replace(
-            q_from=q_from, curr_window=curr_window, demoted=demoted,
+            q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_bad=q_bad,
+            q_sig=q_sig, curr_window=curr_window, demoted=demoted,
             pend_from=jnp.where(do, vfrom, p.pend_from),
             pend_level=jnp.where(do, pick_level, p.pend_level),
             pend_bad=jnp.where(do, vbad, p.pend_bad),

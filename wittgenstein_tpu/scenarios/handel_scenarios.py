@@ -119,6 +119,42 @@ def period_sweep(periods=(10, 20, 50), nodes=256, seeds=4, out_dir="."):
     return csv
 
 
+def byz_suicide_sweep(ratios=(0.0, 0.1, 0.25, 0.5), nodes=256, seeds=4,
+                      out_dir="."):
+    """byzantineSuicide attack impact sweep (HandelScenarios.runOnce with
+    byzantineSuicide, :204-257): byzantine ratio vs time-to-aggregate of the
+    honest majority.  Threshold stays at 0.99 * live."""
+    csv = CSVFormatter(["byz_ratio", "avg_done_ms", "max_done_ms",
+                        "frac_done"])
+    for ratio in ratios:
+        params = default_params(nodes=nodes, dead_ratio=ratio,
+                                byzantine_suicide=ratio > 0)
+        r = _run_point(params, seeds, max_time=8000)
+        csv.add(byz_ratio=ratio, avg_done_ms=round(r["avg_done_ms"], 1),
+                max_done_ms=round(r["max_done_ms"], 1),
+                frac_done=round(r["frac_done"], 3))
+        print(f"byz_suicide ratio={ratio}: {r}")
+    csv.save(f"{out_dir}/handel_byz_suicide.csv")
+    return csv
+
+
+def hidden_byz_sweep(ratios=(0.0, 0.1, 0.25, 0.5), nodes=256, seeds=4,
+                     out_dir="."):
+    """hiddenByzantine attack impact sweep (HandelScenarios :259-289)."""
+    csv = CSVFormatter(["byz_ratio", "avg_done_ms", "max_done_ms",
+                        "frac_done"])
+    for ratio in ratios:
+        params = default_params(nodes=nodes, dead_ratio=ratio,
+                                hidden_byzantine=ratio > 0)
+        r = _run_point(params, seeds, max_time=8000)
+        csv.add(byz_ratio=ratio, avg_done_ms=round(r["avg_done_ms"], 1),
+                max_done_ms=round(r["max_done_ms"], 1),
+                frac_done=round(r["frac_done"], 3))
+        print(f"hidden_byz ratio={ratio}: {r}")
+    csv.save(f"{out_dir}/handel_hidden_byz.csv")
+    return csv
+
+
 def gen_anim(nodes=256, out_path="handel.gif", frames=20, frame_ms=50):
     """Animated GIF of aggregation progress (HandelScenarios.genAnim :291,
     NodeDrawer parity)."""
