@@ -54,9 +54,10 @@ from ..ops import bitset, prng
 U32 = jnp.uint32
 TAG_MINE = 0x504F5731
 
-HONEST, SELFISH, SELFISH2 = 0, 1, 2
+HONEST, SELFISH, SELFISH2, AGENT = 0, 1, 2, 3
 STRATEGIES = {"": HONEST, None: HONEST, "ETHMiner": HONEST,
-              "ETHSelfishMiner": SELFISH, "ETHSelfishMiner2": SELFISH2}
+              "ETHSelfishMiner": SELFISH, "ETHSelfishMiner2": SELFISH2,
+              "ETHAgentMiner": AGENT, "ETHMinerAgent": AGENT}
 
 GENESIS_HEIGHT = 7_951_081                  # POWBlock genesis (:158-165)
 GENESIS_DIFF_RAW = 1_949_482_043_446_410
@@ -149,8 +150,10 @@ class ETHPoW:
         self.has_byz = byz_class_name not in (None, "")
         self.byz_ratio = byz_mining_ratio if self.has_byz else 0.0
         self.tick_ms = tick_ms
-        self.capacity = capacity
-        self.aw = bc.n_words(capacity)
+        # Round up to whole bitset words: block-set masks reshape [A] as
+        # [aw, 32] (e.g. the AGENT overtaken-publish path).
+        self.capacity = -(-capacity // 32) * 32
+        self.aw = bc.n_words(self.capacity)
         self.builder = builders.get_by_name(node_builder_name)
         self.latency = _TickScaled(
             latency_mod.get_by_name(network_latency_name), tick_ms)
@@ -446,13 +449,32 @@ class ETHPoW:
                                          (p.private_blk, w2_go))
             top = jnp.where(w2_go, top2, top)
 
-            do_rel = ahead & ~guard_fail
+            do_rel = ahead & ~guard_fail & (p.strategy != AGENT)
             unsent, rel = self._release_chain(
                 p, jnp.where(do_rel, top, -1), ids)
             oh2 = self._best(p, p.others_head,
                              jnp.where(do_rel, top, -1), ids)
             p = p.replace(mined_unsent=unsent, release=rel,
                           others_head=oh2)
+
+            # AGENT (ETHMinerAgent.onReceivedBlock :186-196): private blocks
+            # at height <= the others' head can no longer win the race —
+            # publish them (queued broadcasts drain one per tick).  Only
+            # node 1 ever runs AGENT, so build the overtaken mask for that
+            # row alone instead of an [N, A] sweep per inbox slot.
+            if self.byz_strategy == AGENT:
+                agent_rcv1 = new[1] & (p.strategy[1] == AGENT)
+                oth_h2 = p.arena.height[jnp.maximum(p.others_head[1], 0)]
+                over = (p.arena.height <= oth_h2).reshape(aw, 32)
+                packed = jnp.sum(
+                    over.astype(U32) << jnp.arange(32, dtype=U32)[None, :],
+                    axis=1)
+                over_bits = jnp.where(agent_rcv1,
+                                      p.mined_unsent[1] & packed, U32(0))
+                p = p.replace(
+                    mined_unsent=p.mined_unsent.at[1].set(
+                        p.mined_unsent[1] & ~over_bits),
+                    release=p.release.at[1].set(p.release[1] | over_bits))
 
         # ---- mining tick (mine10ms :118-129) ----
         miner = alive & (p.hash_power > 0)
@@ -493,7 +515,8 @@ class ETHPoW:
         private_blk = jnp.where(sel_found, blk, p.private_blk)
         p = p.replace(release=release, mined_unsent=mined_unsent,
                       private_blk=private_blk,
-                      mine_private=p.mine_private | sel_found)
+                      mine_private=p.mine_private |
+                      (sel_found & (p.strategy != AGENT)))
 
         # selfish onMinedBlock (:38-53): at deltaP == 0 with two own blocks
         # in a row, publish the private chain.  (The reference's deltaP
@@ -504,7 +527,8 @@ class ETHPoW:
                            p.arena.height[jnp.maximum(p.private_blk, 0)], 0)
         oth_h = p.arena.height[jnp.maximum(p.others_head, 0)]
         depth2 = self._depth(p, p.private_blk, ids) == 2
-        pub = sel_found & (priv_h - (oth_h - 1) == 0) & depth2
+        pub = sel_found & (p.strategy != AGENT) & \
+            (priv_h - (oth_h - 1) == 0) & depth2
         unsent, rel = self._release_chain(
             p, jnp.where(pub, p.private_blk, -1), ids)
         oh = self._best(p, p.others_head,
@@ -555,6 +579,298 @@ def rewards_by_miner(pstate, head: int, until_height: int = 0) -> dict:
         out[prod] = out.get(prod, 0.0) + rwd + p_extra
         cur = int(arena["parent"][cur])
     return out
+
+
+def avg_difficulty(pstate, head: int, until_height: int = 0) -> float:
+    """avgDifficulty (ETHPoW.java:232-239): mean raw difficulty over the
+    chain from `head` down to (excluding) `until_height`."""
+    arena = bc.to_numpy(pstate.arena)
+    diff = np.asarray(pstate.diff_s, np.float64) * 2.0 ** DIFF_SHIFT
+    tot, cnt, cur = 0.0, 0, int(head)
+    while cur > 0 and arena["height"][cur] > until_height:
+        tot += diff[cur]
+        cnt += 1
+        cur = int(arena["parent"][cur])
+    return tot / max(1, cnt)
+
+
+def try_miner(builder_name, nl_name, miner, pows, hours, runs,
+              number_of_miners=10, tick_ms=10, chunk=2000, capacity=8192,
+              **proto_kw):
+    """Strategy-evaluation harness (ETHMiner.tryMiner, ETHMiner.java:234-308)
+    reshaped for the TPU: all `runs` seeds execute as ONE vmapped batch
+    instead of the reference's sequential loop.  `miner` is the strategy
+    name ('ETHMiner', 'ETHSelfishMiner', ...).  Prints the reference's CSV
+    header/rows and returns the rows as dicts."""
+    from ..core.harness import run_multiple_times
+    print("miner, hashrate ratio, revenue ratio, revenue, uncle rate, "
+          "total revenue, avg difficulty")
+    rows = []
+    ticks = int(hours * 3600 * 1000) // tick_ms
+    for pw in pows:
+        proto = ETHPoW(number_of_miners=number_of_miners,
+                       byz_class_name=miner, byz_mining_ratio=pw,
+                       node_builder_name=builder_name,
+                       network_latency_name=nl_name, tick_ms=tick_ms,
+                       capacity=capacity, **proto_kw)
+        res = run_multiple_times(
+            proto, run_count=runs, max_time=ticks, chunk=chunk,
+            first_seed=1, cont_if=lambda net, ps: jnp.asarray(True))
+        rew1 = ur = diff = tot = 0.0
+        for i in range(runs):
+            ps = jax.tree_util.tree_map(lambda x: x[i], res.pstates)
+            arena = bc.to_numpy(ps.arena)
+            # Observer node 0's head is the PUBLIC consensus chain — a
+            # selfish miner's own head may still include private blocks.
+            base = int(np.asarray(ps.head)[0])
+            # Skip warm-up and cool-down blocks on long runs (:255-263).
+            skip = 5000 if hours > 30 else 0
+            for _ in range(skip):
+                par = int(arena["parent"][base])
+                if par <= 0:
+                    break
+                base = par
+            limit = GENESIS_HEIGHT + skip
+            r = rewards_by_miner(ps, base, until_height=limit)
+            rew1 += r.get(1, 0.0)
+            tot += sum(r.values())
+            ur += uncle_rate(ps, base, until_height=limit)
+            diff += avg_difficulty(ps, base, until_height=limit)
+        row = dict(miner=miner or "ETHMiner", pow=pw,
+                   revenue_ratio=rew1 / max(tot, 1e-9),
+                   revenue=rew1 / runs, uncle_rate=ur / runs,
+                   total_revenue=tot / runs, avg_difficulty=diff / runs)
+        rows.append(row)
+        print(f"{row['miner']}/{nl_name}/{hours}/{runs}, {pw:.2f}, "
+              f"{row['revenue_ratio']:.4f}, {row['revenue']:.0f}, "
+              f"{row['uncle_rate']:.4f}, {row['total_revenue']:.0f}, "
+              f"{row['avg_difficulty']:.0f}")
+    return rows
+
+
+class Decision:
+    """ETHPoW.Decision (ETHPoW.java:350-375): a choice taken at
+    `taken_at_height`, evaluated when the head reaches `reward_at_height`.
+    `fields` land in the CSV row ahead of the reward."""
+
+    def __init__(self, taken_at_height: int, reward_at_height: int,
+                 fields=()):
+        if reward_at_height <= taken_at_height:
+            raise ValueError("reward height must be after the decision")
+        self.taken_at_height = taken_at_height
+        self.reward_at_height = reward_at_height
+        self.fields = tuple(fields)
+
+    def for_csv(self) -> str:
+        return ",".join(str(f) for f in
+                        (self.taken_at_height, self.reward_at_height)
+                        + self.fields)
+
+    def reward(self, pstate, head: int, miner_id: int = 1) -> float:
+        """Default reward: the miner's rewards on the head chain above the
+        decision height (Decision.reward :370-374)."""
+        return rewards_by_miner(pstate, head,
+                                until_height=self.taken_at_height
+                                ).get(miner_id, 0.0)
+
+
+class DecisionLog:
+    """ETHAgentMiner's decision bookkeeping (ETHAgentMiner.java:16-66):
+    decisions queue sorted by evaluation height; when the head passes one,
+    its realized reward is appended to `decisions.csv`."""
+
+    def __init__(self, path="decisions.csv", miner_id=1):
+        self.path = path
+        self.miner_id = miner_id
+        self.pending: list = []
+
+    def add(self, d: Decision):
+        import bisect
+        keys = [x.reward_at_height for x in self.pending]
+        self.pending.insert(bisect.bisect_right(keys, d.reward_at_height), d)
+
+    def on_new_head(self, pstate, head: int):
+        arena_h = int(np.asarray(pstate.arena.height)[int(head)])
+        out = []
+        while self.pending and self.pending[0].reward_at_height <= arena_h:
+            d = self.pending.pop(0)
+            out.append(f"{d.for_csv()},{d.reward(pstate, head, self.miner_id)}")
+        if out:
+            with open(self.path, "a") as f:
+                f.write("\n".join(out) + "\n")
+        return out
+
+
+class MinerAgentEnv:
+    """ETHMinerAgent parity (ethpow/ETHMinerAgent.java): step-wise control
+    of the byzantine miner for RL agents.  The reference needs a pyjnius
+    JVM bridge (:11-36); here the framework IS Python, so the env drives
+    the jitted simulation directly and reads state off the device.
+
+    The byzantine miner (node 1) runs strategy AGENT: it never publishes on
+    its own (sendMinedBlock -> false, :63-66) except for blocks already
+    overtaken by the public chain (:186-196); the agent decides with
+    `send_mined_blocks`."""
+
+    ON_MINED_BLOCK = 1       # decisionNeeded codes (:50-53)
+    ON_OTHER_NEW_HEAD = 2
+    ON_OTHER_PRIVATE_HEAD = 3
+
+    def __init__(self, byz_mining_ratio, seed=0, decision_log=None, **kw):
+        kw.setdefault("network_latency_name", "NetworkFixedLatency(1000)")
+        kw.setdefault("node_builder_name",
+                      builders.registry_name("cities", True, 0.0))
+        self.proto = ETHPoW(byz_class_name="ETHMinerAgent",
+                            byz_mining_ratio=byz_mining_ratio, **kw)
+        self.net, self.p = self.proto.init(seed)
+        self.log = decision_log
+
+    @classmethod
+    def create(cls, byz_mining_ratio, seed=0):
+        """ETHMinerAgent.create (:229-243)."""
+        return cls(byz_mining_ratio, seed)
+
+    # ------------------------------------------------------------- driving
+
+    def _until_decision_fn(self):
+        """One jitted device program: tick until decisionNeeded != 0
+        (goNextStep :92-102) — the whole polling loop stays on-device
+        instead of the reference's 1 ms Java round-trips."""
+        import jax as _jax
+        from ..core.network import step_ms
+        proto = self.proto
+
+        def go(net, p, budget):
+            def cond(st):
+                _, _, code, left = st
+                return (code == 0) & (left > 0)
+
+            def body(st):
+                net, p, _, left = st
+                h0, oh0 = p.head[1], p.others_head[1]
+                mu0 = bitset.popcount(p.mined_unsent[1])
+                net, p = step_ms(proto, net, p)
+                mu1 = bitset.popcount(p.mined_unsent[1])
+                h1 = p.head[1]
+                others = p.arena.producer[jnp.maximum(h1, 0)] != 1
+                code = jnp.where(
+                    mu1 > mu0, self.ON_MINED_BLOCK,
+                    jnp.where((mu1 > 0) & (h1 != h0) & others,
+                              self.ON_OTHER_NEW_HEAD,
+                              jnp.where((mu1 > 0) & (p.others_head[1] != oh0),
+                                        self.ON_OTHER_PRIVATE_HEAD, 0)))
+                return net, p, code, left - 1
+
+            return _jax.lax.while_loop(
+                cond, body, (net, p, jnp.int32(0), budget))
+
+        return _jax.jit(go)
+
+    def go_next_step(self, max_ticks=1_000_000) -> int:
+        """Advance the simulation until the agent has a decision to take
+        (goNextStep :92-102); returns the decision code (0 = budget hit)."""
+        if not hasattr(self, "_go"):
+            self._go = self._until_decision_fn()
+        self.net, self.p, code, _ = self._go(self.net, self.p,
+                                             jnp.int32(max_ticks))
+        code = int(code)
+        if self.log is not None:
+            self.log.on_new_head(self.p, int(np.asarray(self.p.head)[1]))
+        return code
+
+    def _unsent_blocks(self):
+        word = np.asarray(self.p.mined_unsent[1])
+        t = np.asarray(self.p.arena.time)
+        out = [b for b in range(self.proto.capacity)
+               if word[b // 32] >> (b % 32) & 1]
+        return sorted(out, key=lambda b: int(t[b]))      # oldest first
+
+    def send_mined_blocks(self, how_many: int):
+        """Publish the `how_many` oldest private blocks (sendMinedBlocks
+        :68-90 + actionSendOldestBlockMined :215-221)."""
+        blocks = self._unsent_blocks()
+        send, keep = blocks[:how_many], blocks[how_many:]
+        if not send:
+            return
+        aw = self.proto.aw
+        p = self.p
+        unsent = p.mined_unsent
+        release = p.release
+        for b in send:
+            bit = bitset.one_bit(jnp.asarray(b, jnp.int32), aw)
+            unsent = unsent.at[1].set(unsent[1] & ~bit)
+            release = release.at[1].set(release[1] | bit)
+        heights = np.asarray(p.arena.height)
+        top = max(send, key=lambda b: int(heights[b]))
+        oh = int(np.asarray(p.others_head)[1])
+        new_oh = top if int(heights[top]) > int(heights[oh]) else oh
+        self.p = p.replace(
+            mined_unsent=unsent, release=release,
+            others_head=p.others_head.at[1].set(new_oh),
+            private_blk=(p.private_blk if keep
+                         else p.private_blk.at[1].set(-1)),
+            # restart mining on the (possibly private) head (:83-85)
+            min_father=p.min_father.at[1].set(-1))
+
+    # ---------------------------------------------------------- observables
+
+    def _walk_run(self, want_mine: bool) -> int:
+        arena = bc.to_numpy(self.p.arena)
+        cur = int(np.asarray(self.p.head)[1])
+        score = 0
+        while cur > 0 and (int(arena["producer"][cur]) == 1) == want_mine:
+            cur = int(arena["parent"][cur])
+            score += 1
+        return score
+
+    def get_advance(self) -> int:
+        """Own blocks in a row from the head (:111-119)."""
+        return self._walk_run(True)
+
+    def get_lag(self) -> int:
+        """Others' blocks in a row from the head (:121-129)."""
+        return self._walk_run(False)
+
+    def get_secret_advance(self) -> int:
+        """Private-chain height advance over the public head (:103-108)."""
+        p = self.p
+        pb = int(np.asarray(p.private_blk)[1])
+        priv = 0 if pb < 0 else int(np.asarray(p.arena.height)[pb])
+        oth = int(np.asarray(p.arena.height)[
+            int(np.asarray(p.others_head)[1])])
+        return max(0, priv - oth)
+
+    def get_reward(self, last_blocks_count=None) -> float:
+        head = int(np.asarray(self.p.head)[1])
+        until = 0
+        if last_blocks_count is not None:
+            until = int(np.asarray(self.p.arena.height)[head]) - \
+                last_blocks_count
+        return rewards_by_miner(self.p, head,
+                                until_height=until).get(1, 0.0)
+
+    def get_reward_ratio(self) -> float:
+        head = int(np.asarray(self.p.head)[1])
+        r = rewards_by_miner(self.p, head)
+        tot = sum(r.values())
+        return r.get(1, 0.0) / tot if tot > 0 else 0.0
+
+    def i_am_ahead(self) -> bool:
+        head = int(np.asarray(self.p.head)[1])
+        return int(np.asarray(self.p.arena.producer)[head]) == 1
+
+    def count_my_blocks(self) -> int:
+        arena = bc.to_numpy(self.p.arena)
+        cur = int(np.asarray(self.p.head)[1])
+        count = 0
+        while cur > 0:
+            count += int(arena["producer"][cur]) == 1
+            cur = int(arena["parent"][cur])
+        return count
+
+    def get_time_in_seconds(self) -> int:
+        """ETHPowWithAgent.getTimeInSeconds (:225-227)."""
+        return int(np.asarray(self.net.time)) * self.proto.tick_ms // 1000
 
 
 def uncle_rate(pstate, head: int, until_height: int = 0) -> float:
