@@ -143,7 +143,10 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
             f"the node axis across devices")
     return NetState(
         time=jnp.asarray(0, jnp.int32),
-        seed=jnp.asarray(seed, jnp.int32),
+        # + 0 forces a fresh buffer: protocols keep their own copy of the
+        # seed in pstate, and under donation the same buffer must not
+        # appear twice in an executable's arguments.
+        seed=jnp.asarray(seed, jnp.int32) + 0,
         nodes=nodes,
         box_data=jnp.zeros((f * h * n * c,), jnp.int32),
         box_src=jnp.zeros((h * n * c,), jnp.int32),

@@ -28,6 +28,7 @@ from ..core import latency as latency_mod
 from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset
+from ..ops.flat import gather2d, set2d
 
 U32 = jnp.uint32
 
@@ -49,7 +50,7 @@ class OptimisticP2PSignature:
 
     def __init__(self, node_count=100, threshold=99, connection_count=20,
                  pairing_time=1, node_builder_name=None,
-                 network_latency_name=None, max_degree=None, inbox_cap=128,
+                 network_latency_name=None, max_degree=None, inbox_cap=192,
                  drain_rate=4, fanout_pacing_ms=1, horizon=512):
         if node_count > 4096:
             raise ValueError("OptimisticP2PSignature keeps an [N, N] "
@@ -72,10 +73,20 @@ class OptimisticP2PSignature:
         # unbounded same-ms bursts; its per-ms bucket is a linked list).
         self.fanout_pacing_ms = fanout_pacing_ms
         self.w = bitset.n_words(node_count)
-        self.cfg = EngineConfig(n=node_count, horizon=horizon,
-                                inbox_cap=inbox_cap, payload_words=1,
-                                out_deg=self.max_degree * drain_rate,
-                                bcast_slots=1)
+        # Discard latencies that would outrun the arrival ring (the
+        # reference's msgDiscardTime mechanism, core Network.java:36-40):
+        # with city+Pareto jitter physics a ~1e-4 tail exceeds 500 ms, and
+        # the flood's redundancy makes those copies irrelevant.  The margin
+        # keeps pacing delays (<= max_degree * pacing) clamp-free.  Only
+        # applied when the ring is big enough that the discard threshold
+        # clears every realistic latency; with a small horizon discarding
+        # would silently kill most traffic, so fall back to edge-clamping.
+        discard = horizon - 2 - self.max_degree * fanout_pacing_ms
+        cfg_kw = {"msg_discard_time": discard} if discard >= 500 else {}
+        self.cfg = EngineConfig(
+            n=node_count, horizon=horizon, inbox_cap=inbox_cap,
+            payload_words=1, out_deg=self.max_degree * drain_rate,
+            bcast_slots=1, **cfg_kw)
 
     def init(self, seed):
         n, w = self.node_count, self.w
@@ -90,8 +101,10 @@ class OptimisticP2PSignature:
         net = init_net(self.cfg, nodes, seed)
         return net, OptSigState(
             seed=seed, peers=peers, degree=degree,
-            received=own, pending=own,
-            pending_src=jnp.broadcast_to(ids[:, None], (n, n)),
+            # Distinct buffers: under donation the same buffer must not
+            # appear twice in an executable's arguments.
+            received=own, pending=bitset.one_bit(ids, w),
+            pending_src=jnp.broadcast_to(ids[:, None], (n, n)) + 0,
             done=jnp.zeros((n,), bool))
 
     def step(self, p: OptSigState, nodes, inbox, t, key):
@@ -99,21 +112,32 @@ class OptimisticP2PSignature:
         ids = jnp.arange(n, dtype=jnp.int32)
         S = inbox.src.shape[1]
 
-        received, pending, pending_src = (p.received, p.pending,
-                                          p.pending_src)
-        for s in range(S):
-            ok = inbox.valid[:, s] & ~p.done & ~nodes.down
-            sig = jnp.clip(inbox.data[:, s, 0], 0, n - 1)
-            src = jnp.clip(inbox.src[:, s], 0, n - 1)
-            bit = bitset.one_bit(sig, w)
-            new = ok & ~bitset.intersects(received, bit)
-            received = jnp.where(new[:, None], received | bit, received)
-            pending = jnp.where(new[:, None], pending | bit, pending)
-            flat = ids * n + sig
-            pending_src = pending_src.reshape(-1).at[
-                jnp.where(new, flat, n * n)].set(src, mode="drop",
-                                                 unique_indices=True
-                                                 ).reshape(n, n)
+        # Receive, vectorized across ALL inbox slots at once (an unrolled
+        # per-slot loop compiles S copies of an [N, N] scatter — minutes of
+        # XLA time at S=128).  First-arrival rule (onSig :113-135): mask
+        # same-ms duplicate slots with an [S, S] lower-triangular equality
+        # sweep, making (node, sig) indices UNIQUE — the only scatter form
+        # the TPU backend lowers without serialization (ops/flat.py).
+        ok = inbox.valid & (~p.done & ~nodes.down)[:, None]    # [N, S]
+        sig = jnp.clip(inbox.data[:, :, 0], 0, n - 1)          # [N, S]
+        src = jnp.clip(inbox.src, 0, n - 1)
+        earlier = jnp.tril(jnp.ones((S, S), bool), k=-1)       # [s, s'<s]
+        dup = jnp.any((sig[:, :, None] == sig[:, None, :]) &
+                      ok[:, None, :] & earlier[None], axis=2)  # [N, S]
+        word = gather2d(p.received, ids[:, None], sig // 32)
+        had = ((word >> (sig % 32).astype(U32)) & U32(1)) != 0
+        new = ok & ~dup & ~had                                 # [N, S]
+
+        # Word updates without scatter: [N, S, W] one-hot OR-reduce.
+        bmask = jnp.where(new, U32(1) << (sig % 32).astype(U32), U32(0))
+        words = jnp.where(
+            (sig // 32)[:, :, None] ==
+            jnp.arange(w, dtype=jnp.int32)[None, None, :],
+            bmask[:, :, None], U32(0))                         # [N, S, W]
+        new_words = jax.lax.reduce(words, U32(0), jax.lax.bitwise_or, (1,))
+        received = p.received | new_words
+        pending = p.pending | new_words
+        pending_src = set2d(p.pending_src, ids[:, None], sig, src, ok=new)
 
         # done at threshold: stop accepting new sigs, doneAt = t +
         # 2*pairing (:128-131).  Already-queued forwards keep draining —

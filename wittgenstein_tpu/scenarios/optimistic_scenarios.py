@@ -1,0 +1,60 @@
+"""OptimisticP2PSignature sweeps — OptimisticP2PSignatureScenarios.java
+parity: BasicStats (doneAt / msgReceived min/avg/max, :13-41) over a
+doubling node-count ladder (logErrors, :59-88), default parameters
+nodes*0.99 threshold / 3 pairing / 4 connections / CITIES builder /
+city-jitter latency (:89-101).
+
+Run `python -m wittgenstein_tpu.scenarios.optimistic_scenarios [out_dir]`
+for a smoke sweep.
+"""
+
+from __future__ import annotations
+
+from ..core import builders
+from ..core.harness import run_multiple_times
+from ..models.optimistic import OptimisticP2PSignature, cont_if_optimistic
+from ..tools.csvf import CSVFormatter
+from ..utils import stats as stats_mod
+
+
+def default_params(nodes, **overrides):
+    """defaultParams (:89-101)."""
+    params = dict(node_count=nodes, threshold=int(nodes * 0.99),
+                  pairing_time=3, connection_count=4,
+                  node_builder_name=builders.registry_name(
+                      "cities", True, 0.0),
+                  network_latency_name="NetworkLatencyByCityWJitter")
+    params.update(overrides)
+    return params
+
+
+def basic_stats(proto, seeds, max_time=60_000, chunk=500):
+    res = run_multiple_times(
+        proto, run_count=seeds, max_time=max_time, chunk=chunk,
+        cont_if=cont_if_optimistic,
+        stats_getters=(stats_mod.simple_stats("doneAt", "done_at"),
+                       stats_mod.simple_stats("msgReceived",
+                                              "msg_received")))
+    d, m = res.stats["doneAt"], res.stats["msgReceived"]
+    return {"done_min": d["min"], "done_avg": d["avg"], "done_max": d["max"],
+            "msg_min": m["min"], "msg_avg": m["avg"], "msg_max": m["max"]}
+
+
+def node_scaling(counts=(128, 256, 512, 1024), seeds=2, out_dir="."):
+    """Behavior when the number of nodes increases (logErrors, :59-88)."""
+    csv = CSVFormatter(["nodes", "done_avg", "done_max", "msg_avg"])
+    for n in counts:
+        proto = OptimisticP2PSignature(**default_params(n))
+        r = basic_stats(proto, seeds)
+        csv.add(nodes=n, done_avg=round(r["done_avg"], 1),
+                done_max=round(r["done_max"], 1),
+                msg_avg=round(r["msg_avg"], 1))
+        print(f"{n} nodes: {r}")
+    csv.save(f"{out_dir}/optimistic_scaling.csv")
+    return csv
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    node_scaling(counts=(128, 256), seeds=2, out_dir=out)

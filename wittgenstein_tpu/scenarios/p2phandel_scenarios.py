@@ -1,0 +1,95 @@
+"""P2PHandel experiment sweeps — P2PHandelScenarios.java parity.
+
+The reference collects BasicStats (doneAt min/avg/max, msgReceived
+min/avg/max, bytesReceived avg, P2PHandelScenarios.java:18-80) per sweep
+point via RunMultipleTimes; here every point is ONE vmapped batch of seeds
+(core/harness.run_multiple_times).  Sweeps mirror sigsPerStrategy /
+byNodeCount (:82-180): send-strategy comparison and node-count scaling.
+
+Run `python -m wittgenstein_tpu.scenarios.p2phandel_scenarios [out_dir]`
+for a smoke sweep.
+"""
+
+from __future__ import annotations
+
+from ..core import builders
+from ..core.harness import run_multiple_times
+from ..models.p2phandel import (CMP_ALL, CMP_DIFF, DIF, ALL, P2PHandel,
+                                cont_if_p2phandel)
+from ..tools.csvf import CSVFormatter
+from ..utils import stats as stats_mod
+
+STRATEGY_NAMES = {ALL: "all", DIF: "dif", CMP_ALL: "cmp_all",
+                  CMP_DIFF: "cmp_diff"}
+
+
+def default_params(signers=100, relays=20, dead_ratio=0.0, **overrides):
+    """Default P2PHandel configuration (P2PHandelParameters defaults,
+    P2PHandel.java:37-112); threshold = 99% of signers."""
+    params = dict(signing_node_count=signers, relaying_node_count=relays,
+                  threshold=int(signers * 0.99), connection_count=40,
+                  pairing_time=100, sigs_send_period=1000,
+                  node_builder_name=builders.registry_name(
+                      "cities", True, 0.0),
+                  network_latency_name="NetworkLatencyByCityWJitter")
+    params.update(overrides)
+    return params
+
+
+def basic_stats(proto, seeds, max_time=60_000, chunk=500):
+    """BasicStats for one sweep point (P2PHandelScenarios.java:18-80):
+    doneAt/msgReceived min/avg/max over live nodes + bytesReceived avg."""
+    res = run_multiple_times(
+        proto, run_count=seeds, max_time=max_time, chunk=chunk,
+        cont_if=cont_if_p2phandel,
+        stats_getters=(stats_mod.simple_stats("doneAt", "done_at"),
+                       stats_mod.simple_stats("msgReceived", "msg_received"),
+                       stats_mod.simple_stats("bytesReceived",
+                                              "bytes_received")))
+    d, m, b = (res.stats["doneAt"], res.stats["msgReceived"],
+               res.stats["bytesReceived"])
+    return {"done_min": d["min"], "done_avg": d["avg"], "done_max": d["max"],
+            "msg_min": m["min"], "msg_avg": m["avg"], "msg_max": m["max"],
+            "bytes_avg": b["avg"]}
+
+
+def strategy_sweep(signers=64, relays=8, seeds=2, out_dir=".",
+                   strategies=(ALL, DIF, CMP_ALL, CMP_DIFF)):
+    """Compare the send strategies {all, dif, cmp_all, cmp_diff}
+    (P2PHandel.java:25-34, sweep analog of sigsPerStrategy).  Each strategy
+    is a distinct compiled program (~3 min apiece on CPU); pass a subset
+    for smoke runs."""
+    csv = CSVFormatter(["strategy", "done_avg", "msg_avg", "bytes_avg"])
+    for strat in strategies:
+        proto = P2PHandel(**default_params(signers, relays,
+                                           send_sigs_strategy=strat))
+        r = basic_stats(proto, seeds)
+        csv.add(strategy=STRATEGY_NAMES[strat],
+                done_avg=round(r["done_avg"], 1),
+                msg_avg=round(r["msg_avg"], 1),
+                bytes_avg=round(r["bytes_avg"], 1))
+        print(f"strategy={STRATEGY_NAMES[strat]}: {r}")
+    csv.save(f"{out_dir}/p2phandel_strategies.csv")
+    return csv
+
+
+def node_scaling(counts=(64, 128, 256), relay_ratio=0.2, seeds=2,
+                 out_dir="."):
+    """Node-count scaling (byNodeCount analog)."""
+    csv = CSVFormatter(["signers", "done_avg", "done_max", "msg_avg"])
+    for n in counts:
+        proto = P2PHandel(**default_params(n, max(1, int(n * relay_ratio))))
+        r = basic_stats(proto, seeds)
+        csv.add(signers=n, done_avg=round(r["done_avg"], 1),
+                done_max=round(r["done_max"], 1),
+                msg_avg=round(r["msg_avg"], 1))
+        print(f"signers={n}: {r}")
+    csv.save(f"{out_dir}/p2phandel_scaling.csv")
+    return csv
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    strategy_sweep(out_dir=out, strategies=(ALL, DIF))
+    node_scaling(counts=(64, 128), out_dir=out)

@@ -1,0 +1,56 @@
+"""Kademlia XOR-distance helpers (core/utils/Kademlia.java:5-29).
+
+The reference keeps a scalar byte-array distance function (the bit length
+of the XOR of two node ids) plus the k-bucket / node-lookup design notes
+from the Kademlia paper; no shipped protocol uses it.  Here the distance is
+vectorized: node ids are `[..., B]` uint8 arrays (e.g. the SHA-256 node
+hashes of `NodeBuilder`), and `distance` maps over arbitrary leading axes —
+one call scores a node against its whole routing table, the idiomatic shape
+for a future discv4/discv5-style protocol model.
+
+K-bucket semantics for such a model (see the paper + devp2p discv4 notes
+mirrored at Kademlia.java:31-73): bucket i holds peers at distance
+(2^i, 2^(i+1)]; on any message the sender moves to the bucket tail, with a
+ping-the-oldest eviction rule when full; lookups are alpha-parallel
+FIND_NODE recursions over the closest known nodes.  Ethereum discv4 uses
+k=16 with 256 buckets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U8 = jnp.uint8
+
+
+def distance(a, b):
+    """Bit-length of XOR distance between byte ids (Kademlia.java:8-29).
+
+    a, b: broadcast-compatible uint8 arrays [..., B] -> int32 [...]:
+    0 for equal ids, else (number of significant bits of a XOR b counted
+    from the most significant byte).  Matches the reference loop: a full
+    byte prefix match drops 8 per byte, the first differing byte drops its
+    leading zeros, later bytes don't matter."""
+    a = jnp.asarray(a, U8)
+    b = jnp.asarray(b, U8)
+    x = (a ^ b).astype(jnp.int32)                       # [..., B]
+    nbytes = x.shape[-1]
+    nz = x != 0
+    # Index of the first nonzero byte (B if none).
+    first = jnp.where(jnp.any(nz, axis=-1),
+                      jnp.argmax(nz, axis=-1), nbytes)
+    byte = jnp.take_along_axis(
+        x, jnp.minimum(first, nbytes - 1)[..., None], axis=-1)[..., 0]
+    # Bit length of that byte (byte is in [0, 255]).
+    blen = jnp.where(byte > 0, 32 - jax.lax.clz(byte), 0)
+    return jnp.where(first >= nbytes, 0,
+                     (nbytes - 1 - first) * 8 + blen)
+
+
+def bucket_index(a, b, n_buckets: int = 256):
+    """k-bucket index for peer b as seen by a: distance-1 clamped to the
+    table size (bucket i spans distances (2^i, 2^(i+1)], discv4 uses 256
+    buckets of k=16)."""
+    d = distance(a, b)
+    return jnp.clip(d - 1, 0, n_buckets - 1)
