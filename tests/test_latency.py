@@ -111,3 +111,19 @@ def test_estimate_latency_roundtrip():
         jnp.arange(1000, dtype=jnp.int32) % 100)
     med = float(np.median(np.asarray(direct)))
     assert 0.3 * med <= float(tab[50]) <= 3 * med
+
+
+def test_estimate_p2p_latency():
+    """estimateP2PLatency parity (NetworkLatency.java:446-460): sampling
+    restricted to direct peers yields a valid monotone quantile table."""
+    from wittgenstein_tpu.core import p2p
+    from wittgenstein_tpu.core.latency import estimate_p2p_latency
+    nodes = builders.NodeBuilder().build(7, 128)
+    peers, degree, overflow = p2p.build_peer_graph(7, 128, 8, minimum=True)
+    assert int(overflow) == 0
+    m = NetworkLatencyByDistanceWJitter()
+    est = estimate_p2p_latency(m, nodes, peers, degree, rounds=20)
+    assert isinstance(est, MeasuredNetworkLatency)
+    tab = np.asarray(est.table)
+    assert tab.shape == (100,)
+    assert np.all(np.diff(tab) >= 0) and tab[0] >= 1

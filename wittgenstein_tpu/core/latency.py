@@ -323,6 +323,21 @@ class MathisNetworkThroughput:
         return self.name
 
 
+def _quantile_table(lat, name):
+    """Bucket observed latencies into the 100-quantile
+    MeasuredNetworkLatency form shared by both estimators (the reference's
+    histogram build, NetworkLatency.java:468-508)."""
+    import numpy as np_
+    lat = np_.sort(lat)
+    qs = np_.quantile(lat, (np_.arange(100) + 1) / 100.0,
+                      method="lower").astype(np_.int32)
+    qs = np_.maximum.accumulate(np_.maximum(qs, 1))
+    table = MeasuredNetworkLatency.__new__(MeasuredNetworkLatency)
+    table.table = jnp.asarray(qs)
+    table.name = name
+    return table
+
+
 def estimate_latency(model, nodes, rounds=100, seed=0):
     """Monte-Carlo sample a latency model into a MeasuredNetworkLatency
     (NetworkLatency.estimateLatency, NetworkLatency.java:432-474): draw
@@ -339,11 +354,28 @@ def estimate_latency(model, nodes, rounds=100, seed=0):
     keep = src != dst
     lat = np_.asarray(full_latency(model, nodes, src, dst, delta))[
         np_.asarray(keep)]
-    lat = np_.sort(lat)
-    qs = np_.quantile(lat, (np_.arange(100) + 1) / 100.0,
-                      method="lower").astype(np_.int32)
-    qs = np_.maximum.accumulate(np_.maximum(qs, 1))
-    table = MeasuredNetworkLatency.__new__(MeasuredNetworkLatency)
-    table.table = jnp.asarray(qs)
-    table.name = f"MeasuredNetworkLatency(estimate of {model!r})"
-    return table
+    return _quantile_table(
+        lat, f"MeasuredNetworkLatency(estimate of {model!r})")
+
+
+def estimate_p2p_latency(model, nodes, peers, degree, rounds=100, seed=0):
+    """estimate_latency restricted to DIRECT peers of each sampled source
+    (NetworkLatency.estimateP2PLatency, NetworkLatency.java:446-460):
+    `peers` is the [N, D] peer-id matrix and `degree` the per-node valid
+    peer count from core/p2p.build_peer_graph."""
+    import numpy as np_
+    from ..ops import prng
+    n = int(nodes.x.shape[0])
+    ids = jnp.arange(rounds * n, dtype=jnp.int32)
+    s = prng.hash2(jnp.asarray(seed, jnp.int32), jnp.int32(0x50325045))
+    src = prng.uniform_int(prng.hash2(s, 1), ids, n)
+    deg = jnp.maximum(degree[src], 1)
+    col = prng.uniform_int(prng.hash2(s, 2), ids, deg)
+    dst = peers[src, col]
+    delta = prng.uniform_delta(prng.hash2(s, 3), ids)
+    keep = (dst >= 0) & (dst != src)
+    lat = np_.asarray(full_latency(model, nodes, src,
+                                   jnp.maximum(dst, 0), delta))[
+        np_.asarray(keep)]
+    return _quantile_table(
+        lat, f"MeasuredNetworkLatency(p2p estimate of {model!r})")

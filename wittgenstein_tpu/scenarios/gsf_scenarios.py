@@ -1,0 +1,77 @@
+"""GSFSignature demo drivers — parity with the reference's in-protocol
+demos: `sigsPerTime` (GSFSignature.java:722-763 — min/avg/max verified-set
+cardinality sampled over time, printed and plotted) and `drawImgs`
+(:699-720 — world-map animation colored by signature count).
+
+Run `python -m wittgenstein_tpu.scenarios.gsf_scenarios [out_dir]` for a
+smoke pass of both.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.network import Runner
+from ..models.gsf import GSFSignature
+from ..ops import bitset
+from ..tools.csvf import CSVFormatter
+from ..tools.graph import Graph, Series
+
+
+def sigs_per_time(nodes=128, nodes_down=0, max_time=3000, stat_each_ms=10,
+                  seed=0, out_dir="."):
+    """Time series of verified-signature counts (sigsPerTime, :722-763):
+    sample min/avg/max of |V| over live nodes every `stat_each_ms`, write
+    CSV + PNG, stop when all live nodes hold a full set."""
+    proto = GSFSignature(node_count=nodes, nodes_down=nodes_down)
+    runner = Runner(proto, donate=False)
+    net, ps = proto.init(seed)
+    down = np.asarray(net.nodes.down)
+    csv = CSVFormatter(["time_ms", "min", "avg", "max"])
+    g = Graph(f"GSFSignature sigs over time, n={nodes}", "time (ms)",
+              "verified sigs")
+    s_min, s_avg, s_max = (Series("min"), Series("avg"), Series("max"))
+    t = 0
+    while t < max_time:
+        net, ps = runner.run_ms(net, ps, stat_each_ms)
+        t += stat_each_ms
+        card = np.asarray(bitset.popcount(ps.verified))[~down]
+        csv.add(time_ms=t, min=int(card.min()), avg=round(float(card.mean()), 1),
+                max=int(card.max()))
+        s_min.add(t, int(card.min()))
+        s_avg.add(t, float(card.mean()))
+        s_max.add(t, int(card.max()))
+        if card.min() >= nodes - int(down.sum()):
+            break
+    for s in (s_min, s_avg, s_max):
+        g.add_series(s)
+    csv.save(f"{out_dir}/gsf_sigs_per_time.csv")
+    g.save(f"{out_dir}/gsf_sigs_per_time.png")
+    return csv
+
+
+def draw_imgs(nodes=128, out_path="gsf.gif", frames=30, frame_ms=25,
+              seed=0):
+    """Animated world-map GIF colored by verified-set size (drawImgs,
+    :699-720)."""
+    from ..tools.node_drawer import NodeDrawer
+    proto = GSFSignature(node_count=nodes)
+    runner = Runner(proto, donate=False)
+    net, ps = proto.init(seed)
+    drawer = NodeDrawer(vmin=1, vmax=nodes)
+    for _ in range(frames):
+        net, ps = runner.run_ms(net, ps, frame_ms)
+        vals = np.asarray(bitset.popcount(ps.verified))
+        drawer.draw(net.nodes, vals)
+        down = np.asarray(net.nodes.down)
+        if int(vals[~down].min()) >= nodes - int(down.sum()):
+            break
+    drawer.save_gif(out_path, ms_per_frame=100)
+    return out_path
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    sigs_per_time(nodes=64, out_dir=out)
+    draw_imgs(nodes=64, out_path=f"{out}/gsf.gif")
