@@ -95,6 +95,46 @@ def test_desynchronized_start():
     assert (np.asarray(net.nodes.done_at) > 0).all()
 
 
+def test_scale_mode_hashed_emission_poolfree():
+    """The large-N configuration (hashed emission order, no snapshot pool,
+    prefix-sum level popcounts) must still aggregate and stay
+    deterministic — it is the path the >16k-node benchmarks use."""
+    n, down = 128, 12
+    proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
+                   nodes_down=down, pairing_time=4, level_wait_time=50,
+                   dissemination_period_ms=20, fast_path=10,
+                   emission_mode="hashed", snapshot_pool=False)
+    proto.prefix_pc = True          # force the large-N popcount path too
+    outs = []
+    for seed in (0, 0, 1):
+        net, p = proto.init(seed)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 1500)
+        outs.append(np.asarray(net.nodes.done_at))
+        live = ~np.asarray(net.nodes.down)
+        assert (outs[-1][live] > 0).all()
+        assert int(net.dropped) == 0 and int(net.clamped) == 0
+    assert np.array_equal(outs[0], outs[1])
+    assert not np.array_equal(outs[0], outs[2])
+    # No O(N^2) state in this mode.
+    assert p.emission.shape == (1, 1) and p.pool.shape == (1, 1, 1)
+
+
+def test_level_pc_prefix_matches_einsum():
+    """The prefix-sum per-level popcount must agree with the MXU one-hot
+    contraction on random bitsets."""
+    proto = Handel(node_count=256, threshold=250)
+    ids = jnp.arange(256, dtype=jnp.int32)
+    rng = np.random.default_rng(5)
+    rows = jnp.asarray(rng.integers(0, 1 << 32, (256, proto.w),
+                                    dtype=np.uint32))
+    onehot = proto._word_onehot(ids)
+    subm = proto._subword_masks(ids)
+    hi = ids >> 5
+    a = np.asarray(proto._level_pc(rows, onehot, subm, hi))
+    b = np.asarray(proto._level_pc(rows, None, subm, hi))
+    assert np.array_equal(a, b)
+
+
 def test_byzantine_suicide():
     """byzantineSuicide (Handel.java:538-559): byzantine nodes plant invalid
     sigs that honest nodes burn pairing slots on, then blacklist.  The run

@@ -16,10 +16,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..ops import bitset
+from ..ops import bitset, prng
 from ..ops.flat import gather2d
 
 U32 = jnp.uint32
+
+
+def keyed_level_peer(seed, tag, ids, level, pos):
+    """The `pos`-th peer of `ids` at `level` under a keyed bijective
+    permutation of the level's sibling range — the shared hashed
+    emission-order primitive used instead of stored per-(node, level) peer
+    lists (SURVEY.md §7.4.6).  Out-of-range `pos` folds to 0; level 0
+    (no peers) yields garbage the callers gate out."""
+    half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 1)
+    base = sibling_base(ids, jnp.maximum(half, 1))
+    key = prng.hash3(prng.hash2(seed, tag), ids, level)
+    return base + prng.bij_perm_dyn(key, jnp.where(pos < half, pos, 0),
+                                    jnp.maximum(level - 1, 0))
 
 
 def get_bit_rows(bits, idx):
@@ -70,7 +83,15 @@ class LevelMixin:
         return masks
 
     def _level_pc(self, rows, onehot, sub_masks, hi):
-        """Per-level popcounts.  rows [N, ..., W] -> [N, ..., L] int32."""
+        """Per-level popcounts.  rows [N, ..., W] -> [N, ..., L] int32.
+
+        onehot=None selects the prefix-sum path (`_level_pc_prefix`): the
+        [N, W, L] one-hot is O(N * W * L) memory — gigabytes past ~16k
+        nodes — while every level's word range is contiguous and
+        word-aligned for levels >= 6, so a popcount cumsum + 2 gathers per
+        level does the same contraction in O(N * W)."""
+        if onehot is None:
+            return self._level_pc_prefix(rows, sub_masks, hi)
         pc = jax.lax.population_count(rows).astype(jnp.float32)
         extra = pc.ndim - 2
         lhs = "n" + "abc"[:extra] + "w"
@@ -82,6 +103,35 @@ class LevelMixin:
         small = jax.lax.population_count(
             own_word[..., None] & sm).astype(jnp.float32)
         return (big + small).astype(jnp.int32)
+
+    def _level_pc_prefix(self, rows, sub_masks, hi):
+        """Prefix-sum `_level_pc`: levels >= 6 cover the word-aligned
+        contiguous range [sibling_base/32, +half/32); their popcount is a
+        difference of two cumsum gathers.  Sub-word levels (1..5) use the
+        in-register masks exactly like the einsum path."""
+        n, L = rows.shape[0], self.levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        extra = rows.ndim - 2
+        pc = jax.lax.population_count(rows).astype(jnp.int32)
+        pref = jnp.cumsum(pc, axis=-1)                       # inclusive
+        own_word = jnp.take_along_axis(
+            rows, hi.reshape((-1,) + (1,) * (rows.ndim - 1)), axis=-1)[..., 0]
+        sm = sub_masks.reshape((sub_masks.shape[0],) + (1,) * extra +
+                               (sub_masks.shape[1],))
+        out = jax.lax.population_count(
+            own_word[..., None] & sm).astype(jnp.int32)      # [.., L]
+        for l in range(6, L):
+            half_words = 1 << (l - 6)                        # half / 32
+            start = (sibling_base(ids, 1 << (l - 1)) >> 5)   # [N]
+            start = start.reshape((n,) + (1,) * extra)
+            end_i = start + half_words - 1                   # inclusive
+            hi_s = jnp.take_along_axis(pref, end_i[..., None], axis=-1)[..., 0]
+            lo_s = jnp.where(
+                start > 0,
+                jnp.take_along_axis(pref, jnp.maximum(start - 1, 0)[..., None],
+                                    axis=-1)[..., 0], 0)
+            out = out.at[..., l].set(hi_s - lo_s)
+        return out
 
     def _range_mask_dyn(self, ids, level):
         """[., W] level range mask where `level` is a traced array

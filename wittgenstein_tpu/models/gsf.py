@@ -52,7 +52,8 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d, gather_rows, set2d, set_rows
-from ._levels import LevelMixin, get_bit_rows as _get_bit_rows, sibling_base
+from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
+                      keyed_level_peer, sibling_base)
 
 TAG_BAD = 0x47424144      # bad-node choice
 TAG_PERM = 0x47504552     # per-(node, level) peer-order permutation
@@ -132,12 +133,7 @@ class GSFSignature(LevelMixin):
         """The `pos`-th peer of `ids` at `level` in its shuffled peer order
         (randomSubset + Collections.shuffle, GSFSignature.java:462-476, as a
         keyed permutation of the level range — no stored [N, N] lists)."""
-        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 1)
-        base = sibling_base(ids, jnp.maximum(half, 1))
-        off = jnp.where(pos < half, pos, 0)
-        key = prng.hash3(prng.hash2(seed, TAG_PERM), ids, level)
-        perm = prng.bij_perm_dyn(key, off, jnp.maximum(level - 1, 0))
-        return base + perm
+        return keyed_level_peer(seed, TAG_PERM, ids, level, pos)
 
     def _fin_level(self, pc):
         """Last finished level f: levels 1..f all complete (getLastFinished

@@ -63,12 +63,14 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
-from ._levels import LevelMixin, get_bit_rows as _get_bit_rows, sibling_base
+from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
+                      keyed_level_peer, sibling_base)
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
 TAG_BAD = 0x48424144      # bad-node choice
 TAG_START = 0x48535452    # desynchronized start draw
 TAG_LEVEL = 0x484C564C    # random level pick in checkSigs
+TAG_EMIT = 0x48454D49     # hashed emission-order permutation keys
 
 U32 = jnp.uint32
 BIG = jnp.int32(1 << 30)
@@ -121,16 +123,41 @@ class Handel(LevelMixin):
                  desynchronized_start=0, window_initial=16, window_min=1,
                  window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
                  emission_lookahead=8, byzantine_suicide=False,
-                 hidden_byzantine=False):
+                 hidden_byzantine=False, emission_mode=None,
+                 snapshot_pool=None):
         if node_count & (node_count - 1):
             raise ValueError("we support only power-of-two node counts "
                              "(Handel.java:119-121)")
-        if node_count > 32768:
-            # The stored [N, N] emission matrix (and its int32 sort key)
-            # caps the single-chip exact implementation; larger N needs the
-            # in-kernel emission permutation + sharded node axis.
-            raise ValueError("node_count > 32768 requires the sharded "
-                             "engine (emission matrix is O(N^2))")
+        # Scale switches (SURVEY.md §7.4.6: stored [N, N] matrices cannot
+        # exist at large N — recompute from hashes instead):
+        # * emission_mode "stored" keeps the reference-exact emission lists
+        #   (receivers sorted by the rank they assign to the sender,
+        #   Handel.java:991-1013) as an [N, N] matrix; "hashed" derives the
+        #   emission order from a keyed bijective permutation of the level
+        #   range — O(1) state, but plain randomized round-robin: the
+        #   rank-prioritized ordering (a convergence optimization) is lost.
+        # * snapshot_pool False drops the [N, R, W] send-time snapshot pool;
+        #   deliveries then reconstruct the aggregate from the sender's
+        #   CURRENT state (marginally fresher than sent — the same
+        #   direction of drift the pool's fast-path refresh already has).
+        # Defaults cut over past 32768 nodes — exactly where the stored
+        # matrix was previously a hard error, so configurations that ran
+        # before keep their reference-exact semantics unchanged.
+        if emission_mode is None:
+            emission_mode = "stored" if node_count <= 32768 else "hashed"
+        if emission_mode not in ("stored", "hashed"):
+            raise ValueError(f"unknown emission_mode {emission_mode!r}")
+        if snapshot_pool is None:
+            snapshot_pool = node_count <= 32768
+        if emission_mode == "stored" and node_count > 32768:
+            raise ValueError("stored emission lists are O(N^2); use "
+                             "emission_mode='hashed' past 32768 nodes")
+        self.emission_mode = emission_mode
+        self.snapshot_pool = snapshot_pool
+        # Past ~16k nodes the [N, W, L] word->level one-hot for the MXU
+        # popcount contraction is gigabytes; the prefix-sum path computes
+        # the SAME values (tested bit-equal) in O(N * W).
+        self.prefix_pc = node_count > 16384
         threshold = (int(node_count * 0.99) if threshold is None
                      else threshold)
         if not (0 <= nodes_down < node_count and
@@ -187,6 +214,17 @@ class Handel(LevelMixin):
         key = prng.hash3(seed, TAG_RANK, i_ids)
         return prng.bij_perm(key, s_ids, self.bits)
 
+    def _emission_peer(self, seed, i_ids, level, pos):
+        """Hashed emission order: the `pos`-th receiver of node i at
+        `level` (replaces the stored per-(node, level) emission list,
+        Handel.java:991-1013, for large N).  NOTE: the stored list is
+        sorted by the rank receivers assign to the sender — a convergence
+        optimization the keyed permutation does NOT reproduce; hashed mode
+        is plain randomized round-robin (the GSF emission model)."""
+        return jnp.minimum(
+            keyed_level_peer(seed, TAG_EMIT, i_ids, level, pos),
+            self.node_count - 1)
+
     def _byz_candidates(self, p, nodes, excl_bits):
         """Per (node, level) lowest-reception-rank byzantine (down) peer,
         excluding senders whose bit is set in `excl_bits` [N, W].  The
@@ -239,17 +277,22 @@ class Handel(LevelMixin):
         # Emission lists: for each (node, level), receivers of the level
         # sorted by the rank THEY assign to us (Handel.java:991-1013), laid
         # out per node as concatenated levels (level l at columns
-        # [2^(l-1), 2^l)); column 0 unused (level 0 has no peers).
-        emission = jnp.zeros((n, n), jnp.int32)
-        for l in range(1, L):
-            half = 1 << (l - 1)
-            base = _sibling_base(ids, half)                   # [N]
-            recv = base[:, None] + jnp.arange(half)[None, :]  # [N, half]
-            key = self._rank(seed, recv, jnp.broadcast_to(ids[:, None],
-                                                          recv.shape))
-            order = jnp.argsort(key * n + (recv - base[:, None]), axis=1)
-            emission = emission.at[:, half:2 * half].set(
-                jnp.take_along_axis(recv, order, axis=1))
+        # [2^(l-1), 2^l)); column 0 unused (level 0 has no peers).  In
+        # hashed mode the order is a keyed permutation recomputed in-kernel
+        # (see __init__) and no matrix exists.
+        if self.emission_mode == "stored":
+            emission = jnp.zeros((n, n), jnp.int32)
+            for l in range(1, L):
+                half = 1 << (l - 1)
+                base = _sibling_base(ids, half)                   # [N]
+                recv = base[:, None] + jnp.arange(half)[None, :]  # [N, half]
+                key = self._rank(seed, recv,
+                                 jnp.broadcast_to(ids[:, None], recv.shape))
+                order = jnp.argsort(key * n + (recv - base[:, None]), axis=1)
+                emission = emission.at[:, half:2 * half].set(
+                    jnp.take_along_axis(recv, order, axis=1))
+        else:
+            emission = jnp.zeros((1, 1), jnp.int32)
 
         def zero_bits():
             # Fresh buffer per field: under donation the same buffer must
@@ -267,7 +310,8 @@ class Handel(LevelMixin):
             q_rank=jnp.zeros((n, Q), jnp.int32),
             q_bad=jnp.zeros((n, Q), bool),
             q_sig=jnp.zeros((n, Q, w), U32),
-            pool=jnp.zeros((n, self.rounds, w), U32),
+            pool=(jnp.zeros((n, self.rounds, w), U32) if self.snapshot_pool
+                  else jnp.zeros((1, 1, 1), U32)),
             emission=emission, pos=jnp.zeros((n, L), jnp.int32),
             curr_window=jnp.full((n,), self.window_initial, jnp.int32),
             added_cycle=jnp.full((n,), self.extra_cycle, jnp.int32),
@@ -288,7 +332,7 @@ class Handel(LevelMixin):
     def step(self, p: HandelState, nodes, inbox, t, key):
         ids = jnp.arange(self.node_count, dtype=jnp.int32)
         active = (~nodes.down) & (t >= p.start_at + 1)
-        onehot = self._word_onehot(ids)
+        onehot = None if self.prefix_pc else self._word_onehot(ids)
         subm = self._subword_masks(ids)
         hi = ids >> 5
 
@@ -324,9 +368,15 @@ class Handel(LevelMixin):
         finished = p.finished_peers | jax.lax.reduce(
             fin_bits, U32(0), jax.lax.bitwise_or, (1,))
 
-        # Reconstruct sigs from the senders' snapshot pool (one flat gather).
-        sig_all = gather_rows(p.pool, src, rslot) & \
-            self._sender_block_mask(src, level)
+        # Reconstruct sigs from the senders' snapshot pool (one flat
+        # gather); pool-free mode reads the sender's CURRENT aggregate
+        # instead (see __init__).
+        if self.snapshot_pool:
+            sig_all = gather_rows(p.pool, src, rslot) & \
+                self._sender_block_mask(src, level)
+        else:
+            sig_all = (p.last_agg[src] | p.ver_ind[src]) & \
+                self._sender_block_mask(src, level)
         rank_all = self._rank(p.seed, ids[:, None], src) + \
             jnp.where(_get_bit_rows(p.demoted, src), n, 0)
 
@@ -647,8 +697,12 @@ class Handel(LevelMixin):
         half_cols = jnp.maximum(halfs, 1)                      # [1, L]
         offs = (p.pos[:, :, None] + jnp.arange(look)[None, None, :]) % \
             half_cols[:, :, None]                              # [N, L, k]
-        cols = jnp.minimum(half_cols[:, :, None] + offs, n - 1)
-        cand_ids = gather2d(p.emission, ids[:, None, None], cols)
+        if self.emission_mode == "stored":
+            cols = jnp.minimum(half_cols[:, :, None] + offs, n - 1)
+            cand_ids = gather2d(p.emission, ids[:, None, None], cols)
+        else:
+            cand_ids = self._emission_peer(p.seed, ids[:, None, None],
+                                           lvl_idx[:, :, None], offs)
         bad_bits = p.finished_peers | p.blacklist
         okc = ~_get_bit_rows(bad_bits, cand_ids)               # [N, L, k]
         found = jnp.any(okc, axis=2)
@@ -693,8 +747,12 @@ class Handel(LevelMixin):
             fpos = gather2d(pos, ids, fl)
             foffs = (fpos[:, None] + jnp.arange(fp)[None, :]) % \
                 fhalf[:, None]
-            fcols = jnp.minimum(fhalf[:, None] + foffs, n - 1)
-            fids = gather2d(p.emission, ids[:, None], fcols)
+            if self.emission_mode == "stored":
+                fcols = jnp.minimum(fhalf[:, None] + foffs, n - 1)
+                fids = gather2d(p.emission, ids[:, None], fcols)
+            else:
+                fids = self._emission_peer(p.seed, ids[:, None],
+                                           fl[:, None], foffs)
             fok = ~_get_bit_rows(bad_bits, fids)
             fsend = (fl > 0) & active & ~done
             fdest = jnp.where(fsend[:, None] & fok, fids, -1)
@@ -713,9 +771,12 @@ class Handel(LevelMixin):
 
         # Snapshot pool: any sender this ms records its current total_inc;
         # receivers mask out their level's view at delivery.
-        wrote = jnp.any(dest >= 0, axis=1)
-        pool = set_rows(p.pool, ids, jnp.full((n,), rslot, jnp.int32),
-                        total_inc, ok=wrote)
+        if self.snapshot_pool:
+            wrote = jnp.any(dest >= 0, axis=1)
+            pool = set_rows(p.pool, ids, jnp.full((n,), rslot, jnp.int32),
+                            total_inc, ok=wrote)
+        else:
+            pool = p.pool
 
         out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
                                              size=sizes)

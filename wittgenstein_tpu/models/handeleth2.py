@@ -48,7 +48,8 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d
-from ._levels import LevelMixin, get_bit_rows, sibling_base
+from ._levels import (LevelMixin, get_bit_rows, keyed_level_peer,
+                      sibling_base)
 
 U32 = jnp.uint32
 PERIOD_TIME = 6000
@@ -140,12 +141,7 @@ class HandelEth2(LevelMixin):
     def _emission_peer(self, seed, ids, level, pos):
         """pos-th peer of the level in emission order (peersPerLevel is a
         fixed shuffle per node, HandelEth2.java init)."""
-        half = jnp.where(level > 0, 1 << jnp.clip(level - 1, 0, 30), 1)
-        base = sibling_base(ids, jnp.maximum(half, 1))
-        key = prng.hash3(prng.hash2(seed, TAG_EMIT), ids, level)
-        perm = prng.bij_perm_dyn(key, jnp.where(pos < half, pos, 0),
-                                 jnp.maximum(level - 1, 0))
-        return base + perm
+        return keyed_level_peer(seed, TAG_EMIT, ids, level, pos)
 
     def _own_hash_draw(self, seed, ids, height):
         """Geometric hash draw: P(h) = 0.8 * 0.2^h (HNode.create :62-73),
