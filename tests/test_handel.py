@@ -103,8 +103,8 @@ def test_scale_mode_hashed_emission_poolfree():
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10,
-                   emission_mode="hashed", snapshot_pool=False)
-    proto.prefix_pc = True          # force the large-N popcount path too
+                   emission_mode="hashed", snapshot_pool=False,
+                   prefix_pc=True)   # force the large-N popcount path too
     outs = []
     for seed in (0, 0, 1):
         net, p = proto.init(seed)

@@ -53,7 +53,7 @@ from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d, gather_rows, set2d, set_rows
 from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
-                      keyed_level_peer, sibling_base)
+                      keyed_level_peer)
 
 TAG_BAD = 0x47424144      # bad-node choice
 TAG_PERM = 0x47504552     # per-(node, level) peer-order permutation

@@ -124,7 +124,7 @@ class Handel(LevelMixin):
                  window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
                  emission_lookahead=8, byzantine_suicide=False,
                  hidden_byzantine=False, emission_mode=None,
-                 snapshot_pool=None):
+                 snapshot_pool=None, prefix_pc=None):
         if node_count & (node_count - 1):
             raise ValueError("we support only power-of-two node counts "
                              "(Handel.java:119-121)")
@@ -157,7 +157,8 @@ class Handel(LevelMixin):
         # Past ~16k nodes the [N, W, L] word->level one-hot for the MXU
         # popcount contraction is gigabytes; the prefix-sum path computes
         # the SAME values (tested bit-equal) in O(N * W).
-        self.prefix_pc = node_count > 16384
+        self.prefix_pc = (node_count > 16384 if prefix_pc is None
+                          else prefix_pc)
         threshold = (int(node_count * 0.99) if threshold is None
                      else threshold)
         if not (0 <= nodes_down < node_count and
@@ -375,7 +376,7 @@ class Handel(LevelMixin):
             sig_all = gather_rows(p.pool, src, rslot) & \
                 self._sender_block_mask(src, level)
         else:
-            sig_all = (p.last_agg[src] | p.ver_ind[src]) & \
+            sig_all = (p.last_agg | p.ver_ind)[src] & \
                 self._sender_block_mask(src, level)
         rank_all = self._rank(p.seed, ids[:, None], src) + \
             jnp.where(_get_bit_rows(p.demoted, src), n, 0)

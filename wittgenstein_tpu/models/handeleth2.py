@@ -48,8 +48,7 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d
-from ._levels import (LevelMixin, get_bit_rows, keyed_level_peer,
-                      sibling_base)
+from ._levels import LevelMixin, get_bit_rows, keyed_level_peer
 
 U32 = jnp.uint32
 PERIOD_TIME = 6000
