@@ -1,0 +1,38 @@
+"""Profiling/observability hooks (SURVEY.md §5.1 replacement)."""
+
+import numpy as np
+
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.pingpong import PingPong
+from wittgenstein_tpu.utils.profiling import run_report, timed, trace
+
+
+def test_run_report_and_timers(tmp_path):
+    proto = PingPong(node_count=64)
+    net, ps = proto.init(0)
+    with timed() as t:
+        with trace(None):                      # no-op path
+            net, ps = Runner(proto, donate=False).run_ms(net, ps, 300)
+    wall = t()
+    rep = run_report(net, wall)
+    assert rep.startswith("Simulation execution time:")
+    assert "sim=300ms" in rep and "live=64" in rep
+    assert "dropped=0" in rep and "sim-ms/s" in rep
+    assert wall > 0
+
+
+def test_run_report_all_down_and_frozen_timer():
+    import time as _time
+    proto = PingPong(node_count=8)
+    net, ps = proto.init(0)
+    # All nodes down: the report must not crash or NaN.
+    net = net.replace(nodes=net.nodes.replace(
+        down=np.ones(8, bool) | np.asarray(net.nodes.down)))
+    rep = run_report(net)
+    assert "live=0" in rep and "nan" not in rep
+    # Timer freezes at block exit.
+    with timed() as t:
+        _time.sleep(0.05)
+    frozen = t()
+    _time.sleep(0.05)
+    assert abs(t() - frozen) < 1e-9

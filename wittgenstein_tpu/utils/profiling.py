@@ -1,0 +1,82 @@
+"""Tracing / profiling hooks — the TPU replacement for the reference's
+observability surface (SURVEY.md §5.1): the reference has per-node
+counters (Node.java:72-79), protocol counters, and a wall-clock print in
+ProgressPerTime ("Simulation execution time", ProgressPerTime.java:111).
+Here the counters already live in `NodeState`; this module adds the
+missing pieces: an XLA profiler trace context and a one-line run report.
+
+Usage::
+
+    from wittgenstein_tpu.utils.profiling import trace, run_report
+    with trace("/tmp/wtpu-trace"):          # view in TensorBoard/XProf
+        net, ps = runner.run_ms(net, ps, 1000)
+    print(run_report(net, wall_s))
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """jax.profiler trace around a simulation stretch (no-op when log_dir
+    is None, e.g. in CI)."""
+    import jax
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed():
+    """Wall-clock context: `with timed() as t: ...; t()` -> seconds of the
+    BLOCK (frozen at exit — the "Simulation execution time" measurement,
+    ProgressPerTime.java:111)."""
+    box = {"end": None}
+    t0 = time.perf_counter()
+
+    def elapsed():
+        return (box["end"] or time.perf_counter()) - t0
+
+    try:
+        yield elapsed
+    finally:
+        box["end"] = time.perf_counter()
+
+
+def run_report(net, wall_s: float | None = None) -> str:
+    """One-line run summary from the engine counters: simulated time,
+    per-node message/byte traffic over live nodes (via the StatsHelper
+    getters, which guard the all-down case), drop/clamp health, and
+    sim-ms-per-second when wall_s is given."""
+    from . import stats
+    nodes = net.nodes
+    live = int(np.asarray((~np.asarray(nodes.down)).sum()))
+    t = int(np.asarray(net.time))
+    msg_r = stats.msg_received_stats(nodes)
+    msg_s = stats.msg_sent_stats(nodes)
+    by_s = stats.bytes_sent_stats(nodes)
+    done = int(stats.done_count(nodes)["count"])
+    parts = [
+        f"sim={t}ms",
+        f"live={live}",
+        f"msgRecv avg={float(msg_r['avg']):.1f} max={float(msg_r['max']):.0f}",
+        f"msgSent avg={float(msg_s['avg']):.1f}",
+        f"bytesSent avg={float(by_s['avg']):.0f}",
+        f"done={done}/{live}",
+        f"dropped={int(np.asarray(net.dropped))}"
+        f"+{int(np.asarray(net.bc_dropped))}bc",
+        f"clamped={int(np.asarray(net.clamped))}",
+    ]
+    if wall_s is not None and wall_s > 0:
+        parts.append(f"wall={wall_s:.2f}s ({t / wall_s:.0f} sim-ms/s)")
+    return "Simulation execution time: " + " ".join(parts)
