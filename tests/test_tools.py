@@ -51,3 +51,32 @@ def test_node_drawer_gif(tmp_path):
     path = str(tmp_path / "n.gif")
     d.save_gif(path)
     assert os.path.getsize(path) > 1000
+
+
+def test_city_population_weighting():
+    """CityPopulationTest parity (core CityPopulationTest.java): the
+    'cities' builder samples cities proportionally to population via the
+    cumulative-probability table (NodeBuilder.java:127-139)."""
+    import numpy as np
+    from wittgenstein_tpu.core.builders import NodeBuilder, load_city_db
+
+    _, _, _, pops = load_city_db()
+    share = pops / pops.sum()
+    n = 20_000
+    nodes = NodeBuilder(location="cities").build(11, n)
+    city = np.asarray(nodes.city)
+    assert (city >= 0).all() and (city < len(pops)).all()
+    counts = np.bincount(city, minlength=len(pops))
+    emp = counts / n
+    # The top-population city must be sampled near its share, and overall
+    # the empirical distribution must track population shares.
+    top = int(np.argmax(share))
+    assert emp[top] > 0.5 * share[top]
+    assert emp[top] < 2.0 * share[top] + 0.01
+    # L1 distance between empirical and target distribution is small.
+    assert float(np.abs(emp - share).sum()) < 0.25
+    # Heaviest decile of cities holds its population share of nodes.
+    order = np.argsort(share)[::-1]
+    k = max(1, len(pops) // 10)
+    target = share[order[:k]].sum()
+    assert abs(emp[order[:k]].sum() - target) < 0.05

@@ -381,31 +381,57 @@ class Handel(LevelMixin):
         rank_all = self._rank(p.seed, ids[:, None], src) + \
             jnp.where(_get_bit_rows(p.demoted, src), n, 0)
 
-        q_from, q_lvl, q_rank = p.q_from, p.q_lvl, p.q_rank
-        q_bad, q_sig = p.q_bad, p.q_sig
-        evicted = p.evicted
-        for s in range(S):
-            oks, srcs, lvls = ok[:, s], src[:, s], level[:, s]
-            ranks = rank_all[:, s]
-            same = (q_from == srcs[:, None]) & (q_lvl == lvls[:, None])
-            free = q_from < 0
-            worst = jnp.argmax(jnp.where(free, -1, q_rank), axis=1)
-            worst_rank = jnp.take_along_axis(q_rank, worst[:, None],
-                                             axis=1)[:, 0]
-            any_same = jnp.any(same, axis=1)
-            any_free = jnp.any(free, axis=1)
-            slot = jnp.where(any_same, jnp.argmax(same, axis=1),
-                             jnp.where(any_free, jnp.argmax(free, axis=1),
-                                       worst))
-            evict = oks & ~any_same & ~any_free
-            ins = oks & (~evict | (ranks < worst_rank))
-            evicted = evicted + jnp.sum(evict & ins).astype(jnp.int32)
+        # Queue merge, vectorized across ALL slots at once.  The reference
+        # queues every incoming aggregate in an unbounded per-level list
+        # (onNewSig :753-786); this implementation bounds memory with a
+        # Q-slot queue whose policy is: one entry per (sender, level) —
+        # newest wins — and keep the Q best (lowest-reception-rank)
+        # candidates, ties favoring already-queued entries then earlier
+        # inbox slots.  One batched sort over (existing ∪ incoming)
+        # implements that directly; the previous unrolled per-slot
+        # insert/evict loop compiled S argmax+scatter blocks for a
+        # near-identical (slightly order-dependent) policy.
+        later = jnp.triu(jnp.ones((S, S), bool), k=1)[None]
+        dup = jnp.any((src[:, :, None] == src[:, None, :]) &
+                      (level[:, :, None] == level[:, None, :]) &
+                      ok[:, None, :] & later, axis=2)
+        inc_ok = ok & ~dup                   # newest same-key message wins
+        superseded = jnp.any(
+            (p.q_from[:, :, None] == src[:, None, :]) &
+            (p.q_lvl[:, :, None] == level[:, None, :]) &
+            inc_ok[:, None, :], axis=2)                        # [N, Q]
+        ex_keep = (p.q_from >= 0) & ~superseded
 
-            q_from = set2d(q_from, ids, slot, srcs, ok=ins)
-            q_lvl = set2d(q_lvl, ids, slot, lvls, ok=ins)
-            q_rank = set2d(q_rank, ids, slot, ranks, ok=ins)
-            q_bad = set2d(q_bad, ids, slot, False, ok=ins)
-            q_sig = set_rows(q_sig, ids, slot, sig_all[:, s], ok=ins)
+        u_from = jnp.concatenate(
+            [jnp.where(ex_keep, p.q_from, -1),
+             jnp.where(inc_ok, src, -1)], axis=1)              # [N, Q+S]
+        u_lvl = jnp.concatenate([p.q_lvl, level], axis=1)
+        u_rank = jnp.concatenate([p.q_rank, rank_all], axis=1)
+        u_bad = jnp.concatenate(
+            [p.q_bad, jnp.zeros_like(inc_ok)], axis=1)
+        u_sig = jnp.concatenate([p.q_sig, sig_all], axis=1)    # [N, Q+S, W]
+
+        valid_u = u_from >= 0
+        # rank * (Q+S+1) + position: existing entries (positions 0..Q-1)
+        # win ties, then incoming by slot order; fits int32 up to 2^25
+        # ranks (ranks are < 2N even after demotion).
+        keyv = jnp.where(valid_u,
+                         u_rank * (Q + S + 1) +
+                         jnp.arange(Q + S, dtype=jnp.int32)[None, :], BIG)
+        order = jnp.argsort(keyv, axis=1)[:, :Q]               # [N, Q]
+        q_from = jnp.take_along_axis(u_from, order, axis=1)
+        q_lvl = jnp.take_along_axis(u_lvl, order, axis=1)
+        q_rank = jnp.take_along_axis(u_rank, order, axis=1)
+        q_bad = jnp.take_along_axis(u_bad, order, axis=1)
+        q_sig = jnp.take_along_axis(u_sig, order[:, :, None], axis=1)
+        # Diagnostic: count EXISTING queue entries displaced by better
+        # incoming candidates (the old loop's evict semantics; rejected
+        # incoming messages don't count).
+        kept_existing = jnp.sum((order < Q) &
+                                jnp.take_along_axis(valid_u, order, axis=1),
+                                axis=1)
+        evicted = p.evicted + jnp.sum(
+            jnp.sum(ex_keep, axis=1) - kept_existing).astype(jnp.int32)
 
         return p.replace(q_from=q_from, q_lvl=q_lvl, q_rank=q_rank,
                          q_bad=q_bad, q_sig=q_sig, finished_peers=finished,

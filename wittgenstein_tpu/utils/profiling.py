@@ -69,7 +69,9 @@ def run_report(net, wall_s: float | None = None) -> str:
     parts = [
         f"sim={t}ms",
         f"live={live}",
-        f"msgRecv avg={float(msg_r['avg']):.1f} max={float(msg_r['max']):.0f}",
+        # max over an empty live set is the -inf sentinel; report 0.
+        f"msgRecv avg={float(msg_r['avg']):.1f} "
+        f"max={max(0.0, float(msg_r['max'])):.0f}",
         f"msgSent avg={float(msg_s['avg']):.1f}",
         f"bytesSent avg={float(by_s['avg']):.0f}",
         f"done={done}/{live}",
