@@ -54,6 +54,21 @@ def sibling_base(ids, half):
     return mine + jnp.where((ids & half) != 0, 0, half)
 
 
+def select_queue(keyv, valid, q_cap, cols2d, cols3d):
+    """Shared tail of the vectorized bounded-queue merges
+    (models/handel.py / models/gsf.py receive paths): keep the `q_cap`
+    best candidate entries by ascending key — invalid entries sort last —
+    and gather every queue column through the same order.  Returns
+    (selected 2-D columns dict, selected 3-D columns dict, order)."""
+    big = jnp.int32(0x7FFFFFFF)
+    order = jnp.argsort(jnp.where(valid, keyv, big), axis=1)[:, :q_cap]
+    sel2 = {k: jnp.take_along_axis(v, order, axis=1)
+            for k, v in cols2d.items()}
+    sel3 = {k: jnp.take_along_axis(v, order[:, :, None], axis=1)
+            for k, v in cols3d.items()}
+    return sel2, sel3, order
+
+
 class LevelMixin:
     """Requires self.node_count, self.bits (log2 N), self.levels, self.w."""
 

@@ -53,7 +53,7 @@ from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d, gather_rows, set2d, set_rows
 from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
-                      keyed_level_peer)
+                      keyed_level_peer, select_queue)
 
 TAG_BAD = 0x47424144      # bad-node choice
 TAG_PERM = 0x47504552     # per-(node, level) peer-order permutation
@@ -204,7 +204,6 @@ class GSFSignature(LevelMixin):
 
     def _receive(self, p: GSFState, nodes, inbox, t):
         n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
-        ids = jnp.arange(n, dtype=jnp.int32)
         S = inbox.src.shape[1]
 
         valid = inbox.valid
@@ -219,63 +218,81 @@ class GSFSignature(LevelMixin):
         fin_block = self._block_mask_dyn(src, fin)
         sig_all = (pool_row & low) | fin_block
 
-        # Individual signature of the sender, enqueued once ever per sender
-        # (got_indiv dedup; the reference keys it per level, but a sender
-        # only ever appears at ONE level of a given receiver — level ranges
-        # partition the id space).
-        got_indiv = p.got_indiv
+        # Queue merge, vectorized across ALL slots at once (the unrolled
+        # per-slot loop compiled S insert/evict blocks).  Bounded-queue
+        # policy: queued INDIVIDUAL entries are immovable (their got_indiv
+        # dedup bit would otherwise lose the sig forever — the reference
+        # keys individuals per level, but a sender only ever appears at ONE
+        # level of a given receiver); aggregates keep one entry per
+        # (sender, level) — newest wins — prioritized by LEVEL ascending
+        # (scoring favors early levels), existing before incoming, then
+        # inbox-slot order.  Policy change from the old loop: ALL same-ms
+        # aggregates now outrank same-ms individual sigs for capacity (the
+        # loop interleaved them by slot); individuals fill leftover slots.
+        # One tiered sort over (existing ∪ inc-agg ∪ inc-indiv) does it.
+        M = Q + 2 * S
+        later = jnp.triu(jnp.ones((S, S), bool), k=1)[None]
+        dup = jnp.any((src[:, :, None] == src[:, None, :]) &
+                      (level[:, :, None] == level[:, None, :]) &
+                      valid[:, None, :] & later, axis=2)
+        agg_ok = valid & ~dup                # newest same-key message wins
+        superseded = jnp.any(
+            (p.q_from[:, :, None] == src[:, None, :]) &
+            (p.q_lvl[:, :, None] == level[:, None, :]) &
+            (~p.q_indiv)[:, :, None] & agg_ok[:, None, :], axis=2)
+        ex_keep = (p.q_from >= 0) & ~superseded
 
-        q_from, q_lvl, q_indiv = p.q_from, p.q_lvl, p.q_indiv
-        q_sig = p.q_sig
-        evicted = p.evicted
-        for s in range(S):
-            oks, srcs, lvls = valid[:, s], src[:, s], level[:, s]
-            # -- main aggregate entry: replace same (from, level), else a
-            # free slot, else evict the highest-level entry.
-            same = (q_from == srcs[:, None]) & (q_lvl == lvls[:, None]) & \
-                ~q_indiv
-            free = q_from < 0
-            # Individual entries are never evicted: their got_indiv dedup
-            # bit stays set, so an evicted one would be lost forever.
-            evictable = ~free & ~q_indiv
-            worst = jnp.argmax(jnp.where(evictable, q_lvl, -1), axis=1)
-            worst_lvl = jnp.take_along_axis(
-                jnp.where(evictable, q_lvl, -1), worst[:, None],
-                axis=1)[:, 0]
-            any_same = jnp.any(same, axis=1)
-            any_free = jnp.any(free, axis=1)
-            slot = jnp.where(any_same, jnp.argmax(same, axis=1),
-                             jnp.where(any_free, jnp.argmax(free, axis=1),
-                                       worst))
-            # Evict only for a more valuable (lower-level) entry — the
-            # scoring favors early levels, so replacing a low-level entry
-            # with a high-level one would discard pending useful work.
-            evict = oks & ~any_same & ~any_free
-            ins = oks & (~evict | ((worst_lvl >= 0) & (lvls < worst_lvl)))
-            evicted = evicted + jnp.sum(evict & ins).astype(jnp.int32)
-            q_from = set2d(q_from, ids, slot, srcs, ok=ins)
-            q_lvl = set2d(q_lvl, ids, slot, lvls, ok=ins)
-            q_indiv = set2d(q_indiv, ids, slot, False, ok=ins)
-            q_sig = set_rows(q_sig, ids, slot, sig_all[:, s], ok=ins)
+        # Incoming individuals: once ever per sender — first slot this ms
+        # wins, and senders already in got_indiv are consumed.
+        earlier = jnp.tril(jnp.ones((S, S), bool), k=-1)[None]
+        dup_ind = jnp.any((src[:, :, None] == src[:, None, :]) &
+                          valid[:, None, :] & earlier, axis=2)
+        ind_ok = valid & ~dup_ind & ~_get_bit_rows(p.got_indiv, src)
 
-            # -- individual-sig entry (once ever per sender, :546-553);
-            # the dedup bit is re-read inside the loop so two same-ms
-            # deliveries from one sender enqueue only once.
-            ind = oks & ~_get_bit_rows(got_indiv, srcs[:, None])[:, 0]
-            free2 = q_from < 0
-            any_free2 = jnp.any(free2, axis=1)
-            slot2 = jnp.argmax(free2, axis=1)
-            ins2 = ind & any_free2        # indiv entries never evict others
-            # Mark consumed only when actually enqueued, else a full queue
-            # would permanently discard this sender's individual signature.
-            got_indiv = jnp.where(ins2[:, None],
-                                  got_indiv | bitset.one_bit(srcs, w),
-                                  got_indiv)
-            q_from = set2d(q_from, ids, slot2, srcs, ok=ins2)
-            q_lvl = set2d(q_lvl, ids, slot2, lvls, ok=ins2)
-            q_indiv = set2d(q_indiv, ids, slot2, True, ok=ins2)
-            q_sig = set_rows(q_sig, ids, slot2, bitset.one_bit(srcs, w),
-                             ok=ins2)
+        u_from = jnp.concatenate(
+            [jnp.where(ex_keep, p.q_from, -1),
+             jnp.where(agg_ok, src, -1),
+             jnp.where(ind_ok, src, -1)], axis=1)           # [N, M]
+        u_lvl = jnp.concatenate([p.q_lvl, level, level], axis=1)
+        u_indiv = jnp.concatenate(
+            [p.q_indiv, jnp.zeros_like(agg_ok),
+             jnp.ones_like(ind_ok)], axis=1)
+        u_sig = jnp.concatenate(
+            [p.q_sig, sig_all,
+             jnp.where(ind_ok[..., None], bitset.one_bit(src, w),
+                       U32(0))], axis=1)                     # [N, M, W]
+
+        valid_u = u_from >= 0
+        pos = jnp.arange(M, dtype=jnp.int32)[None, :]
+        is_inc_ind = pos >= Q + S                            # tier 2
+        tier = jnp.where(is_inc_ind, 2,
+                         jnp.where(u_indiv, 0, 1))           # existing
+        #                                                      indiv = 0
+        lvl_term = jnp.where(tier == 1, u_lvl, 0)
+        sel2, sel3, order = select_queue(
+            (tier * (L + 1) + lvl_term) * M + pos, valid_u, Q,
+            {"from": u_from, "lvl": u_lvl, "indiv": u_indiv},
+            {"sig": u_sig})
+        q_from, q_lvl, q_indiv = sel2["from"], sel2["lvl"], sel2["indiv"]
+        q_sig = sel3["sig"]
+
+        # got_indiv consumed only for incoming individuals that made it in.
+        sel_new_ind = (jnp.take_along_axis(
+            jnp.broadcast_to(is_inc_ind, valid_u.shape), order, axis=1) &
+            (q_from >= 0))
+        ind_bits = jnp.where(sel_new_ind[..., None],
+                             bitset.one_bit(jnp.maximum(q_from, 0), w),
+                             U32(0))
+        got_indiv = p.got_indiv | jax.lax.reduce(
+            ind_bits, U32(0), jax.lax.bitwise_or, (1,))
+
+        # Diagnostic: displaced existing aggregate entries.
+        kept_ex_agg = jnp.sum(
+            (order < Q) &
+            jnp.take_along_axis(valid_u & ~u_indiv, order, axis=1), axis=1)
+        evicted = p.evicted + jnp.sum(
+            jnp.sum(ex_keep & ~p.q_indiv, axis=1) -
+            kept_ex_agg).astype(jnp.int32)
 
         return p.replace(q_from=q_from, q_lvl=q_lvl, q_indiv=q_indiv,
                          q_sig=q_sig, got_indiv=got_indiv, evicted=evicted)

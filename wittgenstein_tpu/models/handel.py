@@ -64,7 +64,7 @@ from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
 from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
-                      keyed_level_peer, sibling_base)
+                      keyed_level_peer, select_queue, sibling_base)
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
 TAG_BAD = 0x48424144      # bad-node choice
@@ -415,15 +415,15 @@ class Handel(LevelMixin):
         # rank * (Q+S+1) + position: existing entries (positions 0..Q-1)
         # win ties, then incoming by slot order; fits int32 up to 2^25
         # ranks (ranks are < 2N even after demotion).
-        keyv = jnp.where(valid_u,
-                         u_rank * (Q + S + 1) +
-                         jnp.arange(Q + S, dtype=jnp.int32)[None, :], BIG)
-        order = jnp.argsort(keyv, axis=1)[:, :Q]               # [N, Q]
-        q_from = jnp.take_along_axis(u_from, order, axis=1)
-        q_lvl = jnp.take_along_axis(u_lvl, order, axis=1)
-        q_rank = jnp.take_along_axis(u_rank, order, axis=1)
-        q_bad = jnp.take_along_axis(u_bad, order, axis=1)
-        q_sig = jnp.take_along_axis(u_sig, order[:, :, None], axis=1)
+        keyv = u_rank * (Q + S + 1) + \
+            jnp.arange(Q + S, dtype=jnp.int32)[None, :]
+        sel2, sel3, order = select_queue(
+            keyv, valid_u, Q,
+            {"from": u_from, "lvl": u_lvl, "rank": u_rank, "bad": u_bad},
+            {"sig": u_sig})
+        q_from, q_lvl = sel2["from"], sel2["lvl"]
+        q_rank, q_bad = sel2["rank"], sel2["bad"]
+        q_sig = sel3["sig"]
         # Diagnostic: count EXISTING queue entries displaced by better
         # incoming candidates (the old loop's evict semantics; rejected
         # incoming messages don't count).
