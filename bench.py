@@ -61,7 +61,35 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250):
     return seeds * actual_ms / wall
 
 
+def _require_backend(timeout_s=240):
+    """Fail fast (nonzero exit) if the accelerator backend doesn't come
+    up: a wedged device tunnel makes `jax.devices()` hang forever, which
+    would otherwise hang the benchmark driver instead of reporting an
+    infrastructure failure."""
+    import threading
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            jax.devices()
+        except BaseException as e:          # noqa: BLE001 — re-raised below
+            err.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not done.wait(timeout_s):
+        raise SystemExit(
+            f"bench: JAX backend failed to initialize within {timeout_s}s "
+            "(device tunnel down?) — refusing to hang or fake a number")
+    if err:
+        raise SystemExit(f"bench: JAX backend failed to initialize: "
+                         f"{err[0]!r}")
+
+
 def main():
+    _require_backend()
     n = int(os.environ.get("WTPU_BENCH_NODES", 2048))
     seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 8))
     sim_ms = int(os.environ.get("WTPU_BENCH_MS", 1000))
