@@ -58,6 +58,7 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250):
     assert int(np.asarray(nets.dropped).sum()) == 0
     assert int(np.asarray(nets.bc_dropped).sum()) == 0
     assert int(np.asarray(nets.clamped).sum()) == 0
+    assert int(np.asarray(ps.evicted).sum()) == 0   # queue never overflowed
     return seeds * actual_ms / wall
 
 

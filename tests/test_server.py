@@ -64,6 +64,41 @@ def test_external_bridge_mock():
     assert all(e["to"] == 3 for e in seen)
 
 
+def test_pending_messages_full_in_flight_set():
+    """Server.java:168-171 exposes the WHOLE in-flight set, not just the
+    next ms: injected unicasts at different delays + a live broadcast must
+    all appear, sorted by (arrivingAt, sentAt, from, to)."""
+    s = score.Server()
+    s.init("PingPong", {"node_count": 32}, seed=0)
+    # Two unicasts, 100 ms apart; delay d arrives at t + 1 + d + latency.
+    s.send(1, 2, payload=[7], delay=50)
+    s.send(4, 5, payload=[9], delay=150)
+    msgs = s.pending_messages()
+    uni = [m for m in msgs if m["sentAt"] == -1]
+    assert {(m["from"], m["to"]) for m in uni} == {(1, 2), (4, 5)}
+    a12 = next(m for m in uni if m["from"] == 1)
+    a45 = next(m for m in uni if m["from"] == 4)
+    assert a12["arrivingAt"] > 51 and a45["arrivingAt"] > a12["arrivingAt"]
+    assert a12["payload"][0] == 7 and a45["payload"][0] == 9
+
+    # One ms in, the witness's Ping broadcast is in flight: every live
+    # dest whose arrival is still in the future shows as a sentAt=0 row —
+    # including an external node's (down in-engine, but its deliveries DO
+    # reach the bridge, so the peek must show them).
+    s.set_external(9, lambda delivered: [])
+    s.run_ms(1)
+    msgs = s.pending_messages()
+    bc = [m for m in msgs if m["sentAt"] == 0]
+    assert len(bc) > 20 and all(m["arrivingAt"] >= 1 for m in bc)
+    assert any(m["to"] == 9 for m in bc), "external node's in-flight hidden"
+    s.clear_external(9)
+    assert msgs == sorted(msgs, key=lambda e: (e["arrivingAt"], e["sentAt"],
+                                               e["from"], e["to"]))
+    # Delivered messages leave the set.
+    s.run_ms(1000)
+    assert s.pending_messages() == []
+
+
 def _get(port, path):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
         return json.loads(r.read())
@@ -102,5 +137,40 @@ def test_http_round_trip():
               {"from": 1, "to": 2, "payload": [7]})
         msgs = _get(port, "/w/network/messages")
         assert isinstance(msgs, list)
+        assert any(m["from"] == 1 and m["to"] == 2 for m in msgs)
+    finally:
+        httpd.shutdown()
+
+
+def _put(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_external_sink_endpoint():
+    """ws/ExternalWS.java:21-40: the demo sink accepts an EnvelopeInfo
+    PUT and replies with an empty SendMessage list — including when it is
+    the external endpoint of a simulation on the SAME server."""
+    import threading
+    httpd = make_server(0)
+    port = httpd.server_address[1]
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        out = _put(port, "/w/external_sink",
+                   [{"from": 0, "to": 3, "arrivingAt": 5, "payload": [1]}])
+        assert out == []
+        # Self-referential bridge: node 3's deliveries are shipped to this
+        # same server's sink (lock-free route — no deadlock).
+        _post(port, "/w/network/init/PingPong", {"node_count": 32})
+        _post(port, "/w/network/nodes/3/external",
+              {"url": f"http://127.0.0.1:{port}/w/external_sink"})
+        _post(port, "/w/network/runMs/120")
+        assert _get(port, "/w/network/time") == 120
+        assert _get(port, "/w/network/nodes/3")["external"]
     finally:
         httpd.shutdown()

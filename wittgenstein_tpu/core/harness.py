@@ -15,6 +15,7 @@ match the sequential semantics run for run.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -64,19 +65,52 @@ def _check_drops(dropped, where):
             "this scenario (pass fail_on_drop=False if that is intended)")
 
 
+def _shard_seed_axis(trees, devices):
+    """Lay the leading (seed) axis of every array across `devices` with a
+    1-D GSPMD mesh — the multi-device analog of the reference's sequential
+    seed loop (RunMultipleTimes.java:44-76).  Runs are data-parallel with
+    no cross-run ops, so XLA partitions the whole chunk program along the
+    seed axis and results stay bit-identical to the single-device vmap."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("dp",))
+
+    def put(x):
+        spec = P(*(("dp",) + (None,) * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return tuple(jax.tree.map(put, t) for t in trees)
+
+
 class _BatchDriver:
     """Shared multi-seed scaffolding for `run_multiple_times` and
     `progress_per_time`: vmapped init over seeds, frozen-run chunk advance,
     and the drop/clamp guard."""
 
     def __init__(self, protocol, run_count, chunk, cont_if, first_seed,
-                 fail_on_drop, where):
+                 fail_on_drop, where, devices=None):
         self.cont = cont_if or cont_until_done
         self.seeds = jnp.arange(first_seed, first_seed + run_count,
                                 dtype=jnp.int32)
         self.nets, self.ps = jax.vmap(protocol.init)(self.seeds)
         self.stopped = jnp.zeros((run_count,), bool)
         self.stopped_at = jnp.zeros((run_count,), jnp.int32)
+        explicit = devices is not None
+        if devices is None:                      # auto: all, when they divide
+            devices = jax.devices()
+            if run_count % len(devices) != 0:
+                devices = devices[:1]
+        if run_count % len(devices) != 0:
+            raise ValueError(f"run_count={run_count} not divisible by "
+                             f"{len(devices)} devices")
+        # Place even for an explicit single device (it may not be the
+        # default one); skip only the redundant auto single-device put.
+        if len(devices) > 1 or explicit:
+            (self.nets, self.ps, self.stopped, self.stopped_at,
+             self.seeds) = _shard_seed_axis(
+                (self.nets, self.ps, self.stopped, self.stopped_at,
+                 self.seeds), devices)
         self._chunk_all = _freeze_chunk(protocol, chunk, self.cont)
         self._fail_on_drop = fail_on_drop
         self._where = where
@@ -102,21 +136,37 @@ class MultiRunResult:
 
 def run_multiple_times(protocol, run_count, max_time=0, chunk=10,
                        cont_if=None, stats_getters=(), final_check=None,
-                       first_seed=0, fail_on_drop=True):
+                       first_seed=0, fail_on_drop=True, devices=None,
+                       max_wall_s=None):
     """Vectorized RunMultipleTimes.run (RunMultipleTimes.java:41-87).
 
     Seeds are first_seed..first_seed+run_count-1 (the reference uses the
     round index as seed, :46).  max_time=0 mirrors the reference's
     "no time limit" — the loop then runs until every run's predicate stops
-    it, which never happens for a protocol that cannot converge; prefer a
-    real bound.  Returns averaged stats across runs plus per-run values.
+    it; unlike the reference there is no ^C ergonomics under jit, so a
+    wall-clock bound (`max_wall_s`, default 1800 s when max_time=0) guards
+    against a protocol that cannot converge.  `devices` shards the seed
+    axis across a device mesh (default: all local devices when run_count
+    divides evenly; pass `devices=jax.devices()[:1]` to force one).
+    Returns averaged stats across runs plus per-run values.
     """
     drv = _BatchDriver(protocol, run_count, chunk, cont_if, first_seed,
-                       fail_on_drop, f"run_multiple_times({protocol})")
+                       fail_on_drop, f"run_multiple_times({protocol})",
+                       devices=devices)
     steps = 10**9 if max_time == 0 else -(-max_time // chunk)
+    if max_time == 0 and max_wall_s is None:
+        max_wall_s = 1800.0
+    deadline = None if max_wall_s is None else time.monotonic() + max_wall_s
     for _ in range(steps):
         if drv.advance():
             break
+        if deadline is not None and time.monotonic() > deadline:
+            raise RuntimeError(
+                f"run_multiple_times({protocol}) exceeded the "
+                f"{max_wall_s:.0f}s wall-clock bound at sim time "
+                f"{int(jnp.max(drv.nets.time))} ms with "
+                f"{int(jnp.sum(~drv.stopped))}/{run_count} runs still "
+                "going; pass max_time or a larger max_wall_s")
     nets, ps, stopped_at, seeds = drv.nets, drv.ps, drv.stopped_at, drv.seeds
 
     if final_check is not None:
@@ -143,7 +193,7 @@ class TimeSeries:
 
 def progress_per_time(protocol, run_count=1, max_time=20_000,
                       stat_each_ms=10, stats_getters=(), cont_if=None,
-                      first_seed=0, fail_on_drop=True):
+                      first_seed=0, fail_on_drop=True, devices=None):
     """Time-series variant (core/ProgressPerTime.java:53-149): sample the
     getters every `stat_each_ms` across all runs; merge min/avg/max across
     the run axis per sample point.  Stopped runs are frozen exactly as in
@@ -151,7 +201,8 @@ def progress_per_time(protocol, run_count=1, max_time=20_000,
     stop-time values (the sequential reference never samples a finished run
     again; a frozen flatline is the batched equivalent)."""
     drv = _BatchDriver(protocol, run_count, stat_each_ms, cont_if, first_seed,
-                       fail_on_drop, f"progress_per_time({protocol})")
+                       fail_on_drop, f"progress_per_time({protocol})",
+                       devices=devices)
 
     @jax.jit
     def sample(nets):

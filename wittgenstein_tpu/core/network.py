@@ -41,6 +41,32 @@ def _retire_broadcasts(cfg: EngineConfig, net: NetState) -> NetState:
     return net.replace(bc_active=live)
 
 
+def broadcast_arrivals(cfg: EngineConfig, model, net: NetState, nodes):
+    """Per-(record, dest) broadcast arrival recompute — the one shared
+    definition of the reference's stateless multicast-latency trick
+    (Envelope.java:45-56, Network.java:493-503): latency is a pure function
+    of (record seed, dest), never stored.  Returns ``(arrival [B, N],
+    ok [B, N], clamped [B, N])`` where `ok` covers record-active, discard
+    and partition checks (NOT the destination's down flag — delivery and
+    introspection treat that differently) and `clamped` marks arrivals
+    whose true latency outran the ring.
+    """
+    node_idx = jnp.arange(cfg.n, dtype=jnp.int32)
+    delta = prng.uniform_delta(net.bc_seed[:, None], node_idx[None, :])
+    lat = full_latency(model, nodes, net.bc_src[:, None], node_idx[None, :],
+                       delta)
+    # Discard is checked against the TRUE latency (Network.java:481 compares
+    # nt before any storage), then the survivor is clamped into the ring.
+    not_discarded = lat < cfg.msg_discard_time
+    raw_lat = jnp.maximum(lat, 1)
+    lat = jnp.clip(lat, 1, cfg.horizon - 2)
+    arrival = net.bc_time[:, None] + 1 + lat
+    ok = (net.bc_active[:, None] & not_discarded
+          & (nodes.partition[net.bc_src][:, None] ==
+             nodes.partition[None, :]))
+    return arrival, ok, raw_lat != lat
+
+
 def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     """Assemble the time-t inbox and bump receive counters.
 
@@ -69,22 +95,9 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     uc_valid = uc_valid & deliver_ok
 
     # --- broadcast recompute: which records arrive at exactly t? ---
-    node_idx = jnp.arange(n, dtype=jnp.int32)
-    delta = prng.uniform_delta(net.bc_seed[:, None], node_idx[None, :])  # [B, N]
-    lat = full_latency(model, nodes, net.bc_src[:, None], node_idx[None, :],
-                       delta)
-    # Discard is checked against the TRUE latency (Network.java:481 compares
-    # nt before any storage), then the survivor is clamped into the ring.
-    not_discarded = lat < cfg.msg_discard_time
-    raw_lat = jnp.maximum(lat, 1)
-    lat = jnp.clip(lat, 1, cfg.horizon - 2)
-    arrival = net.bc_time[:, None] + 1 + lat
-    bc_valid = (net.bc_active[:, None] & (arrival == t)
-                & not_discarded
-                & (~nodes.down[None, :])
-                & (nodes.partition[net.bc_src][:, None] ==
-                   nodes.partition[None, :]))               # [B, N]
-    bc_valid = jnp.transpose(bc_valid)                      # [N, B]
+    arrival, bc_ok, clamped = broadcast_arrivals(cfg, model, net, nodes)
+    bc_valid = bc_ok & (arrival == t) & (~nodes.down[None, :])   # [B, N]
+    bc_valid = jnp.transpose(bc_valid)                           # [N, B]
     bc_data = jnp.broadcast_to(net.bc_payload[None, :, :],
                                (n, b, cfg.payload_words))
     bc_src = jnp.broadcast_to(net.bc_src[None, :], (n, b))
@@ -103,8 +116,7 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
                           bytes_received=nodes.bytes_received + rbytes)
     # Broadcast deliveries whose true latency outran the ring (counted once,
     # at their clamped delivery ms).
-    n_clamped = jnp.sum(jnp.transpose(bc_valid) &
-                        (raw_lat != lat)).astype(jnp.int32)
+    n_clamped = jnp.sum(jnp.transpose(bc_valid) & clamped).astype(jnp.int32)
     return inbox, nodes, n_clamped
 
 

@@ -787,11 +787,21 @@ class MinerAgentEnv:
 
     def send_mined_blocks(self, how_many: int):
         """Publish the `how_many` oldest private blocks (sendMinedBlocks
-        :68-90 + actionSendOldestBlockMined :215-221)."""
+        :68-90 + actionSendOldestBlockMined :215-221).
+
+        The reference's loop is ``while (howMany-- > 0 &&
+        !minedToSend.isEmpty())``: the POST-decrement means howMany ends
+        at 0 — and the restart-on-head fires — only when the queue ran
+        dry with exactly one request remaining (sent == howMany-1),
+        never when the request was fully consumed (howMany ends -1).
+        privateMinerBlock clears whenever the queue is empty afterwards,
+        even if nothing was sent (:85-87).  others_head moves only on
+        blocks received from other miners (onReceivedBlock), never at
+        publish time.  (The reference also gates the restart on
+        ``inMining != null``; our miners are always mining between
+        ticks, so that is always true here.)"""
         blocks = self._unsent_blocks()
-        send, keep = blocks[:how_many], blocks[how_many:]
-        if not send:
-            return
+        send = blocks[:how_many]
         aw = self.proto.aw
         p = self.p
         unsent = p.mined_unsent
@@ -800,17 +810,15 @@ class MinerAgentEnv:
             bit = bitset.one_bit(jnp.asarray(b, jnp.int32), aw)
             unsent = unsent.at[1].set(unsent[1] & ~bit)
             release = release.at[1].set(release[1] | bit)
-        heights = np.asarray(p.arena.height)
-        top = max(send, key=lambda b: int(heights[b]))
-        oh = int(np.asarray(p.others_head)[1])
-        new_oh = top if int(heights[top]) > int(heights[oh]) else oh
+        pb = int(np.asarray(p.private_blk)[1])
+        restart = len(send) == how_many - 1 and pb >= 0
+        queue_empty = len(blocks) <= how_many
         self.p = p.replace(
             mined_unsent=unsent, release=release,
-            others_head=p.others_head.at[1].set(new_oh),
-            private_blk=(p.private_blk if keep
-                         else p.private_blk.at[1].set(-1)),
-            # restart mining on the (possibly private) head (:83-85)
-            min_father=p.min_father.at[1].set(-1))
+            private_blk=(p.private_blk.at[1].set(-1) if queue_empty
+                         else p.private_blk),
+            min_father=(p.min_father.at[1].set(-1) if restart
+                        else p.min_father))
 
     # ---------------------------------------------------------- observables
 

@@ -117,6 +117,13 @@ class GSFSignature(LevelMixin):
 
         self.bits = max(1, int(math.log2(node_count)))
         self.levels = self.bits + 1
+        # The queue-merge sort key is (tier*(L+1)+lvl)*M + pos in int32
+        # with tier <= 2 and M = Q + 2S (see receive); enforce it fits.
+        _m = queue_cap + 2 * inbox_cap
+        if (2 * (self.levels + 1) + self.levels) * _m + _m >= 2 ** 31:
+            raise ValueError(
+                "queue-merge sort key would overflow int32: reduce "
+                f"queue_cap={queue_cap}/inbox_cap={inbox_cap}")
         self.w = bitset.n_words(node_count)
         self.rounds = horizon // max(1, period_duration_ms) + 2
         self.half = np.array([0] + [1 << (l - 1)

@@ -187,6 +187,14 @@ class Handel(LevelMixin):
         self.builder = builders.get_by_name(node_builder_name)
         self.latency = latency_mod.get_by_name(network_latency_name)
 
+        # The queue-merge sort key is rank * (Q + S + 1) + pos in int32
+        # (see _merge_queue); ranks stay < 2*N even after demotion, so the
+        # key is bounded by 2*N*(Q+S+1) — enforce it fits.
+        if 2 * node_count * (queue_cap + inbox_cap + 1) >= 2 ** 31:
+            raise ValueError(
+                "queue-merge sort key would overflow int32: "
+                f"2*{node_count}*({queue_cap}+{inbox_cap}+1) >= 2**31; "
+                "reduce queue_cap/inbox_cap or node_count")
         self.bits = max(1, int(math.log2(node_count)))
         self.levels = self.bits + 1            # levels 0..bits
         self.w = bitset.n_words(node_count)

@@ -188,6 +188,67 @@ class Server:
                             "payload": [int(x) for x in data[i, s]]})
         return out
 
+    def pending_messages(self) -> list:
+        """GET /network/messages — the FULL in-flight set (Server.java:
+        168-171): every undelivered unicast in the mailbox ring plus every
+        active broadcast's future per-dest arrivals, recomputed from the
+        counter PRNG exactly as delivery will, as EnvelopeInfo dicts sorted
+        by (arrivingAt, sentAt, from, to) (EnvelopeInfo.java:33-47).
+
+        sentAt is -1 for unicasts: the ring, like the reference's envelope
+        compression (Envelope.java:45-56), does not retain send times.
+        Broadcast rows apply the down/partition filter at peek time (the
+        engine applies it at delivery); unicast rows were filtered at send
+        time, as the reference's createMessageArrival does."""
+        self._require()
+        cfg = self.protocol.cfg
+        t = int(self.net.time)
+        H, n, c, f = cfg.horizon, cfg.n, cfg.inbox_cap, cfg.payload_words
+        out = []
+
+        count = np.asarray(self.net.box_count)                   # [H, N]
+        src = np.asarray(self.net.box_src).reshape(H, n, c)
+        data = np.asarray(self.net.box_data)[:f * H * n * c].reshape(
+            f, H, n, c)
+        for h in np.nonzero(count.sum(axis=1))[0]:
+            arriving = t + int((int(h) - t) % H)
+            for d in np.nonzero(count[h])[0]:
+                for s in range(int(count[h, d])):
+                    out.append({
+                        "from": int(src[h, d, s]), "to": int(d),
+                        "sentAt": -1, "arrivingAt": arriving,
+                        "payload": [int(data[fi, h, d, s])
+                                    for fi in range(f)]})
+
+        if bool(np.asarray(self.net.bc_active).any()):
+            # External nodes are stopped in-engine but their deliveries DO
+            # reach the bridge (run_ms lifts the down flag, like
+            # Network.java:616-623 diverting instead of dropping) — lift it
+            # for the peek too so their in-flight traffic is visible.
+            nodes = self.net.nodes
+            down = nodes.down
+            for x in self.externals:
+                down = down.at[x].set(False)
+            nodes = nodes.replace(down=down)
+            arrival, ok, _ = net_mod.broadcast_arrivals(
+                cfg, self.protocol.latency, self.net, nodes)
+            pend = ok & (arrival >= t) & (~nodes.down[None, :])
+            pend_np = np.asarray(pend)
+            arr_np = np.asarray(arrival)
+            bsrc = np.asarray(self.net.bc_src)
+            btime = np.asarray(self.net.bc_time)
+            bpay = np.asarray(self.net.bc_payload)
+            for r, d in zip(*np.nonzero(pend_np)):
+                out.append({
+                    "from": int(bsrc[r]), "to": int(d),
+                    "sentAt": int(btime[r]),
+                    "arrivingAt": int(arr_np[r, d]),
+                    "payload": [int(x) for x in bpay[r]]})
+
+        out.sort(key=lambda e: (e["arrivingAt"], e["sentAt"], e["from"],
+                                e["to"]))
+        return out
+
     def send(self, src: int, dest: int, payload=None, delay: int = 0):
         """POST /network/send (SendMessage.java): inject a unicast."""
         self._require()

@@ -11,18 +11,22 @@ role is played by ThreadingHTTPServer):
     GET  /w/network/time
     GET  /w/network/nodes
     GET  /w/network/nodes/{id}
-    GET  /w/network/messages               pending deliveries (next ms)
+    GET  /w/network/messages               ALL in-flight deliveries
     POST /w/network/nodes/{id}/stop
     POST /w/network/nodes/{id}/start
     POST /w/network/nodes/{id}/external    body: {"url": ...} — deliveries
-                                           POSTed there (ExternalRest.java)
+                                           PUT there (ExternalRest.java)
     POST /w/network/send                   body: {from, to, payload, delay}
+    PUT  /w/external_sink                  demo external node: logs the
+                                           EnvelopeInfo list, replies []
+                                           (ws/ExternalWS.java:21-40)
 
 Run: python -m wittgenstein_tpu.server.http [port]
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import threading
@@ -33,13 +37,13 @@ from . import core
 
 
 def _external_rest(url: str):
-    """ExternalRest parity (wserver/ExternalRest.java:44-59): POST the
+    """ExternalRest parity (wserver/ExternalRest.java:42-59): PUT the
     EnvelopeInfo list as JSON; the response body is a SendMessage list."""
 
     def handler(delivered):
         req = urllib.request.Request(
             url, data=json.dumps(delivered).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers={"Content-Type": "application/json"}, method="PUT")
         try:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 body = resp.read()
@@ -70,7 +74,14 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/w/network/nodes/(\d+)$",
          lambda s, m, b: s.srv.node_info(int(m.group(1)))),
         ("GET", r"^/w/network/messages$",
-         lambda s, m, b: s.srv.peek_messages()),
+         lambda s, m, b: s.srv.pending_messages()),
+        # Demo external-node sink (ws/ExternalWS.java:21-40): logs the
+        # EnvelopeInfo list it receives, replies with no messages.  Listed
+        # in NO_LOCK_PATTERNS (it never touches the simulation) so a
+        # simulation on the SAME server may use it as its external
+        # endpoint without deadlocking run_ms.
+        ("PUT", r"^/w/external_sink$",
+         lambda s, m, b: s._external_sink(b)),
         ("POST", r"^/w/network/nodes/(\d+)/stop$",
          lambda s, m, b: s.srv.stop_node(int(m.group(1)))),
         ("POST", r"^/w/network/nodes/(\d+)/start$",
@@ -83,9 +94,18 @@ class _Handler(BaseHTTPRequestHandler):
                                     b.get("delay", 0))),
     ]
 
+    # Routes that must NOT take the sim lock (keyed by the ROUTES pattern,
+    # so a route rename keeps its exemption).
+    NO_LOCK_PATTERNS = frozenset({r"^/w/external_sink$"})
+
     @property
     def srv(self) -> core.Server:
         return self.server.sim_server
+
+    def _external_sink(self, body):
+        """Dummy external node (ExternalWS.java:21-40): print, reply []."""
+        print(f"Received message: {body}")
+        return []
 
     def _dispatch(self, method):
         body = None
@@ -98,8 +118,12 @@ class _Handler(BaseHTTPRequestHandler):
             m = re.match(pattern, self.path)
             if m:
                 # One simulation, one lock: the engine itself is
-                # single-threaded by contract (Network.java:7-11).
-                with self.server.sim_lock:
+                # single-threaded by contract (Network.java:7-11).  The
+                # external_sink demo is lock-free (see NO_LOCK_PATTERNS).
+                lock = (contextlib.nullcontext()
+                        if pattern in self.NO_LOCK_PATTERNS
+                        else self.server.sim_lock)
+                with lock:
                     try:
                         result = fn(self, m, body)
                     except Exception as e:  # surface as a 400, like Spring
@@ -122,6 +146,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
 
     def log_message(self, *a):  # quiet
         pass

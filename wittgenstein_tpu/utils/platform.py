@@ -1,0 +1,31 @@
+"""Backend platform forcing for tests and driver dry runs.
+
+One shared definition of the init-order-sensitive trick used by
+tests/conftest.py and __graft_entry__.dryrun_multichip: the sandbox's
+sitecustomize imports jax and registers a TPU plugin before user code
+runs, overriding the JAX_PLATFORMS env var — but backends are not
+initialized yet, so `jax.config.update` still wins, and XLA_FLAGS is read
+at first CPU-client init, which also happens later.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu(n_devices: int = 8) -> None:
+    """Force the CPU platform with `n_devices` virtual devices.
+
+    Must run before the first device/backend use (anything that builds an
+    array).  If XLA_FLAGS already carries a device-count flag it is kept
+    as-is (callers should assert len(jax.devices()) afterwards when they
+    need an exact count).
+    """
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
