@@ -17,13 +17,16 @@ class OneShot:
     """Minimal protocol: node 0 sends one unicast to node 1 at t=0; every
     node records the messages it sees."""
 
-    def __init__(self, n=4, latency=None, dest=1, size=7, cfg=None):
+    def __init__(self, n=4, latency=None, dest=1, size=7, cfg=None,
+                 delay=0, all_send=False):
         self.latency = latency or NetworkFixedLatency(10)
         self.cfg = cfg or EngineConfig(n=n, horizon=64, inbox_cap=4,
                                        payload_words=2, out_deg=1,
                                        bcast_slots=2)
         self.dest = dest
         self.size = size
+        self.delay = delay
+        self.all_send = all_send      # every node i -> (i+1) % n at t=0
 
     def init(self, seed):
         nodes = builders.NodeBuilder().build(seed, self.cfg.n)
@@ -34,13 +37,17 @@ class OneShot:
 
     def step(self, pstate, nodes, inbox, t, key):
         out = empty_outbox(self.cfg)
-        sender = jnp.arange(self.cfg.n) == 0
+        ids = jnp.arange(self.cfg.n)
+        sender = jnp.ones_like(ids, bool) if self.all_send else (ids == 0)
+        dest = ((ids + 1) % self.cfg.n if self.all_send
+                else jnp.full_like(ids, self.dest))
         out = out.replace(
-            dest=jnp.where(sender & (t == 0), self.dest, -1)[:, None],
+            dest=jnp.where(sender & (t == 0), dest, -1)[:, None],
             payload=jnp.broadcast_to(
                 jnp.where(sender[:, None, None], 42, 0),
                 (self.cfg.n, 1, self.cfg.payload_words)).astype(jnp.int32),
-            size=jnp.full((self.cfg.n, 1), self.size, jnp.int32))
+            size=jnp.full((self.cfg.n, 1), self.size, jnp.int32),
+            delay=jnp.full((self.cfg.n, 1), self.delay, jnp.int32))
         got = jnp.sum(inbox.valid, 1).astype(jnp.int32)
         pstate = {
             "got": pstate["got"] + got,
@@ -152,6 +159,42 @@ def test_inbox_overflow_counts_drops():
     net, p = run(proto, 5)
     assert int(p["got"][0]) == 4
     assert int(net.dropped) == 4
+
+
+def test_far_future_clamps_without_spill():
+    # delay 500 >> horizon 64, spill_cap 0: the arrival is clamped to the
+    # ring edge and counted (the documented bounded-horizon contract).
+    proto = OneShot(latency=NetworkFixedLatency(10), delay=500)
+    net, p = run(proto, 80)
+    assert int(net.clamped) == 1
+    assert int(p["when"][1]) == 63          # t0 send -> 1 + (horizon-2)
+
+
+def test_spill_delivers_far_future_arrivals_exactly():
+    """With spill_cap > 0, an arrival far past the ring parks in the spill
+    buffer and is delivered EXACTLY on time — the reference's
+    unbounded-horizon semantics (MessageStorage, Network.java:201-299;
+    sendArriveAt :384-390) without sizing the ring for it."""
+    cfg = EngineConfig(n=4, horizon=64, inbox_cap=4, payload_words=2,
+                       out_deg=1, bcast_slots=2, spill_cap=8)
+    proto = OneShot(latency=NetworkFixedLatency(10), cfg=cfg, delay=500)
+    net, p = run(proto, 520)
+    assert int(p["when"][1]) == 511         # send t=1 + delay 500 + lat 10
+    assert int(p["got"][1]) == 1 and int(jnp.sum(p["got"])) == 1
+    assert int(net.clamped) == 0 and int(net.sp_dropped) == 0
+    assert int(net.dropped) == 0
+    assert int(jnp.sum(net.sp_arrival >= 0)) == 0   # slot freed after drain
+
+
+def test_spill_overflow_counts():
+    cfg = EngineConfig(n=4, horizon=64, inbox_cap=4, payload_words=2,
+                       out_deg=1, bcast_slots=2, spill_cap=2)
+    proto = OneShot(latency=NetworkFixedLatency(10), cfg=cfg, delay=500,
+                    all_send=True)
+    net, p = run(proto, 520)
+    assert int(net.sp_dropped) == 2         # 4 far sends, 2 spill slots
+    assert int(jnp.sum(p["got"])) == 2      # survivors still delivered
+    assert int(jnp.sum(net.sp_arrival >= 0)) == 0
 
 
 def test_mailbox_ring_wraps():

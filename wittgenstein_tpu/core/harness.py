@@ -51,7 +51,7 @@ def _freeze_chunk(protocol, chunk, cont):
         newly_stopped = (~stopped) & (~still)
         stopped_at = jnp.where(newly_stopped, nets3.time, stopped_at)
         dropped = (jnp.sum(nets3.dropped) + jnp.sum(nets3.bc_dropped) +
-                   jnp.sum(nets3.clamped))
+                   jnp.sum(nets3.clamped) + jnp.sum(nets3.sp_dropped))
         return nets3, ps3, stopped | ~still, stopped_at, dropped
 
     return chunk_all

@@ -14,10 +14,15 @@ stable sort in the scatter — the tensor analogue of the reference's
 deterministic same-ms linked lists (Network.java:108-115).
 
 Design notes vs the reference:
-  * Arrivals beyond ``t + horizon - 1`` are clamped into the ring (the
-    reference's rolling 60 s storage, Network.java:201-299, supports arbitrary
-    horizons; `msg_discard_time` Network.java:36-40 is the sanctioned way to
-    model bounded delivery windows).
+  * UNICAST arrivals beyond ``t + horizon - 1`` park in the spill buffer
+    when ``cfg.spill_cap > 0`` (delivered exactly on time when the ring
+    reaches them — the reference's rolling 60 s storage,
+    Network.java:201-299, supports arbitrary horizons the same way) or are
+    clamped into the ring and counted when ``spill_cap == 0``
+    (`msg_discard_time` Network.java:36-40 is the sanctioned way to model
+    bounded delivery windows).  Broadcast latencies are recomputed within
+    the ring window and always clamp (counted in `clamped`) — a broadcast
+    tail past the horizon needs a bigger ring, not spill.
   * Per-(node, ms) unicast deliveries beyond `inbox_cap` are counted in
     `NetState.dropped`; size the capacity for the protocol (tests assert 0).
   * Partition membership is evaluated at delivery time for broadcasts (the
@@ -120,56 +125,25 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     return inbox, nodes, n_clamped
 
 
-def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
-    """Route the step's unicast sends into the mailbox ring.
+def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
+                   payload, size, valid):
+    """Scatter a batch of messages into the mailbox ring.
 
-    The reference creates one MessageArrival per destination with a fresh
-    latency draw, sorts them, and links them into per-ms buckets
-    (Network.java:449-487).  Here: one latency draw per message, then a
-    stable sort on (arrival, dest) bins messages into ring slots; rank within
-    a (ms, dest) group + the current fill count gives each message its slot.
+    A stable sort on (arrival, dest) bins messages into ring slots; rank
+    within a (ms, dest) group + the current fill count gives each message
+    its slot.  `dest` must already be clipped to [0, n); arrivals must lie
+    within the ring (rel in [1, horizon-1]).  Returns (net', n_dropped) —
+    entries that found their (ms, dest) cell full.
     """
-    nodes = net.nodes
-    n, k, c = cfg.n, cfg.out_deg, cfg.inbox_cap
-    m = n * k
-    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
-    dest = out.dest.reshape(m)
-    payload = out.payload.reshape(m, cfg.payload_words)
-    size = out.size.reshape(m)
-    delay = out.delay.reshape(m)
-
-    want = (dest >= 0) & (~nodes.down[src])
-    dest_c = jnp.clip(dest, 0, n - 1)
-
-    # Attempted sends bump the sender's counters regardless of whether the
-    # destination is reachable (Network.java:475-477 increments before the
-    # partition/down checks).
-    sent = nodes.msg_sent.at[src].add(want.astype(jnp.int32))
-    sbytes = nodes.bytes_sent.at[src].add(jnp.where(want, size, 0))
-    nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
-
-    seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
-    delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
-    lat = full_latency(model, nodes, src, dest_c, delta)
-    not_discarded = lat < cfg.msg_discard_time
-    # `delay` is sender-chosen scheduling (send-at-future-time).  Arrivals
-    # past the ring are clamped to its edge and counted in `net.clamped`:
-    # a staggered fan-out that outruns the horizon loses its stagger, so
-    # size `horizon` for the protocol (tests/harness assert clamped == 0).
-    raw_total = jnp.clip(delay, 0, None) + jnp.maximum(lat, 1)
-    total = jnp.clip(raw_total, 1, cfg.horizon - 2)
-    valid = want & not_discarded & (~nodes.down[dest_c]) & (
-        nodes.partition[src] == nodes.partition[dest_c])
-    n_clamped = jnp.sum(valid & (raw_total != total)).astype(jnp.int32)
-
-    arrival = t + 1 + total
-    rel = arrival - t                                   # in [2, horizon-1]
+    n, c = cfg.n, cfg.inbox_cap
+    m = src.shape[0]
+    rel = arrival - t
     # Two-pass stable radix sort on (rel, dest): avoids the int32 overflow a
     # fused `rel * n + dest` key would hit for n in the millions, yet still
     # yields one deterministic order with (rel, dest) groups contiguous.
     big = jnp.int32(0x7FFFFFFF)
     rel_k = jnp.where(valid, rel, big)
-    dest_k = jnp.where(valid, dest_c, big)
+    dest_k = jnp.where(valid, dest, big)
     o1 = jnp.argsort(dest_k, stable=True)
     order = o1[jnp.argsort(rel_k[o1], stable=True)]
     rel_s, dest_s = rel_k[order], dest_k[order]
@@ -179,7 +153,7 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     rank = idx - jax.lax.cummax(jnp.where(new_grp, idx, 0))
 
     h_s = (arrival % cfg.horizon)[order]
-    d_s = dest_c[order]
+    d_s = dest[order]
     ok_s = valid[order]
     slot = net.box_count[h_s, d_s] + rank
     ok_s = ok_s & (slot < c)
@@ -203,16 +177,113 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
                                            unique_indices=True)
     box_count = net.box_count.at[h_s, d_s].add(ok_s.astype(jnp.int32),
                                                mode="drop")
-    dropped = net.dropped + jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)
-    return net.replace(nodes=nodes, box_data=box_data, box_src=box_src,
-                       box_size=box_size, box_count=box_count, dropped=dropped,
+    n_dropped = jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)
+    return net.replace(box_data=box_data, box_src=box_src,
+                       box_size=box_size, box_count=box_count), n_dropped
+
+
+def _alloc_free_slots(free, want):
+    """Deterministic free-slot allocation for a fixed table: the i-th
+    requester (in index order) takes the i-th free slot.  Returns
+    ``(slot_w, ok)`` where slot_w == len(free) (an OOB sentinel for
+    mode="drop" scatters) for requesters that found the table full."""
+    cap = free.shape[0]
+    rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    n_free = jnp.sum(free).astype(jnp.int32)
+    slot_order = jnp.argsort(~free, stable=True)        # free slots first
+    ok = want & (rank < n_free)
+    slot = slot_order[jnp.clip(rank, 0, cap - 1)]
+    return jnp.where(ok, slot, cap), ok
+
+
+def _park_in_spill(cfg: EngineConfig, net: NetState, src, dest, arrival,
+                   payload, size, far):
+    """Park far-future sends in the spill buffer (free slot = arrival < 0);
+    overflow is counted in `sp_dropped`."""
+    slot_w, ok = _alloc_free_slots(net.sp_arrival < 0, far)
+    return net.replace(
+        sp_arrival=net.sp_arrival.at[slot_w].set(arrival, mode="drop"),
+        sp_src=net.sp_src.at[slot_w].set(src, mode="drop"),
+        sp_dest=net.sp_dest.at[slot_w].set(dest, mode="drop"),
+        sp_size=net.sp_size.at[slot_w].set(size, mode="drop"),
+        sp_payload=net.sp_payload.at[slot_w].set(payload, mode="drop"),
+        sp_dropped=net.sp_dropped + jnp.sum(far & ~ok).astype(jnp.int32))
+
+
+def _drain_spill(cfg: EngineConfig, net: NetState, t):
+    """Re-inject parked messages whose arrival just came within ring reach
+    (exactly one drain step per entry: when arrival - t == horizon - 2)."""
+    sel = (net.sp_arrival >= 0) & (net.sp_arrival - t == cfg.horizon - 2)
+    net2, n_drop = _bin_into_ring(cfg, net, t, net.sp_src, net.sp_dest,
+                                  jnp.maximum(net.sp_arrival, 0),
+                                  net.sp_payload, net.sp_size, sel)
+    return net2.replace(
+        sp_arrival=jnp.where(sel, -1, net2.sp_arrival),
+        dropped=net2.dropped + n_drop)
+
+
+def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
+    """Route the step's unicast sends into the mailbox ring.
+
+    The reference creates one MessageArrival per destination with a fresh
+    latency draw, sorts them, and links them into per-ms buckets
+    (Network.java:449-487).  Here: one latency draw per message, then the
+    sort-based binning of `_bin_into_ring`.
+    """
+    nodes = net.nodes
+    n, k = cfg.n, cfg.out_deg
+    m = n * k
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    dest = out.dest.reshape(m)
+    payload = out.payload.reshape(m, cfg.payload_words)
+    size = out.size.reshape(m)
+    delay = out.delay.reshape(m)
+
+    want = (dest >= 0) & (~nodes.down[src])
+    dest_c = jnp.clip(dest, 0, n - 1)
+
+    # Attempted sends bump the sender's counters regardless of whether the
+    # destination is reachable (Network.java:475-477 increments before the
+    # partition/down checks).
+    sent = nodes.msg_sent.at[src].add(want.astype(jnp.int32))
+    sbytes = nodes.bytes_sent.at[src].add(jnp.where(want, size, 0))
+    nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
+    net = net.replace(nodes=nodes)
+
+    seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
+    delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
+    lat = full_latency(model, nodes, src, dest_c, delta)
+    not_discarded = lat < cfg.msg_discard_time
+    # `delay` is sender-chosen scheduling (send-at-future-time,
+    # sendArriveAt Network.java:384-390).  Arrivals past the ring either
+    # park in the spill buffer (spill_cap > 0 — delivered exactly on time
+    # when the ring reaches them) or are clamped to the ring edge and
+    # counted in `net.clamped` (tests/harness assert clamped == 0).
+    raw_total = jnp.clip(delay, 0, None) + jnp.maximum(lat, 1)
+    total = jnp.clip(raw_total, 1, cfg.horizon - 2)
+    valid = want & not_discarded & (~nodes.down[dest_c]) & (
+        nodes.partition[src] == nodes.partition[dest_c])
+    far = valid & (raw_total > cfg.horizon - 2)
+    if cfg.spill_cap > 0:
+        net = _park_in_spill(cfg, net, src, dest_c, t + 1 + raw_total,
+                             payload, size, far)
+        ring_valid = valid & ~far
+        n_clamped = jnp.asarray(0, jnp.int32)
+    else:
+        ring_valid = valid
+        n_clamped = jnp.sum(far).astype(jnp.int32)
+
+    arrival = t + 1 + total
+    net, n_dropped = _bin_into_ring(cfg, net, t, src, dest_c, arrival,
+                                    payload, size, ring_valid)
+    return net.replace(dropped=net.dropped + n_dropped,
                        clamped=net.clamped + n_clamped)
 
 
 def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
     """Allocate broadcast-table slots for this step's sendAll requests."""
     nodes = net.nodes
-    n, b = cfg.n, cfg.bcast_slots
+    n = cfg.n
     req = out.bcast & (~nodes.down)
 
     # sendAll counts one attempted send per destination (all N nodes,
@@ -221,13 +292,7 @@ def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
     sbytes = nodes.bytes_sent + jnp.where(req, out.bcast_size * n, 0)
     nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
 
-    rank = jnp.cumsum(req.astype(jnp.int32)) - 1          # rank per requester
-    free = ~net.bc_active
-    n_free = jnp.sum(free).astype(jnp.int32)
-    slot_order = jnp.argsort(~free, stable=True)          # free slots first
-    ok = req & (rank < n_free)
-    slot = slot_order[jnp.clip(rank, 0, b - 1)]
-    slot_w = jnp.where(ok, slot, b)                       # b is OOB -> dropped
+    slot_w, ok = _alloc_free_slots(~net.bc_active, req)
 
     node_idx = jnp.arange(n, dtype=jnp.int32)
     bseed = prng.hash3(prng.hash2(net.seed, prng.TAG_BCAST),
@@ -251,6 +316,8 @@ def step_ms(protocol, net: NetState, pstate):
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
     net = _retire_broadcasts(cfg, net)
+    if cfg.spill_cap > 0:
+        net = _drain_spill(cfg, net, t)
     inbox, nodes, bc_clamped = build_inbox(cfg, model, net, t)
     net = net.replace(nodes=nodes, clamped=net.clamped + bc_clamped)
 

@@ -33,11 +33,19 @@ MAX_DIST = int((((MAX_X / 2.0) ** 2) + ((MAX_Y / 2.0) ** 2)) ** 0.5)
 class EngineConfig:
     """Static engine shape parameters (hashable; safe to close over in jit).
 
-    horizon must exceed the largest deliverable latency + 2: arrivals are
-    clamped to ``t + horizon - 1`` (the reference instead supports arbitrary
-    future arrivals via its rolling 60 s slot list, Network.java:201-299; a
-    fixed ring is the fixed-shape analogue, and `msg_discard_time`
-    (Network.java:36-40) already legitimises dropping very-late messages).
+    horizon must exceed the largest deliverable latency + 2: with
+    ``spill_cap == 0`` arrivals are clamped to ``t + horizon - 1`` and
+    counted in `NetState.clamped` (the reference instead supports arbitrary
+    future arrivals via its rolling 60 s slot list, Network.java:201-299;
+    `msg_discard_time` (Network.java:36-40) already legitimises dropping
+    very-late messages).  With ``spill_cap > 0`` a far-future side buffer
+    restores the reference's unbounded-horizon semantics for UNICASTS:
+    arrivals past the ring are parked in `NetState.sp_*` and re-injected
+    into the ring when it advances within reach — hour-scale timers
+    (sendArriveAt, Network.java:384-390) no longer force a huge ring, only
+    a spill slot per concurrently-parked message.  Broadcasts always clamp
+    (their per-dest arrivals are recomputed inside the ring window); size
+    `horizon` for the broadcast latency tail.
     """
 
     n: int
@@ -47,6 +55,7 @@ class EngineConfig:
     out_deg: int = 1            # K: max unicast sends per node per ms
     bcast_slots: int = 4        # B: max concurrently in-flight broadcasts
     msg_discard_time: int = 1 << 30
+    spill_cap: int = 0          # S: far-future parked messages (0 = clamp)
 
     @property
     def inbox_width(self):
@@ -126,9 +135,17 @@ class NetState:
     bc_payload: jnp.ndarray     # int32 [B, F]
     bc_size: jnp.ndarray        # int32 [B]
     bc_seed: jnp.ndarray        # int32 [B] — per-broadcast latency seed
+    # Far-future spill buffer [S] (see EngineConfig.spill_cap); arrival < 0
+    # marks a free slot:
+    sp_arrival: jnp.ndarray     # int32 [S] — absolute arrival time
+    sp_src: jnp.ndarray         # int32 [S]
+    sp_dest: jnp.ndarray        # int32 [S]
+    sp_size: jnp.ndarray        # int32 [S]
+    sp_payload: jnp.ndarray     # int32 [S, F]
     dropped: jnp.ndarray        # int32 scalar — overflowed unicast deliveries
     bc_dropped: jnp.ndarray     # int32 scalar — broadcasts lost to a full table
     clamped: jnp.ndarray        # int32 scalar — arrivals clamped to the ring edge
+    sp_dropped: jnp.ndarray     # int32 scalar — far-future sends lost to a full spill
 
 
 def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
@@ -158,9 +175,15 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
         bc_payload=jnp.zeros((b, f), jnp.int32),
         bc_size=jnp.zeros((b,), jnp.int32),
         bc_seed=jnp.zeros((b,), jnp.int32),
+        sp_arrival=jnp.full((cfg.spill_cap,), -1, jnp.int32),
+        sp_src=jnp.zeros((cfg.spill_cap,), jnp.int32),
+        sp_dest=jnp.zeros((cfg.spill_cap,), jnp.int32),
+        sp_size=jnp.zeros((cfg.spill_cap,), jnp.int32),
+        sp_payload=jnp.zeros((cfg.spill_cap, f), jnp.int32),
         dropped=jnp.asarray(0, jnp.int32),
         bc_dropped=jnp.asarray(0, jnp.int32),
         clamped=jnp.asarray(0, jnp.int32),
+        sp_dropped=jnp.asarray(0, jnp.int32),
     )
 
 

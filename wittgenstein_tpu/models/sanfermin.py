@@ -99,7 +99,10 @@ class _SanFerminBase:
         self.levels = self.bits + 1          # cpl values 0..bits
         self.cfg = EngineConfig(
             n=node_count, horizon=horizon, inbox_cap=inbox_cap,
-            payload_words=3, out_deg=candidate_count + reply_cap,
+            payload_words=3,
+            # +1: the first pick batch is mirror + candidate_count
+            # (pickNextNodes, SanFerminHelper.java:123-158)
+            out_deg=candidate_count + 1 + reply_cap,
             bcast_slots=1)
 
     def _partner_off(self, ids, cpl):
@@ -107,14 +110,30 @@ class _SanFerminBase:
         return ids & (half - 1)
 
     def _pick_batch(self, ids, cpl, used, count):
-        """Candidate ids for picks used..used+count-1 at level cpl; -1 where
-        the candidate set is exhausted.  Returns (dest [N, count], n_taken)."""
+        """Candidate ids for the next request batch at level cpl; -1 where
+        the candidate set is exhausted.
+
+        Matches SanFerminHelper.pickNextNodes (:123-158): the FIRST call
+        returns the exact mirror candidate PLUS up to `count` further
+        candidates (the reference adds the mirror, then unconditionally
+        appends up to `howMany` more — so the initial fan-out is count+1,
+        which is what seeds the reference's non-mirror swaps and level
+        desynchronization); subsequent calls return the next `count`
+        unused candidates in index order.  The reference's bit-set filter
+        over the idx-shifted list is approximated by plain sequential
+        order, and its within-batch `Collections.shuffle` is unobservable
+        here (all requests leave in the same tick with i.i.d. latencies).
+        Returns (dest [N, count+1], n_taken)."""
         half = _half(self.bits, cpl)                        # [N]
         base = _cand_base(ids, half)
         partner = self._partner_off(ids, cpl)
-        j = used[:, None] + jnp.arange(count, dtype=jnp.int32)[None, :]
+        first = used == 0
+        width = count + 1
+        j = jnp.where(first, 0, used)[:, None] + \
+            jnp.arange(width, dtype=jnp.int32)[None, :]
         off = _pick_offset(j, partner[:, None])
-        ok = j < half[:, None]
+        ok = (j < half[:, None]) & \
+            (first[:, None] | (jnp.arange(width)[None, :] < count))
         dest = jnp.where(ok, base[:, None] + off, -1)
         return dest, jnp.sum(ok, axis=1).astype(jnp.int32)
 
@@ -325,16 +344,17 @@ class SanFermin(_SanFerminBase):
         K, F = self.cfg.out_deg, self.cfg.payload_words
         dest = jnp.full((n, K), -1, jnp.int32)
         payload = jnp.zeros((n, K, F), jnp.int32)
-        dest = dest.at[:, :cc].set(dest_req)
-        payload = payload.at[:, :cc, 0].set(REQ)
-        payload = payload.at[:, :cc, 1].set(p.cpl[:, None])
-        payload = payload.at[:, :cc, 2].set(p.agg[:, None])
+        w = cc + 1                         # mirror + cc on first batch
+        dest = dest.at[:, :w].set(dest_req)
+        payload = payload.at[:, :w, 0].set(REQ)
+        payload = payload.at[:, :w, 1].set(p.cpl[:, None])
+        payload = payload.at[:, :w, 2].set(p.agg[:, None])
         rd, rk, rl, rv = bufs
         live_r = jnp.arange(rc)[None, :] < r_cnt[:, None]
-        dest = dest.at[:, cc:cc + rc].set(jnp.where(live_r, rd, -1))
-        payload = payload.at[:, cc:cc + rc, 0].set(rk)
-        payload = payload.at[:, cc:cc + rc, 1].set(rl)
-        payload = payload.at[:, cc:cc + rc, 2].set(rv)
+        dest = dest.at[:, w:w + rc].set(jnp.where(live_r, rd, -1))
+        payload = payload.at[:, w:w + rc, 0].set(rk)
+        payload = payload.at[:, w:w + rc, 1].set(rl)
+        payload = payload.at[:, w:w + rc, 2].set(rv)
         sizes = jnp.full((n, K), self.signature_size + 1, jnp.int32)
 
         out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
@@ -537,16 +557,17 @@ class SanFerminCappos(_SanFerminBase):
         K, F = self.cfg.out_deg, self.cfg.payload_words
         dest = jnp.full((n, K), -1, jnp.int32)
         payload = jnp.zeros((n, K, F), jnp.int32)
-        dest = dest.at[:, :cc].set(dest_req)
-        payload = payload.at[:, :cc, 0].set(SWAP_ASK)
-        payload = payload.at[:, :cc, 1].set(p.cpl[:, None])
-        payload = payload.at[:, :cc, 2].set(req_val[:, None])
+        w = cc + 1                         # mirror + cc on first batch
+        dest = dest.at[:, :w].set(dest_req)
+        payload = payload.at[:, :w, 0].set(SWAP_ASK)
+        payload = payload.at[:, :w, 1].set(p.cpl[:, None])
+        payload = payload.at[:, :w, 2].set(req_val[:, None])
         rd, rl, rv = bufs
         live_r = jnp.arange(rc)[None, :] < r_cnt[:, None]
-        dest = dest.at[:, cc:cc + rc].set(jnp.where(live_r, rd, -1))
-        payload = payload.at[:, cc:cc + rc, 0].set(SWAP_INFO)
-        payload = payload.at[:, cc:cc + rc, 1].set(rl)
-        payload = payload.at[:, cc:cc + rc, 2].set(rv)
+        dest = dest.at[:, w:w + rc].set(jnp.where(live_r, rd, -1))
+        payload = payload.at[:, w:w + rc, 0].set(SWAP_INFO)
+        payload = payload.at[:, w:w + rc, 1].set(rl)
+        payload = payload.at[:, w:w + rc, 2].set(rv)
         sizes = jnp.full((n, K), self.signature_size + 1, jnp.int32)
 
         out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,

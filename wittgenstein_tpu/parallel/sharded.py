@@ -72,6 +72,14 @@ class ShardedRunner:
     def __init__(self, protocol, mesh: Mesh, xcap: int = None):
         if "sp" not in mesh.axis_names:
             raise ValueError("mesh must have an 'sp' axis")
+        if protocol.cfg.spill_cap:
+            # The sharded delivery path clamps far-future arrivals to the
+            # ring edge (like spill_cap == 0); honoring the spill contract
+            # here needs a sharded spill buffer — refuse rather than
+            # silently diverge from the single-chip engine.
+            raise NotImplementedError(
+                "ShardedRunner does not support EngineConfig.spill_cap > 0;"
+                " size `horizon` for the protocol instead")
         self.protocol = protocol
         self.mesh = mesh
         self.n_shards = mesh.shape["sp"]
@@ -304,8 +312,13 @@ class ShardedRunner:
                 ~net.nodes.down[dl] & \
                 (part_all[jnp.maximum(r_src, 0)] ==
                  net.nodes.partition[dl])
-            total = jnp.clip(jnp.clip(r_delay, 0, None) +
-                             jnp.maximum(lat, 1), 1, cfg.horizon - 2)
+            raw_total = jnp.clip(r_delay, 0, None) + jnp.maximum(lat, 1)
+            total = jnp.clip(raw_total, 1, cfg.horizon - 2)
+            # Arrivals past the ring clamp (counted, like the single-chip
+            # engine with spill_cap == 0; spill is unsupported here — see
+            # __init__).
+            n_clamped = jnp.sum(ok & (raw_total != total)).astype(jnp.int32)
+            net = net.replace(clamped=net.clamped + n_clamped)
             arrival = t + 1 + total
             mx = S * xcap
             big = jnp.int32(0x7FFFFFFF)

@@ -1,0 +1,87 @@
+"""Stored vs hashed emission-order drift quantification (VERDICT r1 #4).
+
+The stored emission mode reproduces the reference's per-(sender, level)
+emission lists sorted by the rank each RECEIVER assigns to the sender
+(Handel.java:991-1013) — a convergence optimization: early receivers
+verify the sender's aggregate sooner because they score it higher.  The
+hashed mode (the >32k-node path — no O(N^2) emission state) replaces the
+list with a keyed level permutation: plain randomized round-robin, losing
+that correlation.
+
+This tool measures the cost: same config, both modes, a batch of seeds
+each; reports the doneAt distribution over live nodes (mean / p50 / p90 /
+p99 / max, completion fraction) and the relative drift.  Run:
+
+    python -m wittgenstein_tpu.scenarios.emission_drift [out_dir] \
+        [nodes] [seeds]
+
+Results land in `<out_dir>/emission_drift_<nodes>n.csv` and are printed
+as one JSON line per mode.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..core.harness import run_multiple_times
+from ..models.handel import Handel, cont_if_handel
+from ..tools.csvf import CSVFormatter
+from .handel_scenarios import default_params
+
+
+def run_mode(mode, nodes=2048, seeds=32, max_time=6000, chunk=250,
+             first_seed=0):
+    params = default_params(nodes=nodes)
+    params["emission_mode"] = mode
+    proto = Handel(**params)
+    t0 = time.perf_counter()
+    res = run_multiple_times(proto, run_count=seeds, max_time=max_time,
+                             chunk=chunk, cont_if=cont_if_handel,
+                             first_seed=first_seed)
+    wall = time.perf_counter() - t0
+    done_at = np.asarray(res.nets.nodes.done_at)
+    down = np.asarray(res.nets.nodes.down)
+    live_done = np.concatenate([done_at[i][~down[i]]
+                                for i in range(seeds)])
+    finished = live_done[live_done > 0]
+    frac = finished.size / live_done.size
+    q = (lambda p: float(np.percentile(finished, p)) if finished.size
+         else float("nan"))
+    return {
+        "mode": mode, "nodes": nodes, "seeds": seeds,
+        "frac_done": round(frac, 4),
+        "mean_ms": round(float(finished.mean()), 1),
+        "p50_ms": round(q(50), 1), "p90_ms": round(q(90), 1),
+        "p99_ms": round(q(99), 1), "max_ms": float(finished.max()),
+        "evicted": int(np.asarray(res.pstates.evicted).sum()),
+        "wall_s": round(wall, 1),
+    }
+
+
+def compare(nodes=2048, seeds=32, max_time=6000, out_dir="."):
+    csv = CSVFormatter(["mode", "nodes", "seeds", "frac_done", "mean_ms",
+                        "p50_ms", "p90_ms", "p99_ms", "max_ms", "evicted",
+                        "wall_s"])
+    rows = {}
+    for mode in ("stored", "hashed"):
+        r = run_mode(mode, nodes=nodes, seeds=seeds, max_time=max_time)
+        rows[mode] = r
+        csv.add(**r)
+        print(json.dumps(r))
+    drift_mean = rows["hashed"]["mean_ms"] / rows["stored"]["mean_ms"] - 1
+    drift_p90 = rows["hashed"]["p90_ms"] / rows["stored"]["p90_ms"] - 1
+    print(json.dumps({"drift_mean_pct": round(100 * drift_mean, 2),
+                      "drift_p90_pct": round(100 * drift_p90, 2)}))
+    csv.save(f"{out_dir}/emission_drift_{nodes}n.csv")
+    return rows
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    seeds = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    compare(nodes=nodes, seeds=seeds, out_dir=out)

@@ -220,6 +220,16 @@ class Server:
                         "payload": [int(data[fi, h, d, s])
                                     for fi in range(f)]})
 
+        sp_arr = np.asarray(self.net.sp_arrival)
+        if sp_arr.size:
+            sp_src = np.asarray(self.net.sp_src)
+            sp_dest = np.asarray(self.net.sp_dest)
+            sp_pay = np.asarray(self.net.sp_payload)
+            for s in np.nonzero(sp_arr >= 0)[0]:
+                out.append({"from": int(sp_src[s]), "to": int(sp_dest[s]),
+                            "sentAt": -1, "arrivingAt": int(sp_arr[s]),
+                            "payload": [int(x) for x in sp_pay[s]]})
+
         if bool(np.asarray(self.net.bc_active).any()):
             # External nodes are stopped in-engine but their deliveries DO
             # reach the bridge (run_ms lifts the down flag, like
