@@ -2,6 +2,8 @@
 analogues — colors converge), Paxos (PaxosTest — every proposer accepts the
 same value), plus determinism checks (the testCopy recipe, SURVEY.md §4.2)."""
 
+import pytest
+
 import numpy as np
 
 from wittgenstein_tpu.core.network import Runner
@@ -63,6 +65,7 @@ def test_paxos_agreement():
     assert int(net.dropped) == 0
 
 
+@pytest.mark.slow
 def test_paxos_more_nodes_and_determinism():
     proto = Paxos(acceptor_count=5, proposer_count=4, timeout=800)
     outs = []

@@ -214,6 +214,48 @@ def test_mailbox_ring_wraps():
     assert int(p["got"][1]) == 4  # sends at t=0,50,100,150
 
 
+@pytest.mark.slow
+def test_long_run_ring_integrity():
+    """NetworkTest.java:425-435 analog (100M-ms run, scaled to 1M): a
+    periodic sender over a horizon-64 ring that wraps ~15 625 times must
+    deliver every message exactly once with exact counters and no
+    residue."""
+
+    class Tick:
+        def __init__(self):
+            self.latency = NetworkFixedLatency(5)
+            self.cfg = EngineConfig(n=4, horizon=64, inbox_cap=4,
+                                    payload_words=2, out_deg=1,
+                                    bcast_slots=2)
+
+        def init(self, seed):
+            nodes = builders.NodeBuilder().build(seed, self.cfg.n)
+            return (init_net(self.cfg, nodes, seed),
+                    {"got": jnp.zeros(self.cfg.n, jnp.int32)})
+
+        def step(self, pstate, nodes, inbox, t, key):
+            out = empty_outbox(self.cfg)
+            sender = (jnp.arange(self.cfg.n) == 0) & (t % 100 == 0)
+            out = out.replace(
+                dest=jnp.where(sender, 1, -1)[:, None])
+            got = jnp.sum(inbox.valid, 1).astype(jnp.int32)
+            return {"got": pstate["got"] + got}, nodes, out
+
+    proto = Tick()
+    net, p = proto.init(0)
+    runner = Runner(proto, donate=False)
+    for _ in range(100):
+        net, p = runner.run_ms(net, p, 10_000)
+    # sends at t = 0, 100, ..., 999900 all arrive at t+6 < 1M.
+    assert int(net.time) == 1_000_000
+    assert int(p["got"][1]) == 10_000
+    assert int(jnp.sum(p["got"])) == 10_000
+    assert int(net.nodes.msg_sent[0]) == 10_000
+    assert int(net.nodes.msg_received[1]) == 10_000
+    assert int(net.dropped) == 0 and int(net.clamped) == 0
+    assert int(jnp.sum(net.box_count)) == 0       # no residue in the ring
+
+
 def test_determinism_under_jit_copy():
     # The copy()+init() reproducibility contract (HandelTest.java:14-34):
     # re-initialising from the same seed reproduces runs exactly.

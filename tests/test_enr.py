@@ -1,6 +1,8 @@
 """ENRGossiping tests — cap distribution, rewiring toward done, churn,
 determinism (ENRGossipingTest.java analogue)."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,6 +57,7 @@ def test_run_rewires_and_finishes():
     assert int(net.dropped) == 0
 
 
+@pytest.mark.slow
 def test_churn_membership():
     p = make(time_to_leave=4_000)   # joins every 500 ms, quick exits
     r = Runner(p, donate=False)
@@ -67,6 +70,7 @@ def test_churn_membership():
     assert len(set(seen_alive)) > 1, seen_alive
 
 
+@pytest.mark.slow
 def test_determinism():
     p = make()
     r = Runner(p, donate=False)

@@ -1,6 +1,8 @@
 """ETHPoW tests — the analogue of ethpow/EthPoWTest.java: mining rate,
 difficulty, consensus, uncles/rewards, selfish strategies, determinism."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -17,6 +19,7 @@ def run(p, ticks, seed=0):
     return net, ps
 
 
+@pytest.mark.slow
 def test_honest_mining_rate_and_consensus():
     p = ETHPoW(number_of_miners=10,
                network_latency_name="NetworkFixedLatency(1000)")
@@ -47,6 +50,7 @@ def test_difficulty_tracks_constantinople():
     assert np.all(diffs < GENESIS_DIFF_S * 2)
 
 
+@pytest.mark.slow
 def test_rewards_and_uncles():
     p = ETHPoW(number_of_miners=10,
                network_latency_name="NetworkFixedLatency(2000)")
@@ -61,6 +65,7 @@ def test_rewards_and_uncles():
     assert 0.0 <= uncle_rate(ps, head) < 0.5
 
 
+@pytest.mark.slow
 def test_selfish_miner_runs_and_determinism():
     p = ETHPoW(number_of_miners=8, byz_class_name="ETHSelfishMiner",
                byz_mining_ratio=0.35,
@@ -74,6 +79,7 @@ def test_selfish_miner_runs_and_determinism():
     assert int(ps2.arena.n) == int(ps.arena.n)
 
 
+@pytest.mark.slow
 def test_selfish2_runs():
     p = ETHPoW(number_of_miners=8, byz_class_name="ETHSelfishMiner2",
                byz_mining_ratio=0.4,
@@ -84,6 +90,7 @@ def test_selfish2_runs():
     assert np.asarray(ps.arena.height)[heads].max() > GENESIS_HEIGHT
 
 
+@pytest.mark.slow
 def test_arena_walks():
     p = ETHPoW(number_of_miners=4,
                network_latency_name="NetworkFixedLatency(100)")
@@ -98,6 +105,7 @@ def test_arena_walks():
     assert int(ca[0]) == 0
 
 
+@pytest.mark.slow
 def test_try_miner_harness():
     """tryMiner parity (ETHMiner.java:234-308) at smoke scale: the vmapped
     strategy-evaluation harness produces sane revenue/uncle numbers."""
@@ -111,6 +119,7 @@ def test_try_miner_harness():
     assert r["avg_difficulty"] > 1e14          # near genesis difficulty
 
 
+@pytest.mark.slow
 def test_miner_agent_env():
     """ETHMinerAgent parity (ethpow/ETHMinerAgent.java): the RL env mines
     privately, the host decides when to publish, observables line up."""
@@ -148,6 +157,7 @@ def test_miner_agent_env():
             assert all(ln.startswith(f"{first_height},") for ln in lines)
 
 
+@pytest.mark.slow
 def test_agent_determinism():
     """Same seed => identical agent trajectory (testCopy analogue)."""
     from wittgenstein_tpu.models.ethpow import MinerAgentEnv

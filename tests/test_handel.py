@@ -2,6 +2,8 @@
 structural invariants after init, run-to-completion, per-seed determinism
 (the testCopy analogue), plus unit tests of the level/bitset math."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -95,6 +97,7 @@ def test_desynchronized_start():
     assert (np.asarray(net.nodes.done_at) > 0).all()
 
 
+@pytest.mark.slow
 def test_scale_mode_hashed_emission_poolfree():
     """The large-N configuration (hashed emission order, no snapshot pool,
     prefix-sum level popcounts) must still aggregate and stay
@@ -135,6 +138,7 @@ def test_level_pc_prefix_matches_einsum():
     assert np.array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_byzantine_suicide():
     """byzantineSuicide (Handel.java:538-559): byzantine nodes plant invalid
     sigs that honest nodes burn pairing slots on, then blacklist.  The run
@@ -163,6 +167,7 @@ def test_byzantine_suicide():
     assert np.array_equal(outs[0], outs[1])
 
 
+@pytest.mark.slow
 def test_hidden_byzantine():
     """HiddenByzantine (Handel.java:840-917): useless 1-bit sigs steal
     verification slots; completion still happens, determinism kept."""

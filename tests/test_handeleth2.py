@@ -1,6 +1,8 @@
 """HandelEth2 tests — the analogue of handeleth2/HandelEth2Test.java:
 concurrent aggregations, full contributions, determinism."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -9,6 +11,7 @@ from wittgenstein_tpu.models.handeleth2 import (
     PERIOD_TIME, R, HandelEth2)
 
 
+@pytest.mark.slow
 def test_continuous_aggregation():
     p = HandelEth2(node_count=64, pairing_time=3, level_wait_time=100,
                    period_duration_ms=50,
@@ -25,6 +28,7 @@ def test_continuous_aggregation():
     assert int(net.dropped) == 0
 
 
+@pytest.mark.slow
 def test_multi_hash_values():
     p = HandelEth2(node_count=64, period_duration_ms=50,
                    network_latency_name="NetworkNoLatency")
@@ -41,6 +45,7 @@ def test_multi_hash_values():
     assert np.all(card == 64)
 
 
+@pytest.mark.slow
 def test_nodes_down_and_determinism():
     p = HandelEth2(node_count=64, nodes_down=6,
                    network_latency_name="NetworkLatencyByDistanceWJitter")

@@ -2,6 +2,7 @@
 ProgressPerTime in protocol tests (RunMultipleTimes.java, ProgressPerTime.java)."""
 
 import jax.numpy as jnp
+import pytest
 
 from wittgenstein_tpu.core import harness
 from wittgenstein_tpu.core.latency import (NetworkFixedLatency, get_by_name,
@@ -95,11 +96,32 @@ def test_seed_axis_sharded_over_devices_matches_single_device():
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
+def test_mesh_2d_seed_by_node_sweep_matches_single_device():
+    """SURVEY §2.6 multi-slice topology on the virtual mesh: seeds over
+    'dp' x node axis over 'sp' must be bit-equal to the plain vmap."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    proto = PingPong(node_count=64)
+    multi = harness.run_multiple_times(
+        proto, 4, max_time=800, stats_getters=(stats.done_at_stats,),
+        mesh=mesh)
+    single = harness.run_multiple_times(
+        proto, 4, max_time=800, stats_getters=(stats.done_at_stats,),
+        devices=jax.devices()[:1])
+    assert len(multi.nets.nodes.done_at.sharding.device_set) == 8
+    assert [int(x) for x in multi.stopped_at] == \
+        [int(x) for x in single.stopped_at]
+    for a, b in zip(jax.tree.leaves(multi.nets), jax.tree.leaves(single.nets)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_max_time_zero_wall_clock_guard():
     """VERDICT r1 weak #6: max_time=0 with a never-true stop predicate must
     hit the wall-clock bound instead of looping forever."""
-    import pytest
-
     proto = PingPong(node_count=16)
     with pytest.raises(RuntimeError, match="wall-clock bound"):
         harness.run_multiple_times(

@@ -1,5 +1,7 @@
 """OptimisticP2PSignature + P2PHandel tests."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -58,6 +60,7 @@ def test_compressed_size():
     assert cs("1" * 16, n_sign=16) == 1
 
 
+@pytest.mark.slow
 def test_p2phandel_run():
     p = P2PHandel(signing_node_count=100, relaying_node_count=20,
                   threshold=99, connection_count=10, pairing_time=10,

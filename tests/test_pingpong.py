@@ -10,12 +10,15 @@ torus put ~pi*r^2/(2000*1112) of the nodes inside r.  We assert that curve:
 ~20-30% by 100 ms, a steady ramp, and full convergence by 800 ms (max
 distance 1144 px => max RTT ~ 450 ms incl. jitter tails)."""
 
+import pytest
+
 import jax.numpy as jnp
 
 from wittgenstein_tpu.core.network import Runner
 from wittgenstein_tpu.models.pingpong import PingPong
 
 
+@pytest.mark.slow
 def test_pingpong_convergence_curve():
     proto = PingPong(node_count=1000)
     net, p = proto.init(0)

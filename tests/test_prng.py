@@ -1,0 +1,49 @@
+"""Counter-based PRNG primitives — determinism and the keyed-permutation
+pair (bij_perm / bij_perm_inv) that replaces the reference's stored
+random-rank matrices (Handel.java:940-948; SURVEY.md §7.4.6)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.ops import prng
+
+
+def test_bij_perm_is_a_permutation():
+    for bits in (1, 2, 3, 5, 8, 12):
+        n = 1 << bits
+        xs = jnp.arange(n, dtype=jnp.int32)
+        for key in (0, 1, 77, -5):
+            ys = np.asarray(prng.bij_perm(jnp.int32(key), xs, bits))
+            assert sorted(ys) == list(range(n)), (bits, key)
+
+
+def test_bij_perm_inv_round_trips():
+    for bits in (1, 2, 3, 4, 7, 11, 16, 20, 31):
+        n = min(1 << bits, 4096)
+        xs = jnp.arange(n, dtype=jnp.int32)
+        for key in (0, 3, 12345, -1):
+            k = jnp.int32(key)
+            fwd = prng.bij_perm(k, xs, bits)
+            back = np.asarray(prng.bij_perm_inv(k, fwd, bits))
+            assert np.array_equal(back, np.asarray(xs)), (bits, key)
+            # and the other direction
+            inv = prng.bij_perm_inv(k, xs, bits)
+            fwd2 = np.asarray(prng.bij_perm(k, inv, bits))
+            assert np.array_equal(fwd2, np.asarray(xs)), (bits, key)
+
+
+def test_bij_perm_dyn_matches_static_and_inverts():
+    bits = jnp.asarray([3, 5, 8, 8, 12], jnp.int32)
+    xs = jnp.asarray([5, 21, 200, 7, 4000], jnp.int32)
+    key = jnp.int32(99)
+    fwd = prng.bij_perm_dyn(key, xs, bits)
+    for i, b in enumerate([3, 5, 8, 8, 12]):
+        assert int(fwd[i]) == int(prng.bij_perm(key, xs[i], b))
+    back = prng.bij_perm_inv_dyn(key, fwd, bits)
+    assert np.array_equal(np.asarray(back), np.asarray(xs))
+
+
+def test_uniform_float_half_open():
+    u = np.asarray(prng.uniform_float(jnp.int32(7),
+                                      jnp.arange(10000, dtype=jnp.int32)))
+    assert (u >= 0).all() and (u < 1.0).all()

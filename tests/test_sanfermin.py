@@ -1,6 +1,8 @@
 """San Fermín tests — geometry unit tests (SanFerminHelper analogue) +
 run-to-done + determinism for both variants."""
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,6 +37,7 @@ def test_pick_order():
     assert picks == [2, 0, 1, 3]
 
 
+@pytest.mark.slow
 def test_sanfermin_run_and_determinism():
     p = SanFermin(node_count=128, threshold=128, pairing_time=2,
                   reply_timeout=300, candidate_count=1,
@@ -62,6 +65,7 @@ def test_sanfermin_run_and_determinism():
     assert np.array_equal(np.asarray(net2.nodes.done_at), done_at)
 
 
+@pytest.mark.slow
 def test_cappos_run():
     p = SanFerminCappos(node_count=64, threshold=48, pairing_time=2,
                         timeout=150, candidate_count=4,

@@ -93,7 +93,9 @@ def test_gsf_scenarios_smoke(tmp_path):
 
 @pytest.mark.slow
 def test_p2phandel_strategy_sweep_smoke(tmp_path):
+    # signers+relays must exceed the default connection target (40,
+    # P2PHandel.java parity) — 64+8 is the module's own smoke config.
     csv = p2phandel_scenarios.strategy_sweep(
-        signers=32, relays=4, seeds=2, out_dir=str(tmp_path),
+        signers=64, relays=8, seeds=2, out_dir=str(tmp_path),
         strategies=(p2phandel_scenarios.ALL,))
     assert csv.rows

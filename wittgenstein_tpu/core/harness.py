@@ -83,34 +83,72 @@ def _shard_seed_axis(trees, devices):
     return tuple(jax.tree.map(put, t) for t in trees)
 
 
+def _shard_seed_and_node_axes(trees, mesh, n):
+    """2-D sweep layout: the leading (seed) axis over the mesh's 'dp' axis
+    and the node axis (any later axis of size `n`, last match wins; flat
+    mailbox axes divisible by n*sp are sharded across their flat index
+    space) over 'sp'.  This is the multi-slice topology of SURVEY §2.6 —
+    on real hardware 'dp' is the DCN/inter-slice axis (runs never
+    communicate) and 'sp' the ICI axis (node-state collectives stay
+    in-slice); on one host it validates on a virtual mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sp = mesh.shape["sp"]
+
+    def put(x):
+        matches = [i for i in range(1, x.ndim) if x.shape[i] == n]
+        spec = [None] * x.ndim
+        spec[0] = "dp"
+        if matches:
+            spec[matches[-1]] = "sp"
+        elif x.ndim == 2 and x.shape[1] >= n and x.shape[1] % (n * sp) == 0:
+            spec[1] = "sp"
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return tuple(jax.tree.map(put, t) for t in trees)
+
+
 class _BatchDriver:
     """Shared multi-seed scaffolding for `run_multiple_times` and
     `progress_per_time`: vmapped init over seeds, frozen-run chunk advance,
     and the drop/clamp guard."""
 
     def __init__(self, protocol, run_count, chunk, cont_if, first_seed,
-                 fail_on_drop, where, devices=None):
+                 fail_on_drop, where, devices=None, mesh=None):
         self.cont = cont_if or cont_until_done
         self.seeds = jnp.arange(first_seed, first_seed + run_count,
                                 dtype=jnp.int32)
         self.nets, self.ps = jax.vmap(protocol.init)(self.seeds)
         self.stopped = jnp.zeros((run_count,), bool)
         self.stopped_at = jnp.zeros((run_count,), jnp.int32)
-        explicit = devices is not None
-        if devices is None:                      # auto: all, when they divide
-            devices = jax.devices()
-            if run_count % len(devices) != 0:
-                devices = devices[:1]
-        if run_count % len(devices) != 0:
-            raise ValueError(f"run_count={run_count} not divisible by "
-                             f"{len(devices)} devices")
-        # Place even for an explicit single device (it may not be the
-        # default one); skip only the redundant auto single-device put.
-        if len(devices) > 1 or explicit:
+        trees = (self.nets, self.ps, self.stopped, self.stopped_at,
+                 self.seeds)
+        if mesh is not None:
+            if devices is not None:
+                raise ValueError("pass either devices or mesh, not both")
+            if run_count % mesh.shape["dp"] != 0:
+                raise ValueError(f"run_count={run_count} not divisible by "
+                                 f"the mesh 'dp' axis ({mesh.shape['dp']})")
+            if protocol.cfg.n % mesh.shape["sp"] != 0:
+                raise ValueError(f"node count {protocol.cfg.n} not "
+                                 f"divisible by 'sp' ({mesh.shape['sp']})")
+            trees = _shard_seed_and_node_axes(trees, mesh, protocol.cfg.n)
             (self.nets, self.ps, self.stopped, self.stopped_at,
-             self.seeds) = _shard_seed_axis(
+             self.seeds) = trees
+        else:
+            explicit = devices is not None
+            if devices is None:                  # auto: all, when they divide
+                devices = jax.devices()
+                if run_count % len(devices) != 0:
+                    devices = devices[:1]
+            if run_count % len(devices) != 0:
+                raise ValueError(f"run_count={run_count} not divisible by "
+                                 f"{len(devices)} devices")
+            # Place even for an explicit single device (it may not be the
+            # default one); skip only the redundant auto single-device put.
+            if len(devices) > 1 or explicit:
                 (self.nets, self.ps, self.stopped, self.stopped_at,
-                 self.seeds), devices)
+                 self.seeds) = _shard_seed_axis(trees, devices)
         self._chunk_all = _freeze_chunk(protocol, chunk, self.cont)
         self._fail_on_drop = fail_on_drop
         self._where = where
@@ -137,7 +175,7 @@ class MultiRunResult:
 def run_multiple_times(protocol, run_count, max_time=0, chunk=10,
                        cont_if=None, stats_getters=(), final_check=None,
                        first_seed=0, fail_on_drop=True, devices=None,
-                       max_wall_s=None):
+                       max_wall_s=None, mesh=None):
     """Vectorized RunMultipleTimes.run (RunMultipleTimes.java:41-87).
 
     Seeds are first_seed..first_seed+run_count-1 (the reference uses the
@@ -148,11 +186,15 @@ def run_multiple_times(protocol, run_count, max_time=0, chunk=10,
     against a protocol that cannot converge.  `devices` shards the seed
     axis across a device mesh (default: all local devices when run_count
     divides evenly; pass `devices=jax.devices()[:1]` to force one).
+    `mesh` (a Mesh with axes 'dp' and 'sp', mutually exclusive with
+    `devices`) lays seeds over 'dp' AND the node axis over 'sp' — the
+    multi-slice topology where 'dp' rides DCN and node-state collectives
+    stay on in-slice ICI (SURVEY §2.6).
     Returns averaged stats across runs plus per-run values.
     """
     drv = _BatchDriver(protocol, run_count, chunk, cont_if, first_seed,
                        fail_on_drop, f"run_multiple_times({protocol})",
-                       devices=devices)
+                       devices=devices, mesh=mesh)
     steps = 10**9 if max_time == 0 else -(-max_time // chunk)
     if max_time == 0 and max_wall_s is None:
         max_wall_s = 1800.0

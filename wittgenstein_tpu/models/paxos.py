@@ -15,6 +15,12 @@ inbox slots are processed SEQUENTIALLY (an unrolled loop over the slot
 axis), reproducing the reference's per-message ordering exactly.  All node
 state is [N] vectors; acceptors are ids [0, A), proposers [A, A+P).
 -1 encodes the reference's `null` for accepted seq/value.
+
+COMPILE-TIME GUARD: trace length scales with inbox_cap x the per-slot
+handler chain, so XLA compile time grows with inbox_cap.  Fine at the
+reference's scale (inbox_cap ~ N ~ 10); do NOT reuse this unrolled-slot
+pattern for protocols with hundreds of inbox slots — use the vectorized
+reduce/scatter recipe (e.g. models/dfinity.py's receive path) instead.
 """
 
 from __future__ import annotations

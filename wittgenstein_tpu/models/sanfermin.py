@@ -129,8 +129,7 @@ class _SanFerminBase:
         partner = self._partner_off(ids, cpl)
         first = used == 0
         width = count + 1
-        j = jnp.where(first, 0, used)[:, None] + \
-            jnp.arange(width, dtype=jnp.int32)[None, :]
+        j = used[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
         off = _pick_offset(j, partner[:, None])
         ok = (j < half[:, None]) & \
             (first[:, None] | (jnp.arange(width)[None, :] < count))

@@ -99,6 +99,58 @@ def bij_perm(key, x, bits: int):
     return bij_perm_dyn(key, x, bits)
 
 
+def _uinv_odd(m):
+    """Modular inverse of odd uint32 m modulo 2^32 (Newton-Hensel: each
+    step doubles the number of correct low bits; 5 steps from the 5-bit
+    seed m covers all 32)."""
+    inv = m
+    for _ in range(5):
+        inv = inv * (_U32(2) - m * inv)
+    return inv
+
+
+def bij_perm_inv(key, y, bits: int):
+    """Inverse of `bij_perm`: the position of value y in key's permutation.
+
+    Lets a sender ENUMERATE a keyed permutation in rank order without a
+    sort: receiver-at-rank-p = bij_perm_inv-composed constructions (the
+    rank-aware hashed emission order in models/handel.py).  Every forward
+    step is inverted exactly: xor is self-inverse, odd multiplies by the
+    Hensel inverse (valid mod 2^bits because it holds mod 2^32), and
+    x ^= x >> s unwinds in <= 3 iterations since both shifts are >= bits/2.
+    """
+    assert 1 <= bits <= 31
+    return bij_perm_inv_dyn(key, y, bits)
+
+
+def bij_perm_inv_dyn(key, y, bits):
+    """`bij_perm_inv` with a traced per-element bit count (matches
+    `bij_perm_dyn`)."""
+    bits = jnp.asarray(bits, jnp.int32)
+    mask = ((_U32(1) << jnp.clip(bits, 0, 31).astype(_U32)) - _U32(1))
+    y = jnp.asarray(y).astype(_U32) & mask
+    key = jnp.asarray(key).astype(_U32)
+    s1 = jnp.maximum(1, (bits + 1) // 2).astype(_U32)
+    s2 = jnp.maximum(1, (2 * bits) // 3).astype(_U32)
+
+    def unshift(x, s):
+        # invert x ^= x >> s; s >= ceil(bits/3) here, so 3 rounds suffice
+        r = x
+        for _ in range(3):
+            r = x ^ (r >> s)
+        return r & mask
+
+    minv2 = _uinv_odd(_U32(0x6A09E667 | 1))
+    for c in (0xC2B2AE35, 0x85EBCA6B, 0x9E3779B9):     # reverse order
+        k = mix32(key ^ _U32(c))
+        y = unshift(y, s2)
+        y = (y * minv2) & mask
+        y = unshift(y, s1)
+        y = (y * _uinv_odd(k | _U32(1))) & mask
+        y = (y ^ (k & mask)) & mask
+    return (y & mask).astype(jnp.int32)
+
+
 def bij_perm_dyn(key, x, bits):
     """`bij_perm` with a *traced* per-element bit count: each element is
     permuted within its own [0, 2^bits) domain (bits >= 0; bits == 0 maps

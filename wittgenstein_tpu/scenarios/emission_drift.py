@@ -48,14 +48,17 @@ def run_mode(mode, nodes=2048, seeds=32, max_time=6000, chunk=250,
                                 for i in range(seeds)])
     finished = live_done[live_done > 0]
     frac = finished.size / live_done.size
+    nan = float("nan")
     q = (lambda p: float(np.percentile(finished, p)) if finished.size
-         else float("nan"))
+         else nan)
     return {
         "mode": mode, "nodes": nodes, "seeds": seeds,
         "frac_done": round(frac, 4),
-        "mean_ms": round(float(finished.mean()), 1),
+        "mean_ms": round(float(finished.mean()), 1) if finished.size
+        else nan,
         "p50_ms": round(q(50), 1), "p90_ms": round(q(90), 1),
-        "p99_ms": round(q(99), 1), "max_ms": float(finished.max()),
+        "p99_ms": round(q(99), 1),
+        "max_ms": float(finished.max()) if finished.size else nan,
         "evicted": int(np.asarray(res.pstates.evicted).sum()),
         "wall_s": round(wall, 1),
     }
