@@ -108,11 +108,17 @@ def main():
         # process (this one may hold a poisoned half-initialized backend)
         # and emit an explicitly-labeled small-config CPU number rather
         # than nothing: perf evidence with provenance beats a null.
+        # Force the small config outright: TPU-scale WTPU_BENCH_* overrides
+        # must not ride onto the 1-core CPU (65k nodes there needs ~43 GB
+        # and hours — reports/TIER2_CPU.md).
         env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
-                   WTPU_BENCH_FALLBACK="1")
-        env.setdefault("WTPU_BENCH_NODES", "256")
-        env.setdefault("WTPU_BENCH_SEEDS", "2")
-        env.setdefault("WTPU_BENCH_MS", "1000")
+                   WTPU_BENCH_FALLBACK="1",
+                   WTPU_BENCH_NODES=str(min(
+                       256, int(os.environ.get("WTPU_BENCH_NODES", 256)))),
+                   WTPU_BENCH_SEEDS=str(min(
+                       2, int(os.environ.get("WTPU_BENCH_SEEDS", 2)))),
+                   WTPU_BENCH_MS=str(min(
+                       1000, int(os.environ.get("WTPU_BENCH_MS", 1000)))))
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
     n = int(os.environ.get("WTPU_BENCH_NODES", 2048))

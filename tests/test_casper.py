@@ -84,6 +84,70 @@ def test_byz_variants_run(kind):
     assert (prods == 1).sum() > 5
 
 
+def test_fork_choice_unit():
+    """CasperIMDTest.java:101-228 analog: `best` on hand-crafted block
+    topologies — direct-link/taller rule, attestation counting across a
+    fork from the common ancestor, and the deterministic id tie-break."""
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.core import blockchain as bc
+    from wittgenstein_tpu.ops import bitset
+
+    proto = make(random_on_ties=False)
+    net, p = proto.init(0)
+    n = proto.node_count
+
+    def alloc_one(arena, parent, t):
+        want = jnp.zeros((n,), bool).at[0].set(True)
+        arena, blk = bc.alloc(arena, want,
+                              jnp.full((n,), parent, jnp.int32),
+                              jnp.zeros((n,), jnp.int32), t)
+        return arena, int(blk[0])
+
+    # chain A: genesis -> a1 -> a2 ; fork B: genesis -> b1
+    arena = p.arena
+    arena, a1 = alloc_one(arena, 0, 5)
+    arena, a2 = alloc_one(arena, a1, 6)
+    arena, b1 = alloc_one(arena, 0, 7)
+    p = p.replace(arena=arena)
+
+    def best(pp, x, y):
+        out = proto._best(pp, jnp.full((n,), x, jnp.int32),
+                          jnp.full((n,), y, jnp.int32), jnp.int32(50))
+        return int(out[0])
+
+    # 1) ancestor vs descendant: direct link -> taller wins, both orders
+    # (best :214-217).
+    assert best(p, a1, a2) == a2
+    assert best(p, a2, a1) == a2
+
+    # 2) fork with votes: 2 attestations head=a2, 1 head=b1, all endorsing
+    # the common ancestor (genesis) -> the A branch wins regardless of
+    # argument order; flip the counts and B wins despite lower height
+    # (best :222-249, countAttestations :262-288).
+    def with_votes(heads):
+        pp = p.replace(att_n=jnp.asarray(len(heads), jnp.int32))
+        ah = pp.att_head
+        anc = pp.att_anc
+        for j, hblk in enumerate(heads):
+            ah = ah.at[j].set(hblk)
+            anc = anc.at[j].set(bitset.one_bit(jnp.asarray(0), proto.aw))
+        recv = jnp.zeros_like(pp.recv_att).at[:, 0].set(
+            jnp.uint32((1 << len(heads)) - 1))
+        return pp.replace(att_head=ah, att_anc=anc, recv_att=recv)
+
+    pv = with_votes([a2, a2, b1])
+    assert best(pv, a2, b1) == a2
+    assert best(pv, b1, a2) == a2
+    pv = with_votes([b1, b1, a2])
+    assert best(pv, a2, b1) == b1
+
+    # 3) equal votes, random_on_ties=False -> higher id wins (:252).
+    pv = with_votes([a2, b1])
+    assert best(pv, a2, b1) == max(a2, b1)
+    assert best(pv, b1, a2) == max(a2, b1)
+
+
 def test_determinism():
     p = make(random_on_ties=False)
     r = Runner(p, donate=False)

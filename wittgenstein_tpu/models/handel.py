@@ -195,6 +195,15 @@ class Handel(LevelMixin):
                 "queue-merge sort key would overflow int32: "
                 f"2*{node_count}*({queue_cap}+{inbox_cap}+1) >= 2**31; "
                 "reduce queue_cap/inbox_cap or node_count")
+        # q_sig's flat gathers index N*Q*W int32 cells (ops/flat.py);
+        # found the hard way at 65536 nodes x queue_cap 16 (exactly 2^31).
+        _w = (node_count + 31) // 32
+        if node_count * queue_cap * _w >= 2 ** 31:
+            raise ValueError(
+                f"verification-queue flat index would overflow int32: "
+                f"{node_count}*{queue_cap}*{_w} >= 2**31; the >=65536-node "
+                "tier needs queue_cap <= "
+                f"{(2 ** 31 - 1) // (node_count * _w)} (SCALE.md tier 2)")
         self.bits = max(1, int(math.log2(node_count)))
         self.levels = self.bits + 1            # levels 0..bits
         self.w = bitset.n_words(node_count)
