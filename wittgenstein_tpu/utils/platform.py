@@ -11,6 +11,46 @@ at first CPU-client init, which also happens later.
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+
+# The child probes the backend itself and EXITS CLEANLY on timeout or
+# error: killing a process stuck mid-backend-init is what wedges the
+# device tunnel for later processes, so the parent-side timeout below is
+# only a backstop for a child whose own exit wedges.  The probe thread
+# catches exceptions so a fast-raising backend (e.g. "UNAVAILABLE: TPU
+# backend setup/compile error") fails in seconds, not the full wait.
+_PROBE_CHILD = """\
+import sys, threading
+done = threading.Event()
+err = []
+def p():
+    try:
+        import jax
+        jax.devices()
+    except BaseException as e:
+        err.append(e)
+    finally:
+        done.set()
+threading.Thread(target=p, daemon=True).start()
+if not done.wait({timeout}):
+    sys.exit(3)
+sys.exit(4 if err else 0)
+"""
+
+
+def probe_backend(timeout_s: int = 240) -> bool:
+    """True iff the default JAX backend initializes, probed in a
+    SUBPROCESS so a wedged device tunnel cannot poison (or deadlock) the
+    calling process — the caller may still `jax.config.update` its own
+    platform afterwards."""
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CHILD.format(timeout=timeout_s)],
+            timeout=timeout_s + 60).returncode
+    except subprocess.TimeoutExpired:
+        return False
+    return rc == 0
 
 
 def force_virtual_cpu(n_devices: int = 8) -> None:
