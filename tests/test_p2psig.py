@@ -80,6 +80,31 @@ def test_p2phandel_run():
     assert np.all(card >= 99)
 
 
+@pytest.mark.slow
+def test_p2phandel_cmp_all_strategy():
+    """The remaining send strategy (P2PHandel.java:25-34 'cmp_all': full
+    state, compressed-size costing) — runs to completion like the others;
+    with it, all four strategies are exercised across the suite (all:
+    scenario smoke, dif/cmp_diff: the tests around this one)."""
+    p = P2PHandel(signing_node_count=64, relaying_node_count=8,
+                  threshold=60, connection_count=8, pairing_time=10,
+                  sigs_send_period=50, double_aggregate_strategy=False,
+                  send_sigs_strategy="cmp_all",
+                  network_latency_name="NetworkFixedLatency(20)")
+    r = Runner(p, donate=False)
+    net, ps = p.init(0)
+    for _ in range(20):
+        net, ps = r.run_ms(net, ps, 500)
+        done = np.asarray(net.nodes.done_at)
+        if (done > 0).all():
+            break
+    assert (done > 0).all()
+    assert int(net.dropped) == 0
+    # Smoke-level byte accounting only (the compressed-size model itself
+    # is unit-tested via compressed_size in this file's cs() tests).
+    assert int(np.asarray(net.nodes.bytes_sent).sum()) > 0
+
+
 def test_p2phandel_checksigs1():
     p = P2PHandel(signing_node_count=64, relaying_node_count=0,
                   threshold=60, connection_count=8, pairing_time=10,

@@ -358,15 +358,22 @@ class Runner:
     pytree is donated for the larger protocol states, and the failure
     poisons the process.  Donation is a memory optimisation only; re-enable
     explicitly once the backend handles it (CPU ignores donation anyway).
+
+    Requests longer than `chunk_limit` ms are split into equal bounded
+    chunks (scan composition — bit-identical results): very long single
+    scans have crashed the current TPU runtime, and the split reuses ONE
+    compiled program instead of compiling a fresh scan per distinct
+    length.
     """
 
-    def __init__(self, protocol, donate="auto"):
+    def __init__(self, protocol, donate="auto", chunk_limit=10_000):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
             donate = jax.default_backend() != "tpu"
         self._donate = donate
         self._validated = False
+        self.chunk_limit = chunk_limit
 
     def _chunk_fn(self, ms):
         if ms not in self._jits:
@@ -381,4 +388,15 @@ class Runner:
                     jnp.asarray(net.nodes.city), jax.core.Tracer):
                 validate(net.nodes)
             self._validated = True
-        return self._chunk_fn(int(ms))(net, pstate)
+        ms = int(ms)
+        if self.chunk_limit and ms > self.chunk_limit:
+            # n_chunks equal pieces + one remainder piece at most: two
+            # compiled programs for any length.
+            whole, rem = divmod(ms, self.chunk_limit)
+            fn = self._chunk_fn(self.chunk_limit)
+            for _ in range(whole):
+                net, pstate = fn(net, pstate)
+            if rem:
+                net, pstate = self._chunk_fn(rem)(net, pstate)
+            return net, pstate
+        return self._chunk_fn(ms)(net, pstate)
