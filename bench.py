@@ -103,6 +103,13 @@ def main():
     # would otherwise reintroduce the unbounded jax.devices() hang.
     fallback = (os.environ.get("WTPU_BENCH_FALLBACK") == "1" and
                 os.environ.get("JAX_PLATFORMS") == "cpu")
+    if fallback:
+        # The sandbox sitecustomize can load from site-packages (not just
+        # PYTHONPATH) and override JAX_PLATFORMS with the TPU plugin; the
+        # config key is the override that actually wins (utils/platform.py),
+        # and without it this child would skip the probe and hang in
+        # jax.devices() — the exact condition the fallback exists to avoid.
+        jax.config.update("jax_platforms", "cpu")
     if not fallback and not _backend_up():
         # The accelerator is unreachable.  Re-exec into a clean CPU
         # process (this one may hold a poisoned half-initialized backend)

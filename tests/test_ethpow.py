@@ -144,7 +144,18 @@ def test_miner_agent_env():
                     log.add(Decision(first_height, first_height + 2,
                                      ("send",)))
                 if env.get_secret_advance() >= 1:
+                    # actionSendOldestBlockMined (ETHMinerAgent.java:219-226)
+                    # raises otherMinersHead to each sent block whose height
+                    # exceeds it — a publish must move the baseline that
+                    # getSecretAdvance measures against.
+                    heights = np.asarray(env.p.arena.height)
+                    sent = env._unsent_blocks()[0]
+                    oh_before = heights[max(
+                        int(np.asarray(env.p.others_head)[1]), 0)]
                     env.send_mined_blocks(1)
+                    oh_after = heights[max(
+                        int(np.asarray(env.p.others_head)[1]), 0)]
+                    assert oh_after == max(oh_before, heights[sent])
         assert all(c in (1, 2, 3) for c in codes), codes
         assert env.ON_MINED_BLOCK in codes
         assert env.count_my_blocks() > 0

@@ -85,7 +85,8 @@ def _shard_seed_axis(trees, devices):
 
 def _shard_seed_and_node_axes(trees, mesh, n):
     """2-D sweep layout: the leading (seed) axis over the mesh's 'dp' axis
-    and the node axis (any later axis of size `n`, last match wins; flat
+    and the node axis (any later axis of size `n`, last match wins, with a
+    warning when non-adjacent matches make the pick ambiguous; flat
     mailbox axes divisible by n*sp are sharded across their flat index
     space) over 'sp'.  This is the multi-slice topology of SURVEY §2.6 —
     on real hardware 'dp' is the DCN/inter-slice axis (runs never
@@ -99,7 +100,27 @@ def _shard_seed_and_node_axes(trees, mesh, n):
         matches = [i for i in range(1, x.ndim) if x.shape[i] == n]
         spec = [None] * x.ndim
         spec[0] = "dp"
+        contiguous = matches == list(range(matches[0], matches[-1] + 1)) \
+            if matches else False
+        if len(matches) > 1 and not contiguous:
+            # An unrelated axis (inbox_cap, payload_words, ...) coinciding
+            # with n makes the choice ambiguous: GSPMD stays correct either
+            # way but silently inserts reshards, defeating the intended ICI
+            # layout.  Surface it instead of guessing quietly.
+            import warnings
+            warnings.warn(
+                f"node-axis sharding is ambiguous for leaf shape {x.shape}: "
+                f"axes {matches} all have size n={n}; using axis "
+                f"{matches[-1]}. Pick a node count that no other axis "
+                "coincides with, or shard explicitly (see "
+                "__graft_entry__.shard_spec).", stacklevel=2)
         if matches:
+            # Last match wins: for the hot [R, horizon, n] double-match
+            # (box_count with horizon == n, the Handel default) the node
+            # axis IS the last axis (__graft_entry__.shard_spec documents
+            # this), and for a pairwise [n, n] emission block either pick
+            # is GSPMD-correct.  A contiguous run is therefore resolved
+            # silently; only non-adjacent matches warrant the warning.
             spec[matches[-1]] = "sp"
         elif x.ndim == 2 and x.shape[1] >= n and x.shape[1] % (n * sp) == 0:
             spec[1] = "sp"

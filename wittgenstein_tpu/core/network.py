@@ -211,11 +211,17 @@ def _park_in_spill(cfg: EngineConfig, net: NetState, src, dest, arrival,
 
 
 def _drain_spill(cfg: EngineConfig, net: NetState, t):
-    """Re-inject parked messages whose arrival just came within ring reach
-    (exactly one drain step per entry: when arrival - t == horizon - 2)."""
-    sel = (net.sp_arrival >= 0) & (net.sp_arrival - t == cfg.horizon - 2)
+    """Re-inject parked messages whose arrival is within ring reach.
+
+    Entries parked by `enqueue_unicast` cross `arrival - t == horizon - 2`
+    exactly once, but a restored/hand-built NetState (or a future horizon
+    change) can hold entries already nearer than that — an exact-equality
+    drain would leak them (never delivered, slot never freed).  Draining on
+    <= with arrival clamped to t+1 (rel >= 1 for `_bin_into_ring`) is
+    equivalent for the enqueue path and robust for any other state."""
+    sel = (net.sp_arrival >= 0) & (net.sp_arrival - t <= cfg.horizon - 2)
     net2, n_drop = _bin_into_ring(cfg, net, t, net.sp_src, net.sp_dest,
-                                  jnp.maximum(net.sp_arrival, 0),
+                                  jnp.maximum(net.sp_arrival, t + 1),
                                   net.sp_payload, net.sp_size, sel)
     return net2.replace(
         sp_arrival=jnp.where(sel, -1, net2.sp_arrival),

@@ -795,26 +795,34 @@ class MinerAgentEnv:
         dry with exactly one request remaining (sent == howMany-1),
         never when the request was fully consumed (howMany ends -1).
         privateMinerBlock clears whenever the queue is empty afterwards,
-        even if nothing was sent (:85-87).  others_head moves only on
-        blocks received from other miners (onReceivedBlock), never at
-        publish time.  (The reference also gates the restart on
-        ``inMining != null``; our miners are always mining between
-        ticks, so that is always true here.)"""
+        even if nothing was sent (:85-87).  actionSendOldestBlockMined
+        (:219-226) also advances otherMinersHead to each sent block whose
+        height exceeds it, so a publish immediately raises the baseline
+        that getSecretAdvance measures against.  (The reference also
+        gates the restart on ``inMining != null``; our miners are always
+        mining between ticks, so that is always true here.)"""
         blocks = self._unsent_blocks()
         send = blocks[:how_many]
         aw = self.proto.aw
         p = self.p
         unsent = p.mined_unsent
         release = p.release
+        heights = np.asarray(p.arena.height)
+        oh0 = oh = int(np.asarray(p.others_head)[1])
+        oh_h = int(heights[max(oh, 0)])
         for b in send:
             bit = bitset.one_bit(jnp.asarray(b, jnp.int32), aw)
             unsent = unsent.at[1].set(unsent[1] & ~bit)
             release = release.at[1].set(release[1] | bit)
+            if int(heights[b]) > oh_h:
+                oh, oh_h = b, int(heights[b])
         pb = int(np.asarray(p.private_blk)[1])
         restart = len(send) == how_many - 1 and pb >= 0
         queue_empty = len(blocks) <= how_many
         self.p = p.replace(
             mined_unsent=unsent, release=release,
+            others_head=(p.others_head.at[1].set(oh) if oh != oh0
+                         else p.others_head),
             private_blk=(p.private_blk.at[1].set(-1) if queue_empty
                          else p.private_blk),
             min_father=(p.min_father.at[1].set(-1) if restart
