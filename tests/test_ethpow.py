@@ -39,6 +39,63 @@ def test_honest_mining_rate_and_consensus():
     assert len(chain) == heights[0] - GENESIS_HEIGHT
 
 
+def test_difficulty_golden_exact():
+    """EthPoWTest.java:33-70 testDifficulty: the published per-block
+    difficulty and total-difficulty values, driven with the same parent
+    timestamps through the scaled difficulty function.  The only allowed
+    divergence is the 2^DIFF_SHIFT fixed-point representation: <= 4 scaled
+    units (4 * 2^21 raw, i.e. a 4e-9 relative error) per block, growing by
+    at most ~2 units per step from the /2048 floor on a scaled operand."""
+    from wittgenstein_tpu.models.ethpow import (DIFF_SHIFT, GENESIS_DIFF_RAW,
+                                                GENESIS_DIFF_S,
+                                                difficulty_s)
+
+    # (gap_ms_from_father, father_has_uncles, published difficulty)
+    chain = [
+        (13000, False, 1_949_482_177_664_138),   # b2
+        (7000,  False, 1_950_434_207_476_428),   # b3
+        (4000,  False, 1_951_386_702_147_025),   # b4
+        (39000, False, 1_948_528_359_750_282),   # b5
+        (3000,  False, 1_949_479_923_831_169),   # b6
+        (15000, False, 1_949_480_058_048_897),   # b7
+        (11000, False, 1_949_480_192_266_625),   # b8 (has uncle u1 itself)
+        (3000,  True,  1_951_384_115_734_613),   # b9 (father b8 HAS uncles)
+    ]
+    # The published totalDifficulty strings are exactly cumulative:
+    # td_k = td_{k-1} + difficulty_k from the genesis TD (POWBlock :134).
+    genesis_td = 10_591_882_213_905_570_860_929
+    published_td = [
+        10_591_884_163_387_748_525_067, 10_591_886_113_821_956_001_495,
+        10_591_888_065_208_658_148_520, 10_591_890_013_737_017_898_802,
+        10_591_891_963_216_941_729_971, 10_591_893_912_696_999_778_868,
+        10_591_895_862_177_192_045_493, 10_591_897_813_561_307_780_106,
+    ]
+    td = genesis_td
+    for (_, _, diff), want in zip(chain, published_td):
+        td += diff
+        assert td == want                       # reference TD invariant
+
+    fd_s = GENESIS_DIFF_S
+    height = GENESIS_HEIGHT
+    td_s = 0                                    # scaled TD above genesis
+    for i, (gap_ms, f_uncles, want_raw) in enumerate(chain):
+        d_s = int(difficulty_s(jnp.asarray(fd_s, jnp.int32),
+                               jnp.asarray(height, jnp.int32),
+                               jnp.asarray(gap_ms // 9000, jnp.int32),
+                               jnp.asarray(f_uncles)))
+        err_units = abs(d_s * 2 ** DIFF_SHIFT - want_raw) / 2 ** DIFF_SHIFT
+        assert err_units <= 4, (i, d_s * 2 ** DIFF_SHIFT, want_raw,
+                                err_units)
+        td_s += d_s
+        want_td_rel = sum(c[2] for c in chain[:i + 1])
+        td_err = abs(td_s * 2 ** DIFF_SHIFT - want_td_rel) / 2 ** DIFF_SHIFT
+        assert td_err <= 4 * (i + 1), (i, td_err)
+        fd_s, height = d_s, height + 1
+    # The scaled genesis itself is the documented 2^-21 rounding.
+    assert abs(GENESIS_DIFF_S * 2 ** DIFF_SHIFT - GENESIS_DIFF_RAW) \
+        <= 2 ** (DIFF_SHIFT - 1)
+
+
 def test_difficulty_tracks_constantinople():
     p = ETHPoW(number_of_miners=5,
                network_latency_name="NetworkFixedLatency(100)")

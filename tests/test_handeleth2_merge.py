@@ -1,0 +1,178 @@
+"""HandelEth2 merge-math unit tests — the analogue of
+HandelEth2Test.java:12-119 (testTree + testMerge): direct checks of the
+level geometry and the sizeIfMerged / mergeIncoming analogues
+(HLevel.java:158-193, :225-261), independent of a full simulation run."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.models.handeleth2 import HandelEth2, R
+from wittgenstein_tpu.ops import bitset
+
+U32 = jnp.uint32
+
+
+def bits_of(*ids, w=1):
+    """Packed [1, W] row with the given node bits set."""
+    row = np.zeros(w, np.uint32)
+    for i in ids:
+        row[i // 32] |= np.uint32(1) << (i % 32)
+    return jnp.asarray(row[None, :])
+
+
+def test_tree_geometry():
+    """testTree (HandelEth2Test.java:12-31): communicationLevel is
+    symmetric, the peer appears exactly at that level's range and at no
+    lower level."""
+    p = HandelEth2(node_count=64)
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        a, b = rng.integers(0, 64, 2)
+        if a == b:
+            continue
+        c_ab = int(a ^ b).bit_length()          # communicationLevel
+        assert c_ab == int(b ^ a).bit_length()
+        for l in range(1, p.levels):
+            mask = p._range_mask_dyn(jnp.asarray([int(a)]),
+                                     jnp.asarray([l]))
+            word = np.asarray(mask)[0]
+            has = bool(word[b // 32] >> (b % 32) & 1)
+            assert has == (l == c_ab), (a, b, l, c_ab)
+
+
+def test_size_if_merged_disjoint_and_empty():
+    """sizeIfMerged :158-193 — empty incoming keeps ours; disjoint sets
+    sum."""
+    p = HandelEth2(node_count=64)
+    w = p.w
+    lmask = p._range_mask_dyn(jnp.asarray([0]), jnp.asarray([3]))  # ids 4..7
+    ours = bits_of(4, 5, w=w)[:, None, :]       # [1, 1(H), W]
+    ind = jnp.zeros_like(ours)
+    empty = jnp.zeros_like(ours)
+    assert int(p._size_if_merged(ours, ind, empty, lmask[:, None, :])[0]) == 2
+    theirs = bits_of(6, 7, w=w)[:, None, :]
+    assert int(p._size_if_merged(ours, ind, theirs,
+                                 lmask[:, None, :])[0]) == 4
+
+
+def test_size_if_merged_overlap_best_of():
+    """Overlapping aggregates cannot union (real BLS can't dedup):
+    best-of wins, and the receiver's individual sigs repair the
+    alternative (their | individuals)."""
+    p = HandelEth2(node_count=64)
+    w = p.w
+    lmask = p._range_mask_dyn(jnp.asarray([0]), jnp.asarray([3]))
+    ours = bits_of(4, 5, 6, w=w)[:, None, :]
+    theirs = bits_of(6, 7, w=w)[:, None, :]     # overlaps on 6
+    no_ind = jnp.zeros_like(ours)
+    # alt = theirs (2) < ours (3) -> keep ours
+    assert int(p._size_if_merged(ours, no_ind, theirs,
+                                 lmask[:, None, :])[0]) == 3
+    # with individuals {4, 5}: alt = {4,5,6,7} (4) > ours (3)
+    ind = bits_of(4, 5, w=w)[:, None, :]
+    assert int(p._size_if_merged(ours, ind, theirs,
+                                 lmask[:, None, :])[0]) == 4
+
+
+def test_size_if_merged_multi_hash_keying():
+    """Aggregations are keyed by attested hash (HLevel.mergeIncoming
+    :225-261): each hash row merges independently and the size is the sum
+    over hashes."""
+    p = HandelEth2(node_count=64, hash_values=4)
+    w, H = p.w, p.n_hash
+    lmask = p._range_mask_dyn(jnp.asarray([0]), jnp.asarray([3]))
+    ours = jnp.concatenate(
+        [bits_of(4, 5, w=w), bits_of(6, w=w),
+         jnp.zeros((1, w), U32), jnp.zeros((1, w), U32)],
+        axis=0)[None]                           # [1, H, W]
+    ind = jnp.zeros_like(ours)
+    theirs = jnp.concatenate(
+        [jnp.zeros((1, w), U32), bits_of(7, w=w),
+         bits_of(4, w=w), jnp.zeros((1, w), U32)],
+        axis=0)[None]
+    # hash0: theirs empty -> 2; hash1: disjoint -> 2; hash2: 0 vs 1 -> 1;
+    # hash3: both empty -> 0.  Total 5.
+    assert int(p._size_if_merged(ours, ind, theirs,
+                                 lmask[:, None, :])[0]) == 5
+
+
+def _merge_once(p, pstate, node, frm, lvl, h, sig_row, t=10):
+    n, H, w = p.node_count, p.n_hash, p.w
+    sl = 0
+    sig = jnp.zeros((n, H, w), U32).at[node, h].set(sig_row)
+    pstate = pstate.replace(
+        pend_on=jnp.zeros((n,), bool).at[node].set(True),
+        pend_at=jnp.zeros((n,), jnp.int32),
+        pend_from=jnp.full((n,), -1, jnp.int32).at[node].set(frm),
+        pend_lvl=jnp.zeros((n,), jnp.int32).at[node].set(lvl),
+        pend_slot=jnp.zeros((n,), jnp.int32).at[node].set(sl),
+        pend_hash=jnp.zeros((n,), jnp.int32).at[node].set(h),
+        pend_sig=sig)
+    return p._apply_pending(pstate, jnp.asarray(t, jnp.int32))
+
+
+def test_merge_incoming_applies_and_keys_by_hash():
+    """mergeIncoming via _apply_pending on crafted state (the testMerge
+    flow, HandelEth2Test.java:33-119): a verified level-1 aggregate lands
+    in the right hash row, the sender's individual bit is recorded, and a
+    second hash's row stays untouched."""
+    p = HandelEth2(node_count=4, hash_values=4)
+    _, ps = p.init(jnp.asarray(0, jnp.int32))
+    w = p.w
+
+    # Node 0 verifies node 1's level-1 single-signer aggregate (hash 2).
+    sig1 = bits_of(1, w=w)[0]
+    ps2 = _merge_once(p, ps, node=0, frm=1, lvl=1, h=2, sig_row=sig1)
+    inc = np.asarray(ps2.inc)[0, 0]             # [H, W]
+    ind = np.asarray(ps2.ind)[0, 0]
+    assert inc[2][0] == 0b10                    # level-1 range = {1}
+    assert ind[2][0] == 0b10                    # sender's individual bit
+    assert inc[0].sum() == inc[1].sum() == inc[3].sum() == 0
+    assert not bool(np.asarray(ps2.pend_on)[0])
+
+    # Disjoint level-2 merge under the same hash unions ({2} then {3}).
+    ps3 = _merge_once(p, ps2, node=0, frm=2, lvl=2, h=2,
+                      sig_row=bits_of(2, w=w)[0])
+    ps4 = _merge_once(p, ps3, node=0, frm=3, lvl=2, h=2,
+                      sig_row=bits_of(3, w=w)[0])
+    inc4 = np.asarray(ps4.inc)[0, 0]
+    assert inc4[2][0] == 0b1110                 # {1} | {2} | {3}
+
+    # Overlapping non-improving level-2 aggregate keeps the current set.
+    ps5 = _merge_once(p, ps4, node=0, frm=2, lvl=2, h=2,
+                      sig_row=bits_of(2, w=w)[0])
+    assert np.asarray(ps5.inc)[0, 0][2][0] == 0b1110
+
+
+def test_merge_incoming_best_of_with_repair():
+    """Overlap resolution (mergeIncoming :246-256): an overlapping bigger
+    aggregate replaces ours only when (theirs | individuals) beats it."""
+    p = HandelEth2(node_count=8, hash_values=2)
+    _, ps = p.init(jnp.asarray(0, jnp.int32))
+    w = p.w
+    # Seed node 0's level-3 range ({4..7}) under hash 0 with {4, 5} via
+    # two individual merges (recording ind bits 4 and 5).
+    ps = _merge_once(p, ps, node=0, frm=4, lvl=3, h=0,
+                     sig_row=bits_of(4, w=w)[0])
+    ps = _merge_once(p, ps, node=0, frm=5, lvl=3, h=0,
+                     sig_row=bits_of(5, w=w)[0])
+    assert np.asarray(ps.inc)[0, 0][0][0] == 0b110000
+    # Overlapping {5, 6, 7}: alt = theirs | ind{4,5} = {4..7} (4) beats
+    # ours (2) -> replaced by the repaired set.
+    ps = _merge_once(p, ps, node=0, frm=6, lvl=3, h=0,
+                     sig_row=bits_of(5, 6, 7, w=w)[0])
+    assert np.asarray(ps.inc)[0, 0][0][0] == 0b11110000
+
+
+def test_merge_fast_path_trigger():
+    """Level completion queues upper complete levels for fast-path sends
+    (updateVerifiedSignatures :176-202 via fast_pending bits)."""
+    p = HandelEth2(node_count=4, hash_values=2)
+    _, ps = p.init(jnp.asarray(0, jnp.int32))
+    w = p.w
+    # Completing level 1 ({1}) makes level 2's outgoing (own + lvl1 = 2
+    # of 2... outgoing complete) queue a fast-path bit for level 2.
+    ps2 = _merge_once(p, ps, node=0, frm=1, lvl=1, h=0,
+                      sig_row=bits_of(1, w=w)[0])
+    fp = int(np.asarray(ps2.fast_pending)[0, 0])
+    assert fp & (1 << 2), bin(fp)

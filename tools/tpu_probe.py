@@ -9,6 +9,7 @@ marker file .tpu_up is written so the build loop can pick it up and run the
 real bench on-chip.
 """
 import datetime
+import os
 import pathlib
 import subprocess
 import sys
@@ -45,10 +46,22 @@ def main() -> None:
     while not MARKER.exists():
         t0 = time.time()
         log("attempt: spawning child jax.devices() (no timeout; down signature is ~55min hang then UNAVAILABLE)")
+        # Sanitize the child env: a stray JAX_PLATFORMS=cpu or cleared
+        # PYTHONPATH (the repo's own CPU-test recipe) would make every
+        # attempt come back PROBE_CPU_ONLY in seconds — a permanent false
+        # negative while the tunnel is healthy.
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        axon_site = "/root/.axon_site"
+        if axon_site not in env.get("PYTHONPATH", "") and \
+                pathlib.Path(axon_site).is_dir():
+            env["PYTHONPATH"] = (axon_site + os.pathsep +
+                                 env.get("PYTHONPATH", "")).rstrip(os.pathsep)
         proc = subprocess.run(
             [sys.executable, "-c", CHILD],
             capture_output=True,
             text=True,
+            env=env,
         )
         dt = time.time() - t0
         out = (proc.stdout or "").strip().splitlines()

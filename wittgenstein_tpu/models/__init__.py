@@ -3,5 +3,5 @@ registry (core/protocol.PROTOCOLS) — the analogue of the reference
 wserver's Spring classpath scan (wserver/Server.java:56-70)."""
 
 from . import (avalanche, casper, dfinity, enr, ethpow, gsf, handel,  # noqa
-               handeleth2, optimistic, p2pflood, p2phandel, paxos,
-               pingpong, sanfermin)
+               handel_cardinal, handeleth2, optimistic, p2pflood,
+               p2phandel, paxos, pingpong, sanfermin)

@@ -66,6 +66,30 @@ GENESIS_DIFF_S = int(round(GENESIS_DIFF_RAW / 2 ** DIFF_SHIFT))
 TOTAL_HASH_POWER = 200 * 1024               # GH/s (ETHPoW.init :72)
 
 
+def difficulty_s(fd_s, father_height, gap, father_has_uncles):
+    """Constantinople difficulty + bomb (calculateDifficulty,
+    ETHPoW.java:283-296) in 2^DIFF_SHIFT-scaled int32 units.
+
+    ``gap = (ts - father.proposalTime_ms) // 9000``; both sides floor the
+    /2048 step, so the only divergence from the reference's long math is
+    the scaled representation itself (<= a few scaled units per block —
+    golden-tested against EthPoWTest.java:33-70's published values in
+    tests/test_ethpow.py)."""
+    y = jnp.where(father_has_uncles, 2, 1)
+    ugap = jnp.maximum(-99, y - gap)
+    diff = (fd_s // 2048) * ugap
+    periods = (father_height - 4_999_999) // 100_000
+    # periods <= 1 falls back to `diff`, not 0 — the reference's own
+    # quirk (:290-293); unreachable at this genesis height (periods ~ 29)
+    # but kept formula-for-formula.
+    bomb = jnp.where(periods > 1,
+                     jnp.where(periods - 2 >= DIFF_SHIFT,
+                               jnp.int32(1) << jnp.clip(
+                                   periods - 2 - DIFF_SHIFT, 0, 30), 0),
+                     diff)
+    return fd_s + diff + bomb
+
+
 class _TickScaled:
     """Wraps a ms latency model: output is ceil-divided into engine ticks."""
 
@@ -323,19 +347,7 @@ class ETHPoW:
         # Constantinople difficulty (:283-296), scaled by 2^DIFF_SHIFT.
         fd = p.diff_s[jnp.maximum(f, 0)]
         gap = ((t - p.arena.time[jnp.maximum(f, 0)]) * self.tick_ms) // 9000
-        y = jnp.where(p.u1[jnp.maximum(f, 0)] >= 0, 2, 1)
-        ugap = jnp.maximum(-99, y - gap)
-        diff = (fd // 2048) * ugap
-        periods = (hf + 1 - 4_999_999) // 100_000
-        # periods <= 1 falls back to `diff`, not 0 — the reference's own
-        # quirk (calculateDifficulty :290-293); unreachable at this genesis
-        # height (periods ~ 29) but kept formula-for-formula.
-        bomb = jnp.where(periods > 1,
-                         jnp.where(periods - 2 >= DIFF_SHIFT,
-                                   jnp.int32(1) << jnp.clip(
-                                       periods - 2 - DIFF_SHIFT, 0, 30), 0),
-                         diff)
-        all_d = fd + diff + bomb
+        all_d = difficulty_s(fd, hf, gap, p.u1[jnp.maximum(f, 0)] >= 0)
 
         # solveIn10ms (:225-231): 1 - (1-1/d)^(hp*2^30/100 per tick).
         thr = 1.0 - jnp.exp(-(p.hash_power.astype(jnp.float32) * (1 << 9)) /

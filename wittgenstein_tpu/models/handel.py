@@ -114,7 +114,26 @@ class HandelState:
 
 @register
 class Handel(LevelMixin):
-    """Parameters mirror Handel.HandelParameters (Handel.java:22-142)."""
+    """Parameters mirror Handel.HandelParameters (Handel.java:22-142).
+
+    ``mode="cardinal"`` dispatches to the O(N*L)-state tier-3 variant
+    (models/handel_cardinal.py, SCALE.md): same protocol semantics under
+    count-based per-level aggregation, no O(N^2) state."""
+
+    def __new__(cls, *args, mode="exact", **kwargs):
+        if cls is Handel and mode == "cardinal":
+            from .handel_cardinal import HandelCardinal
+            obj = object.__new__(HandelCardinal)
+            # Not a Handel subclass, so Python will not auto-call
+            # __init__ on the returned object — do it here.  Cardinal
+            # mode accepts the shared parameter subset; exact-only scale
+            # switches (emission_mode, snapshot_pool, ...) are rejected
+            # by its signature.
+            obj.__init__(*args, **kwargs)
+            return obj
+        if mode not in ("exact", "cardinal"):
+            raise ValueError(f"unknown Handel mode {mode!r}")
+        return super().__new__(cls)
 
     def __init__(self, node_count=2048, threshold=None, pairing_time=3,
                  level_wait_time=50, extra_cycle=10,
@@ -124,7 +143,10 @@ class Handel(LevelMixin):
                  window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
                  emission_lookahead=8, byzantine_suicide=False,
                  hidden_byzantine=False, emission_mode=None,
-                 snapshot_pool=None, prefix_pc=None):
+                 snapshot_pool=None, prefix_pc=None, mode="exact"):
+        # `mode` is consumed by __new__ ("cardinal" dispatches to
+        # HandelCardinal before this body runs); it reaches here only as
+        # "exact".
         if node_count & (node_count - 1):
             raise ValueError("we support only power-of-two node counts "
                              "(Handel.java:119-121)")
