@@ -264,3 +264,24 @@ def test_determinism_under_jit_copy():
     n2, p2 = run(proto, 150, seed=3)
     assert jnp.array_equal(p1["when"], p2["when"])
     assert jnp.array_equal(n1.nodes.msg_received, n2.nodes.msg_received)
+
+
+def test_runner_big_donation_bit_identical():
+    """Runner(donate="big") — selective donation of >=1MB leaves (the
+    tier-2 memory path, SCALE.md) — must be bit-identical to the
+    undonated runner, including across the chunk_limit split."""
+    from wittgenstein_tpu.models.handel import Handel
+    import jax
+    proto = Handel(node_count=128, nodes_down=12, threshold=114,
+                   pairing_time=4, dissemination_period_ms=20)
+    outs = []
+    for donate in (False, "big"):
+        r = Runner(proto, donate=donate, chunk_limit=300)
+        net, ps = proto.init(7)
+        net, ps = r.run_ms(net, ps, 700)   # 300 + 300 + 100 chunks
+        outs.append((net, ps))
+    (n1, p1), (n2, p2) = outs
+    # "big" actually split something (the mailbox ring is > 1 MB).
+    assert r._split is not None and len(r._split[1]) > 0
+    for a, b in zip(jax.tree.leaves((n1, p1)), jax.tree.leaves((n2, p2))):
+        assert jnp.array_equal(a, b)

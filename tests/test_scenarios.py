@@ -64,6 +64,35 @@ def test_handel_byz_sweeps_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_handel_log_errors_smoke(tmp_path):
+    csv = handel_scenarios.log_errors(error_rate=0.2, counts=(32,), seeds=2,
+                                      out_dir=tmp_path)
+    assert csv.rows
+    assert os.path.exists(tmp_path / "handel_errors.csv")
+    assert os.path.exists(tmp_path / "handel_errors.png")
+
+
+@pytest.mark.slow
+def test_handel_extra_cycle_sweep_smoke(tmp_path):
+    csv = handel_scenarios.extra_cycle_sweep(cycles=(10,), nodes=32,
+                                             seeds=2, out_dir=tmp_path)
+    assert csv.rows
+    assert os.path.exists(tmp_path / "handel_extra_cycle.csv")
+
+
+@pytest.mark.slow
+def test_handel_contacted_node_sweep_smoke(tmp_path):
+    csv = handel_scenarios.contacted_node_sweep(fast_paths=(0, 10),
+                                                nodes=32, seeds=2,
+                                                out_dir=tmp_path)
+    assert csv.rows
+    assert os.path.exists(tmp_path / "handel_fastpath.csv")
+    # fast_path=0 must still complete (the fast path is an optimization).
+    fd = csv.columns.index("frac_done")
+    assert all(r[fd] == 1.0 for r in csv.rows)
+
+
+@pytest.mark.slow
 def test_handel_period_sweep_smoke(tmp_path):
     csv = handel_scenarios.period_sweep(periods=(20,), nodes=32, seeds=2,
                                         out_dir=str(tmp_path))

@@ -125,17 +125,20 @@ def main():
     # Aggregation must actually be progressing (counts grow past own sig).
     assert lvl_sum.max() > 1
 
+    cfg = proto.cfg
     report = REPO / "reports" / "CARDINAL_1M.md"
-    report.write_text(f"""# Cardinal-mode 1M-node run (virtual 8-device mesh)
+    report.write_text(f"""# Cardinal-mode {n:,}-node run (virtual 8-device mesh)
 
-Evidence for SCALE.md tier 3: `HandelCardinal` at N = 2^20 = 1,048,576
-nodes, GSPMD node-axis sharding over an 8-device virtual CPU mesh
+Evidence for SCALE.md tier 3: `HandelCardinal` at N = {n:,} nodes, GSPMD
+node-axis sharding over an 8-device virtual CPU mesh
 (`xla_force_host_platform_device_count=8`, the same layout
 `__graft_entry__.dryrun_multichip` validates), single seed.
 
-Config: threshold 0.99N, pairing 4 ms, period 20 ms, fastPath 10,
-queue_cap 8, inbox_cap 4, horizon 256, NetworkUniformLatency(150)
-(all arrivals inside the ring by construction — nothing may clamp).
+Config: threshold 0.99N, pairing {proto.pairing_time} ms, period
+{proto.period} ms, fastPath {proto.fast_path}, queue_cap
+{proto.queue_cap}, inbox_cap {cfg.inbox_cap}, horizon {cfg.horizon},
+{proto.latency!r} (all arrivals inside the ring by construction —
+nothing may clamp).
 
 | metric | value |
 |---|---|
@@ -148,10 +151,11 @@ queue_cap 8, inbox_cap 4, horizon 256, NetworkUniformLatency(150)
 | dropped / clamped / bc_dropped / evicted | {dropped} / {clamped} / {bc_dropped} / {evicted} |
 | aggregate count (mean / max over nodes) | {lvl_sum.mean():.1f} / {lvl_sum.max()} |
 
-State is O(N*L): lvl_best [N, 21] + queue counts, vs the exact mode's
-Theta(N^2) bitsets (>= 0.8 TB at 1M — SCALE.md).  The mailbox ring
-(3 x 256 x 2^20 x 4 int32 words + src/size/count) dominates at this
-scale; it shards evenly over the node axis, so a v5e-8 holds
+State is O(N*L): lvl_best [N, {proto.levels}] + queue counts, vs the
+exact mode's Theta(N^2) bitsets (>= 0.8 TB at 1M — SCALE.md).  The
+mailbox ring ({cfg.payload_words} x {cfg.horizon} x {n:,} x
+{cfg.inbox_cap} int32 words + src/size/count) dominates at this scale;
+it shards evenly over the node axis, so a v5e-8 holds
 {state_bytes / 1e9 / N_DEV:.1f} GB/chip against 16 GB HBM.
 
 Wall-clock caveat: this host is a 1-core CPU; the run validates fit +

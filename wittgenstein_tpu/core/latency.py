@@ -40,6 +40,7 @@ def gpd_inverse(y, shape=1.4, location=-0.3, scale=0.35):
 
 class NetworkNoLatency:
     """Always 1 ms (NetworkLatency.java:271-275)."""
+    positional = False
 
     name = "NetworkNoLatency"
 
@@ -52,6 +53,7 @@ class NetworkNoLatency:
 
 class NetworkFixedLatency:
     """Constant latency (NetworkLatency.java:235-249)."""
+    positional = False
 
     def __init__(self, fixed: int):
         self.fixed = max(1, int(fixed))
@@ -66,6 +68,7 @@ class NetworkFixedLatency:
 
 class NetworkUniformLatency:
     """Uniform in [0, max]: ``(delta / 99) * max`` (NetworkLatency.java:255-269)."""
+    positional = False
 
     def __init__(self, max_latency: int):
         self.max_latency = max(1, int(max_latency))
@@ -172,6 +175,8 @@ def build_distribution(proportions, values):
 
 class MeasuredNetworkLatency:
     """Arbitrary 100-bucket latency distribution (NetworkLatency.java:277-359)."""
+
+    positional = False
 
     def __init__(self, proportions, values, name="MeasuredNetworkLatency"):
         self.table = jnp.asarray(build_distribution(proportions, values))

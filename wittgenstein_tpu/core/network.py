@@ -362,8 +362,13 @@ class Runner:
     donate="auto" disables buffer donation on TPU: the current (experimental)
     TPU plugin fails at runtime (INVALID_ARGUMENT) when the full simulator
     pytree is donated for the larger protocol states, and the failure
-    poisons the process.  Donation is a memory optimisation only; re-enable
-    explicitly once the backend handles it (CPU ignores donation anyway).
+    poisons the process.  donate="big" donates ONLY leaves >=
+    `donate_threshold` bytes (the mailbox ring, sig queues, pools — the
+    buffers that dominate tier-2 residency, SCALE.md) via a split
+    argument, halving peak memory for exactly the arrays that matter
+    while keeping the donated pytree small; it is the configuration to
+    try on TPU once hardware is reachable (bit-identical on CPU, where
+    donation is a no-op — tested in tests/test_engine.py).
 
     Requests longer than `chunk_limit` ms are split into equal bounded
     chunks (scan composition — bit-identical results): very long single
@@ -372,20 +377,47 @@ class Runner:
     length.
     """
 
-    def __init__(self, protocol, donate="auto", chunk_limit=10_000):
+    def __init__(self, protocol, donate="auto", chunk_limit=10_000,
+                 donate_threshold=1 << 20):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
             donate = jax.default_backend() != "tpu"
         self._donate = donate
+        self._donate_threshold = donate_threshold
+        self._split = None          # (treedef, big_idx) for donate="big"
         self._validated = False
         self.chunk_limit = chunk_limit
 
     def _chunk_fn(self, ms):
         if ms not in self._jits:
-            kw = {"donate_argnums": (0, 1)} if self._donate else {}
-            self._jits[ms] = jax.jit(scan_chunk(self.protocol, ms), **kw)
+            base = scan_chunk(self.protocol, ms)
+            if self._donate == "big":
+                treedef, big_idx = self._split
+
+                def split_run(big, small):
+                    leaves = [None] * (len(big) + len(small))
+                    bi, si = iter(big), iter(small)
+                    for i in range(len(leaves)):
+                        leaves[i] = next(bi) if i in big_idx else next(si)
+                    net, ps = jax.tree.unflatten(treedef, leaves)
+                    return base(net, ps)
+
+                self._jits[ms] = jax.jit(split_run, donate_argnums=(0,))
+            else:
+                kw = {"donate_argnums": (0, 1)} if self._donate else {}
+                self._jits[ms] = jax.jit(base, **kw)
         return self._jits[ms]
+
+    def _call(self, fn, net, pstate):
+        if self._donate != "big":
+            return fn(net, pstate)
+        treedef, big_idx = self._split
+        leaves = jax.tree.leaves((net, pstate))
+        big = tuple(x for i, x in enumerate(leaves) if i in big_idx)
+        small = tuple(x for i, x in enumerate(leaves)
+                      if i not in big_idx)
+        return fn(big, small)
 
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:
@@ -394,6 +426,11 @@ class Runner:
                     jnp.asarray(net.nodes.city), jax.core.Tracer):
                 validate(net.nodes)
             self._validated = True
+        if self._donate == "big" and self._split is None:
+            leaves, treedef = jax.tree.flatten((net, pstate))
+            self._split = (treedef, frozenset(
+                i for i, x in enumerate(leaves)
+                if x.size * x.dtype.itemsize >= self._donate_threshold))
         ms = int(ms)
         if self.chunk_limit and ms > self.chunk_limit:
             # n_chunks equal pieces + one remainder piece at most: two
@@ -401,8 +438,8 @@ class Runner:
             whole, rem = divmod(ms, self.chunk_limit)
             fn = self._chunk_fn(self.chunk_limit)
             for _ in range(whole):
-                net, pstate = fn(net, pstate)
+                net, pstate = self._call(fn, net, pstate)
             if rem:
-                net, pstate = self._chunk_fn(rem)(net, pstate)
+                net, pstate = self._call(self._chunk_fn(rem), net, pstate)
             return net, pstate
-        return self._chunk_fn(ms)(net, pstate)
+        return self._call(self._chunk_fn(ms), net, pstate)

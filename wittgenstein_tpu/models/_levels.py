@@ -54,6 +54,59 @@ def sibling_base(ids, half):
     return mine + jnp.where((ids & half) != 0, 0, half)
 
 
+def merge_bounded_queue(q_from, q_lvl, q_rank, src, level, rank_all, ok,
+                        q_cap, cols2d, cols3d):
+    """The shared bounded-queue merge policy of the Handel-family receive
+    paths (models/handel.py and models/handel_cardinal.py): one entry per
+    (sender, level) — newest inbox message wins — keep the `q_cap` best
+    (lowest-reception-rank) candidates, ties favoring already-queued
+    entries then earlier inbox slots, via one batched sort over
+    (existing ∪ incoming).
+
+    `cols2d` / `cols3d` map column name -> (existing [N,Q,...],
+    incoming [N,S,...]) pairs carried through the merge.  Returns
+    (sel2, sel3, evicted_delta) where sel2 always contains "from", "lvl",
+    "rank", and evicted_delta counts EXISTING entries displaced by better
+    incoming candidates (rejected incoming messages don't count)."""
+    q = q_cap
+    s = src.shape[1]
+    later = jnp.triu(jnp.ones((s, s), bool), k=1)[None]
+    dup = jnp.any((src[:, :, None] == src[:, None, :]) &
+                  (level[:, :, None] == level[:, None, :]) &
+                  ok[:, None, :] & later, axis=2)
+    inc_ok = ok & ~dup                   # newest same-key message wins
+    superseded = jnp.any(
+        (q_from[:, :, None] == src[:, None, :]) &
+        (q_lvl[:, :, None] == level[:, None, :]) &
+        inc_ok[:, None, :], axis=2)                        # [N, Q]
+    ex_keep = (q_from >= 0) & ~superseded
+
+    u_from = jnp.concatenate(
+        [jnp.where(ex_keep, q_from, -1),
+         jnp.where(inc_ok, src, -1)], axis=1)              # [N, Q+S]
+    u2 = {"from": u_from,
+          "lvl": jnp.concatenate([q_lvl, level], axis=1),
+          "rank": jnp.concatenate([q_rank, rank_all], axis=1)}
+    for k, (ex, inc) in cols2d.items():
+        u2[k] = jnp.concatenate([ex, inc], axis=1)
+    u3 = {k: jnp.concatenate([ex, inc], axis=1)
+          for k, (ex, inc) in cols3d.items()}
+
+    valid_u = u_from >= 0
+    # rank * (Q+S+1) + position: existing entries (positions 0..Q-1) win
+    # ties, then incoming by slot order; int32-safe per the callers'
+    # __init__ guards.
+    keyv = u2["rank"] * (q + s + 1) + \
+        jnp.arange(q + s, dtype=jnp.int32)[None, :]
+    sel2, sel3, order = select_queue(keyv, valid_u, q, u2, u3)
+    kept_existing = jnp.sum((order < q) &
+                            jnp.take_along_axis(valid_u, order, axis=1),
+                            axis=1)
+    evicted_delta = jnp.sum(
+        jnp.sum(ex_keep, axis=1) - kept_existing).astype(jnp.int32)
+    return sel2, sel3, evicted_delta
+
+
 def select_queue(keyv, valid, q_cap, cols2d, cols3d):
     """Shared tail of the vectorized bounded-queue merges
     (models/handel.py / models/gsf.py receive paths): keep the `q_cap`

@@ -78,6 +78,11 @@ def difficulty_s(fd_s, father_height, gap, father_has_uncles):
     y = jnp.where(father_has_uncles, 2, 1)
     ugap = jnp.maximum(-99, y - gap)
     diff = (fd_s // 2048) * ugap
+    # The bomb period counts from the FATHER's height — the reference is
+    # literally `periods = (father.height - 4_999_999L) / 100_000L`
+    # (calculateDifficulty :291); an earlier in-line version of this code
+    # wrongly used the child height (father + 1), off by one at period
+    # boundaries.
     periods = (father_height - 4_999_999) // 100_000
     # periods <= 1 falls back to `diff`, not 0 — the reference's own
     # quirk (:290-293); unreachable at this genesis height (periods ~ 29)

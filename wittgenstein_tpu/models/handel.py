@@ -64,7 +64,7 @@ from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
 from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
-                      keyed_level_peer, select_queue, sibling_base)
+                      keyed_level_peer, merge_bounded_queue, sibling_base)
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
 TAG_BAD = 0x48424144      # bad-node choice
@@ -387,7 +387,6 @@ class Handel(LevelMixin):
     def _receive(self, p: HandelState, nodes, inbox, t):
         n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
         ids = jnp.arange(n, dtype=jnp.int32)
-        S = inbox.src.shape[1]
         done = nodes.done_at > 0
 
         valid = inbox.valid                                   # [N, S]
@@ -422,60 +421,20 @@ class Handel(LevelMixin):
 
         # Queue merge, vectorized across ALL slots at once.  The reference
         # queues every incoming aggregate in an unbounded per-level list
-        # (onNewSig :753-786); this implementation bounds memory with a
-        # Q-slot queue whose policy is: one entry per (sender, level) —
-        # newest wins — and keep the Q best (lowest-reception-rank)
-        # candidates, ties favoring already-queued entries then earlier
-        # inbox slots.  One batched sort over (existing ∪ incoming)
-        # implements that directly; the previous unrolled per-slot
-        # insert/evict loop compiled S argmax+scatter blocks for a
-        # near-identical (slightly order-dependent) policy.
-        later = jnp.triu(jnp.ones((S, S), bool), k=1)[None]
-        dup = jnp.any((src[:, :, None] == src[:, None, :]) &
-                      (level[:, :, None] == level[:, None, :]) &
-                      ok[:, None, :] & later, axis=2)
-        inc_ok = ok & ~dup                   # newest same-key message wins
-        superseded = jnp.any(
-            (p.q_from[:, :, None] == src[:, None, :]) &
-            (p.q_lvl[:, :, None] == level[:, None, :]) &
-            inc_ok[:, None, :], axis=2)                        # [N, Q]
-        ex_keep = (p.q_from >= 0) & ~superseded
+        # (onNewSig :753-786); this implementation bounds memory with the
+        # shared bounded-queue policy (_levels.merge_bounded_queue): one
+        # entry per (sender, level) — newest wins — keep the Q best
+        # (lowest-reception-rank) candidates.
+        sel2, sel3, ev = merge_bounded_queue(
+            p.q_from, p.q_lvl, p.q_rank, src, level, rank_all, ok, Q,
+            {"bad": (p.q_bad, jnp.zeros_like(ok))},
+            {"sig": (p.q_sig, sig_all)})
 
-        u_from = jnp.concatenate(
-            [jnp.where(ex_keep, p.q_from, -1),
-             jnp.where(inc_ok, src, -1)], axis=1)              # [N, Q+S]
-        u_lvl = jnp.concatenate([p.q_lvl, level], axis=1)
-        u_rank = jnp.concatenate([p.q_rank, rank_all], axis=1)
-        u_bad = jnp.concatenate(
-            [p.q_bad, jnp.zeros_like(inc_ok)], axis=1)
-        u_sig = jnp.concatenate([p.q_sig, sig_all], axis=1)    # [N, Q+S, W]
-
-        valid_u = u_from >= 0
-        # rank * (Q+S+1) + position: existing entries (positions 0..Q-1)
-        # win ties, then incoming by slot order; fits int32 up to 2^25
-        # ranks (ranks are < 2N even after demotion).
-        keyv = u_rank * (Q + S + 1) + \
-            jnp.arange(Q + S, dtype=jnp.int32)[None, :]
-        sel2, sel3, order = select_queue(
-            keyv, valid_u, Q,
-            {"from": u_from, "lvl": u_lvl, "rank": u_rank, "bad": u_bad},
-            {"sig": u_sig})
-        q_from, q_lvl = sel2["from"], sel2["lvl"]
-        q_rank, q_bad = sel2["rank"], sel2["bad"]
-        q_sig = sel3["sig"]
-        # Diagnostic: count EXISTING queue entries displaced by better
-        # incoming candidates (the old loop's evict semantics; rejected
-        # incoming messages don't count).
-        kept_existing = jnp.sum((order < Q) &
-                                jnp.take_along_axis(valid_u, order, axis=1),
-                                axis=1)
-        evicted = p.evicted + jnp.sum(
-            jnp.sum(ex_keep, axis=1) - kept_existing).astype(jnp.int32)
-
-        return p.replace(q_from=q_from, q_lvl=q_lvl, q_rank=q_rank,
-                         q_bad=q_bad, q_sig=q_sig, finished_peers=finished,
+        return p.replace(q_from=sel2["from"], q_lvl=sel2["lvl"],
+                         q_rank=sel2["rank"], q_bad=sel2["bad"],
+                         q_sig=sel3["sig"], finished_peers=finished,
                          msg_filtered=p.msg_filtered + filtered,
-                         evicted=evicted)
+                         evicted=p.evicted + ev)
 
     # -- apply a finished verification (updateVerifiedSignatures, :686-750)
 

@@ -64,7 +64,7 @@ from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d, set2d
 from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
-                      keyed_level_peer, select_queue, sibling_base)
+                      keyed_level_peer, merge_bounded_queue, sibling_base)
 from .handel import TAG_BAD, TAG_EMIT, TAG_LEVEL, TAG_RANK, TAG_START
 
 U32 = jnp.uint32
@@ -84,7 +84,6 @@ class HandelCardinalState:
     q_from: jnp.ndarray        # int32 [N, Q]  (-1 = empty slot)
     q_lvl: jnp.ndarray         # int32 [N, Q]
     q_rank: jnp.ndarray        # int32 [N, Q]
-    q_bad: jnp.ndarray         # bool [N, Q]
     q_cnt: jnp.ndarray         # int32 [N, Q] — the entry's aggregate count
     pos: jnp.ndarray           # int32 [N, L] — posInLevel round-robin pointer
     curr_window: jnp.ndarray   # int32 [N]
@@ -247,7 +246,6 @@ class HandelCardinal(LevelMixin):
             q_from=jnp.full((n, Q), -1, jnp.int32),
             q_lvl=jnp.zeros((n, Q), jnp.int32),
             q_rank=jnp.zeros((n, Q), jnp.int32),
-            q_bad=jnp.zeros((n, Q), bool),
             q_cnt=jnp.zeros((n, Q), jnp.int32),
             pos=jnp.zeros((n, L), jnp.int32),
             curr_window=jnp.full((n,), self.window_initial, jnp.int32),
@@ -279,7 +277,6 @@ class HandelCardinal(LevelMixin):
     def _receive(self, p: HandelCardinalState, nodes, inbox, t):
         n, L, Q = self.node_count, self.levels, self.queue_cap
         ids = jnp.arange(n, dtype=jnp.int32)
-        S = inbox.src.shape[1]
         done = nodes.done_at > 0
 
         valid = inbox.valid                                   # [N, S]
@@ -301,46 +298,16 @@ class HandelCardinal(LevelMixin):
 
         rank_all = self._rank(p.seed, ids[:, None], src)
 
-        # Bounded-queue merge: one entry per (sender, level) — newest wins —
-        # keep the Q lowest-reception-rank candidates (the same policy and
-        # batched sort as models/handel.py _receive, minus the sig rows).
-        later = jnp.triu(jnp.ones((S, S), bool), k=1)[None]
-        dup = jnp.any((src[:, :, None] == src[:, None, :]) &
-                      (level[:, :, None] == level[:, None, :]) &
-                      ok[:, None, :] & later, axis=2)
-        inc_ok = ok & ~dup
-        superseded = jnp.any(
-            (p.q_from[:, :, None] == src[:, None, :]) &
-            (p.q_lvl[:, :, None] == level[:, None, :]) &
-            inc_ok[:, None, :], axis=2)                        # [N, Q]
-        ex_keep = (p.q_from >= 0) & ~superseded
-
-        u_from = jnp.concatenate(
-            [jnp.where(ex_keep, p.q_from, -1),
-             jnp.where(inc_ok, src, -1)], axis=1)              # [N, Q+S]
-        u_lvl = jnp.concatenate([p.q_lvl, level], axis=1)
-        u_rank = jnp.concatenate([p.q_rank, rank_all], axis=1)
-        u_bad = jnp.concatenate([p.q_bad, jnp.zeros_like(inc_ok)], axis=1)
-        u_cnt = jnp.concatenate([p.q_cnt, cnt], axis=1)
-
-        valid_u = u_from >= 0
-        keyv = u_rank * (Q + S + 1) + \
-            jnp.arange(Q + S, dtype=jnp.int32)[None, :]
-        sel2, _, order = select_queue(
-            keyv, valid_u, Q,
-            {"from": u_from, "lvl": u_lvl, "rank": u_rank, "bad": u_bad,
-             "cnt": u_cnt}, {})
-        kept_existing = jnp.sum((order < Q) &
-                                jnp.take_along_axis(valid_u, order, axis=1),
-                                axis=1)
-        evicted = p.evicted + jnp.sum(
-            jnp.sum(ex_keep, axis=1) - kept_existing).astype(jnp.int32)
+        # Bounded-queue merge (the shared policy of
+        # _levels.merge_bounded_queue, minus the sig rows).
+        sel2, _, ev = merge_bounded_queue(
+            p.q_from, p.q_lvl, p.q_rank, src, level, rank_all, ok, Q,
+            {"cnt": (p.q_cnt, cnt)}, {})
 
         return p.replace(q_from=sel2["from"], q_lvl=sel2["lvl"],
-                         q_rank=sel2["rank"], q_bad=sel2["bad"],
-                         q_cnt=sel2["cnt"],
+                         q_rank=sel2["rank"], q_cnt=sel2["cnt"],
                          msg_filtered=p.msg_filtered + filtered,
-                         evicted=evicted)
+                         evicted=p.evicted + ev)
 
     # -- apply a finished verification (updateVerifiedSignatures, :686-750)
 
@@ -467,7 +434,9 @@ class HandelCardinal(LevelMixin):
 
         slot = gather2d(best_slot, ids, pick_level)
         vfrom = gather2d(p.q_from, ids, slot)
-        vbad = gather2d(p.q_bad, ids, slot)
+        # Queue entries are never bad (only attack plants are, and those
+        # go straight to pend): no q_bad column exists in cardinal mode.
+        vbad = jnp.zeros_like(do)
         vcnt = gather2d(p.q_cnt, ids, slot)
         keep_entry = jnp.zeros_like(do)
 
@@ -522,7 +491,7 @@ class HandelCardinal(LevelMixin):
         # (No rank demotion in cardinal mode — O(N^2) bits.)
         q_from = jnp.where(due[:, None] & ~keep, -1, p.q_from)
         q_from = set2d(q_from, ids, slot, -1, ok=do & ~keep_entry)
-        q_lvl, q_rank, q_bad, q_cnt = p.q_lvl, p.q_rank, p.q_bad, p.q_cnt
+        q_lvl, q_rank, q_cnt = p.q_lvl, p.q_rank, p.q_cnt
 
         if self.hidden_byzantine:
             # A failed attack leaves the plant in the queue (:905-913).
@@ -536,12 +505,10 @@ class HandelCardinal(LevelMixin):
             q_from = set2d(q_from, ids, islot, h_id, ok=ins)
             q_lvl = set2d(q_lvl, ids, islot, pick_level, ok=ins)
             q_rank = set2d(q_rank, ids, islot, h_rank, ok=ins)
-            q_bad = set2d(q_bad, ids, islot, False, ok=ins)
             q_cnt = set2d(q_cnt, ids, islot, 1, ok=ins)
 
         return p.replace(
-            q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_bad=q_bad,
-            q_cnt=q_cnt, curr_window=curr_window, byz_seen=byz_seen,
+            q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_cnt=q_cnt, curr_window=curr_window, byz_seen=byz_seen,
             pend_from=jnp.where(do, vfrom, p.pend_from),
             pend_level=jnp.where(do, pick_level, p.pend_level),
             pend_bad=jnp.where(do, vbad, p.pend_bad),
@@ -588,6 +555,10 @@ class HandelCardinal(LevelMixin):
         sz_l = 1 + halfs // 8 + 192                            # [1, L]
         dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
         payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
+        # Word 1 (levelFinished flag) is wire-format parity with exact
+        # mode only: cardinal receivers ignore it (no finishedPeers
+        # tracking), but message introspection tooling still sees the
+        # same 3-word layout.
         payload = payload.at[:, :L - 1, 1].set(
             inc_complete.astype(jnp.int32)[:, 1:])
         payload = payload.at[:, :L - 1, 2].set(og_size[:, 1:])

@@ -23,11 +23,13 @@ Design:
   shard per ms; overflow is counted in `xdropped` (the sharded analogue of
   NetState.dropped — size it for the protocol).
 
-Latency draws key on GLOBAL node ids, so for delta-independent latency
-models (fixed / none / measured-table) a sharded run is bit-identical to
-the single-chip run of the same protocol (tested on the virtual CPU mesh
-in tests/test_sharded.py); positional models would need their coordinate
-tables replicated into the model (see _bc_latency).
+Latency draws key on GLOBAL message indices and node ids, and the node
+coordinate/city tables are replicated into every shard (three [N] int32
+all_gathers per ms, riding the same ICI exchange), so a sharded run is
+bit-identical to the single-chip run of the same protocol for EVERY
+latency model, including the positional ones
+(NetworkLatencyByDistanceWJitter / city models) — tested on the virtual
+CPU mesh in tests/test_sharded.py.
 """
 
 from __future__ import annotations
@@ -137,7 +139,7 @@ class ShardedRunner:
     # ---------------------------------------------------------------- step
 
     def _local_inbox(self, snet: ShardedNet, t, part_all=None,
-                     extra_all=None):
+                     extra_all=None, tables=None):
         """Local-ring slice + broadcast recompute for the local nodes.
 
         Global semantics preserved: latency draws key on GLOBAL ids."""
@@ -169,7 +171,7 @@ class ShardedRunner:
         gids = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
         delta = prng.uniform_delta(net.bc_seed[:, None], gids[None, :])
         lat = self._bc_latency(snet, net.bc_src[:, None], gids[None, :],
-                               delta, extra_all)
+                               delta, extra_all, tables)
         not_disc = lat < cfg.msg_discard_time
         lat = jnp.clip(lat, 1, cfg.horizon - 2)
         arrival = net.bc_time[:, None] + 1 + lat
@@ -198,15 +200,18 @@ class ShardedRunner:
             bytes_received=nodes.bytes_received + rbytes)
         return inbox, nodes
 
-    def _bc_latency(self, snet, src_g, dst_g, delta, extra_all=None):
-        """Latency between global ids.  Distance-free models only
-        (fixed/uniform/no-latency/measured); positional models would need
-        replicated coordinate tables.  Per-node extra latency (tor) is
-        honored via the replicated extra_all table."""
+    def _bc_latency(self, snet, src_g, dst_g, delta, extra_all=None,
+                    tables=None):
+        """Latency between global ids, any model: positional models read
+        the replicated [N] coordinate/city tables (`tables`); per-node
+        extra latency (tor) is honored via the replicated extra_all
+        table."""
         model = self.protocol.latency
 
         class _NodesStub:
             extra_latency = jnp.zeros_like(delta)
+            if tables is not None:
+                x, y, city = tables
 
         lat = model.extended(_NodesStub(), src_g, dst_g, delta)
         if extra_all is not None:
@@ -230,11 +235,22 @@ class ShardedRunner:
             extra_all = jax.lax.all_gather(net.nodes.extra_latency,
                                            "sp").reshape(-1)
             down_all = jax.lax.all_gather(net.nodes.down, "sp").reshape(-1)
+            # Positional latency models read global coordinates/cities;
+            # distance-free models declare `positional = False` and skip
+            # the three [N] gathers (default True: unknown custom models
+            # get the tables).
+            if getattr(proto.latency, "positional", True):
+                tables = (
+                    jax.lax.all_gather(net.nodes.x, "sp").reshape(-1),
+                    jax.lax.all_gather(net.nodes.y, "sp").reshape(-1),
+                    jax.lax.all_gather(net.nodes.city, "sp").reshape(-1))
+            else:
+                tables = None
             snet = snet.replace(net=net)
             net = net.replace(bc_active=net.bc_active & (
                 (t - net.bc_time) < cfg.horizon))
             inbox, nodes = self._local_inbox(snet.replace(net=net), t,
-                                             part_all, extra_all)
+                                             part_all, extra_all, tables)
             key = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
             gids0 = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
             step = getattr(proto, "step_sharded", None)
@@ -276,6 +292,11 @@ class ShardedRunner:
             b_payload = scatter(payload, 0)
             b_size = scatter(size, 0)
             b_delay = scatter(delay, 0)
+            # Global flat message index (src_g * k + outbox slot): the
+            # single-chip engine keys its latency delta on exactly this
+            # (enqueue_unicast), so carrying it through the exchange keeps
+            # jittered models bit-identical to the unsharded run.
+            b_midx = scatter(src_g * k + idx % k, 0)
             xdrop = jnp.sum((ds_s < S) & ~ok_s).astype(jnp.int32)
 
             # counters for attempted sends (parity with enqueue_unicast)
@@ -297,15 +318,17 @@ class ShardedRunner:
             r_payload = xc(b_payload)
             r_size = xc(b_size)
             r_delay = xc(b_delay)
+            r_midx = xc(b_midx)
 
             # ---- enqueue received into the local ring ----
             dl = jnp.clip(r_dest - snet.shard_id * nl, 0, nl - 1)
             seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
-            # latency keyed by (global msg index = src slot), global parity
-            delta = prng.uniform_delta(seed_t, r_src * S + snet.shard_id)
+            # latency keyed by the global flat message index — the same
+            # draw enqueue_unicast makes on one chip
+            delta = prng.uniform_delta(seed_t, r_midx)
             lat = self._bc_latency(snet, jnp.maximum(r_src, 0),
                                    jnp.where(r_dest >= 0, r_dest, 0),
-                                   delta, extra_all)
+                                   delta, extra_all, tables)
             # the same validity gates as enqueue_unicast: discard window,
             # destination down, cross-partition drop
             ok = (r_dest >= 0) & (lat < cfg.msg_discard_time) & \
@@ -453,11 +476,12 @@ class RingForward:
     each ms; nodes accumulate what they receive.  Exercises cross-shard
     unicast routing + the broadcast path (node 0 broadcasts at t == 0)."""
 
-    def __init__(self, n=64, stride=9, latency=10):
+    def __init__(self, n=64, stride=9, latency=10, horizon=64):
         self.node_count = n
         self.stride = stride
-        self.latency = NetworkFixedLatency(latency)
-        self.cfg = EngineConfig(n=n, horizon=64, inbox_cap=8,
+        self.latency = (NetworkFixedLatency(latency)
+                        if isinstance(latency, int) else latency)
+        self.cfg = EngineConfig(n=n, horizon=horizon, inbox_cap=8,
                                 payload_words=1, out_deg=1, bcast_slots=2)
 
     def init(self, seed):

@@ -33,8 +33,15 @@ from .handel_scenarios import default_params
 
 
 def run_mode(mode, nodes=2048, seeds=32, max_time=6000, chunk=250,
-             first_seed=0):
-    params = default_params(nodes=nodes)
+             first_seed=0, attack=None, dead_ratio=None):
+    """One emission mode; `attack` in (None, "byzantine_suicide",
+    "hidden_byzantine") turns the dead fraction into attackers — the
+    rank-prioritized stored ordering matters most under attack (VERDICT
+    r2 weak #5), so the drift must be measured there too."""
+    kw = {} if dead_ratio is None else {"dead_ratio": dead_ratio}
+    params = default_params(nodes=nodes, **kw)
+    if attack:
+        params[attack] = True
     params["emission_mode"] = mode
     proto = Handel(**params)
     t0 = time.perf_counter()
@@ -64,21 +71,26 @@ def run_mode(mode, nodes=2048, seeds=32, max_time=6000, chunk=250,
     }
 
 
-def compare(nodes=2048, seeds=32, max_time=6000, out_dir="."):
+def compare(nodes=2048, seeds=32, max_time=6000, out_dir=".", attack=None,
+            dead_ratio=None):
     csv = CSVFormatter(["mode", "nodes", "seeds", "frac_done", "mean_ms",
                         "p50_ms", "p90_ms", "p99_ms", "max_ms", "evicted",
                         "wall_s"])
     rows = {}
     for mode in ("stored", "hashed"):
-        r = run_mode(mode, nodes=nodes, seeds=seeds, max_time=max_time)
+        r = run_mode(mode, nodes=nodes, seeds=seeds, max_time=max_time,
+                     attack=attack, dead_ratio=dead_ratio)
+        r["attack"] = attack or "none"
         rows[mode] = r
-        csv.add(**r)
+        csv.add(**r)                 # unknown keys are ignored by add()
         print(json.dumps(r))
     drift_mean = rows["hashed"]["mean_ms"] / rows["stored"]["mean_ms"] - 1
     drift_p90 = rows["hashed"]["p90_ms"] / rows["stored"]["p90_ms"] - 1
-    print(json.dumps({"drift_mean_pct": round(100 * drift_mean, 2),
+    print(json.dumps({"attack": attack or "none", "nodes": nodes,
+                      "drift_mean_pct": round(100 * drift_mean, 2),
                       "drift_p90_pct": round(100 * drift_p90, 2)}))
-    csv.save(f"{out_dir}/emission_drift_{nodes}n.csv")
+    suffix = f"_{attack}" if attack else ""
+    csv.save(f"{out_dir}/emission_drift_{nodes}n{suffix}.csv")
     return rows
 
 

@@ -170,6 +170,75 @@ def hidden_byz_sweep(ratios=(0.0, 0.1, 0.25, 0.5), nodes=256, seeds=4,
     return csv
 
 
+def log_errors(error_rate=0.2, counts=(32, 64, 128, 256), seeds=4,
+               out_dir="."):
+    """Fail-silent error-rate node scaling (HandelScenarios.logErrors
+    :365-430): time + message counts as n doubles at a fixed dead
+    fraction.  Reference default sweeps n = 32..4096 at errors = 0.2."""
+    csv = CSVFormatter(["nodes", "error_rate", "avg_done_ms",
+                        "msg_sent_avg", "frac_done"])
+    g = Graph(f"Handel under {int(error_rate * 100)}% fail-silent errors",
+              "nodes", "avg doneAt (ms)")
+    s = Series(f"errors={int(error_rate * 100)}%")
+    for n in counts:
+        r = _run_point(default_params(nodes=n, dead_ratio=error_rate),
+                       seeds, max_time=8000)
+        csv.add(nodes=n, error_rate=error_rate,
+                avg_done_ms=round(r["avg_done_ms"], 1),
+                msg_sent_avg=round(r["msg_sent_avg"], 1),
+                frac_done=round(r["frac_done"], 3))
+        s.add(n, r["avg_done_ms"])
+        print(f"errors={error_rate} nodes={n}: {r}")
+    g.add_series(s)
+    csv.save(f"{out_dir}/handel_errors.csv")
+    g.save(f"{out_dir}/handel_errors.png")
+    return csv
+
+
+def extra_cycle_sweep(cycles=(10, 15, 20, 30, 40, 50), nodes=256, seeds=4,
+                      dead_ratio=0.10, out_dir="."):
+    """extraCycle sweep (HandelScenarios.logExtraCycle :568-585): done
+    nodes keep disseminating for ec more periods; measures the cost of
+    the grace cycles vs completion reliability.  Reference runs n=4096,
+    r=5 seeds."""
+    csv = CSVFormatter(["extra_cycle", "avg_done_ms", "msg_sent_avg",
+                        "frac_done"])
+    for ec in cycles:
+        r = _run_point(default_params(nodes=nodes, dead_ratio=dead_ratio,
+                                      extra_cycle=ec), seeds,
+                       max_time=8000)
+        csv.add(extra_cycle=ec, avg_done_ms=round(r["avg_done_ms"], 1),
+                msg_sent_avg=round(r["msg_sent_avg"], 1),
+                frac_done=round(r["frac_done"], 3))
+        print(f"extra_cycle={ec}: {r}")
+    csv.save(f"{out_dir}/handel_extra_cycle.csv")
+    return csv
+
+
+def contacted_node_sweep(fast_paths=(0, 5, 10, 20, 40), nodes=256, seeds=4,
+                         dead_ratio=0.10, out_dir="."):
+    """Fast-path peer-count sweep (HandelScenarios.logContactedNode
+    :588-632): time and messages vs the number of peers contacted on
+    level completion.  Reference runs n=4096, r=5 seeds."""
+    csv = CSVFormatter(["fast_path", "avg_done_ms", "msg_sent_avg",
+                        "frac_done"])
+    g = Graph("Handel: time vs fast-path peer count", "fast path peers",
+              "avg doneAt (ms)")
+    s = Series("avg doneAt")
+    for fp in fast_paths:
+        r = _run_point(default_params(nodes=nodes, dead_ratio=dead_ratio,
+                                      fast_path=fp), seeds, max_time=8000)
+        csv.add(fast_path=fp, avg_done_ms=round(r["avg_done_ms"], 1),
+                msg_sent_avg=round(r["msg_sent_avg"], 1),
+                frac_done=round(r["frac_done"], 3))
+        s.add(fp, r["avg_done_ms"])
+        print(f"fast_path={fp}: {r}")
+    g.add_series(s)
+    csv.save(f"{out_dir}/handel_fastpath.csv")
+    g.save(f"{out_dir}/handel_fastpath.png")
+    return csv
+
+
 def gen_anim(nodes=256, out_path="handel.gif", frames=20, frame_ms=50):
     """Animated GIF of aggregation progress (HandelScenarios.genAnim :291,
     NodeDrawer parity)."""
