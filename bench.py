@@ -11,7 +11,8 @@ the ratio is against the driver's budget of 10k aggregate sim-ms/s for this
 config (≈ 10 full 2048-node Handel runs per wall-second).
 
 Env overrides for smoke runs: WTPU_BENCH_NODES, WTPU_BENCH_SEEDS,
-WTPU_BENCH_MS.
+WTPU_BENCH_MS; WTPU_BENCH_MODE=cardinal benches the O(N*L) tier-3
+variant (models/handel_cardinal.py) for 100k-class node counts.
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -31,14 +32,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250):
+def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250, mode="exact"):
     from wittgenstein_tpu.core.network import scan_chunk
     from wittgenstein_tpu.models.handel import Handel
 
     down = n // 10
+    kw = {}
+    if mode == "cardinal" and n > 32768:
+        # Tier-2 config: bounded ring for the int32 flat-index limit
+        # (3 * 256 * n * 8 < 2^31 up to ~349k nodes).  Past that the ring
+        # must shrink below what ByDistanceWJitter's latency tail allows
+        # on one chip — use tools/cardinal_1m.py (mesh sharding + a
+        # bounded-latency model) for the 1M-class evidence runs.
+        if n > 349_000:
+            raise ValueError(
+                "cardinal bench supports n <= ~349k on one chip; see "
+                "tools/cardinal_1m.py for larger runs")
+        kw = dict(queue_cap=8, inbox_cap=8, horizon=256)
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
-                   dissemination_period_ms=20, fast_path=10)
+                   dissemination_period_ms=20, fast_path=10, mode=mode,
+                   **kw)
     step = jax.jit(jax.vmap(scan_chunk(proto, chunk)))
     nets, ps = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
 
@@ -131,8 +145,11 @@ def main():
     n = int(os.environ.get("WTPU_BENCH_NODES", 2048))
     seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 8))
     sim_ms = int(os.environ.get("WTPU_BENCH_MS", 1000))
-    agg = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms)
+    mode = os.environ.get("WTPU_BENCH_MODE", "exact")
+    agg = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms, mode=mode)
     suffix = "_cpu_fallback" if fallback else ""
+    if mode != "exact":
+        suffix = f"_{mode}{suffix}"
     out = {
         "metric": f"handel_{n}n_{seeds}seeds_agg_sim_ms_per_sec{suffix}",
         "value": round(agg, 1),

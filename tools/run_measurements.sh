@@ -1,11 +1,13 @@
 #!/bin/bash
-# Serialized round-3 measurement queue for the 1-core sandbox: waits for
-# the in-flight cardinal 1M run, then executes each study back to back.
-# Logs land in reports/*.log; each tool writes its own .md report.
+# Serialized round-3 measurement queue for the 1-core sandbox.
+# Order: highest evidence-per-CPU-hour first; the open-ended 1M unsharded
+# run goes last.  Logs land in reports/*.log; each tool writes its own
+# .md report.
 cd "$(dirname "$0")/.."
 
-echo "[queue] waiting for cardinal_1m (if running)..."
-while pgrep -f "tools/cardinal_1m.py" > /dev/null; do sleep 60; done
+echo "[queue] 262k cardinal on the 8-device mesh"
+WTPU_CARDINAL_N=262144 python tools/cardinal_1m.py 120 \
+    > reports/cardinal_262k.log 2>&1
 
 echo "[queue] cardinal_drift (1024,4096 x 8 seeds + attack rows)"
 python tools/cardinal_drift.py --sizes 1024,4096 --seeds 8 \
@@ -30,5 +32,10 @@ python tools/scenario_sweeps_2048.py > reports/sweeps_2048.log 2>&1
 
 echo "[queue] dfinity variance (32 seeds x 300 s)"
 python tools/dfinity_variance.py 32 300 > reports/dfinity_variance.log 2>&1
+
+echo "[queue] 1M cardinal unsharded (single device; GSPMD at 1M x 8"
+echo "        partitions exceeds this host's compile/exec workspace)"
+WTPU_CARDINAL_DEVS=1 python tools/cardinal_1m.py 120 \
+    > reports/cardinal_1m_1dev.log 2>&1
 
 echo "[queue] done"

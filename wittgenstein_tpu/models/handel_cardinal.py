@@ -508,7 +508,8 @@ class HandelCardinal(LevelMixin):
             q_cnt = set2d(q_cnt, ids, islot, 1, ok=ins)
 
         return p.replace(
-            q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_cnt=q_cnt, curr_window=curr_window, byz_seen=byz_seen,
+            q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_cnt=q_cnt,
+            curr_window=curr_window, byz_seen=byz_seen,
             pend_from=jnp.where(do, vfrom, p.pend_from),
             pend_level=jnp.where(do, pick_level, p.pend_level),
             pend_bad=jnp.where(do, vbad, p.pend_bad),
