@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250, mode="exact"):
+def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact"):
     from wittgenstein_tpu.core.network import scan_chunk
     from wittgenstein_tpu.models.handel import Handel
 
@@ -53,7 +53,13 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=250, mode="exact"):
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,
                    **kw)
-    step = jax.jit(jax.vmap(scan_chunk(proto, chunk)))
+    # t0_mod=0: runs start at time 0 and `chunk` is a multiple of the
+    # schedule lcm, so the phase-specialized scan applies (bit-identical,
+    # tests/test_phase_hints.py) — masked verification/dissemination work
+    # is only traced on the ms where it can fire.
+    lcm = getattr(proto, "schedule_lcm", None)
+    t0 = 0 if (lcm and chunk % lcm == 0) else None
+    step = jax.jit(jax.vmap(scan_chunk(proto, chunk, t0_mod=t0)))
     nets, ps = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
 
     # compile + warm

@@ -37,8 +37,8 @@ def run_batch(mode, n, seeds, sim_ms, **attack):
                mode=mode, **attack)
     t0 = time.perf_counter()
     nets, pss = jax.vmap(p.init)(np.arange(seeds, dtype=np.int32))
-    chunk = 500
-    step = jax.jit(jax.vmap(scan_chunk(p, chunk)))
+    chunk = 500          # multiple of the 20-ms schedule lcm -> t0_mod=0
+    step = jax.jit(jax.vmap(scan_chunk(p, chunk, t0_mod=0)))
     for _ in range(sim_ms // chunk):
         nets, pss = step(nets, pss)
     jax.block_until_ready(nets.time)

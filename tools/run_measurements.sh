@@ -1,22 +1,14 @@
 #!/bin/bash
 # Serialized round-3 measurement queue for the 1-core sandbox.
-# Order: highest evidence-per-CPU-hour first; the open-ended 1M unsharded
-# run goes last.  Logs land in reports/*.log; each tool writes its own
-# .md report.
+# Reordered after the mid-round sandbox restore: artifacts still missing
+# (drift study, emission attack rows, reference-scale sweeps, dfinity
+# variance) run first; the long supplementary cardinal re-runs go last.
+# Logs land in reports/*.log; each tool writes its own .md report.
 cd "$(dirname "$0")/.."
-
-echo "[queue] 262k cardinal on the 8-device mesh"
-WTPU_CARDINAL_N=262144 python tools/cardinal_1m.py 120 \
-    > reports/cardinal_262k.log 2>&1
 
 echo "[queue] cardinal_drift (1024,4096 x 8 seeds + attack rows)"
 python tools/cardinal_drift.py --sizes 1024,4096 --seeds 8 \
     > reports/cardinal_drift.log 2>&1
-
-echo "[queue] emission drift 8192 honest x 8 seeds"
-PYTHONPATH= JAX_PLATFORMS=cpu python -m \
-    wittgenstein_tpu.scenarios.emission_drift reports 8192 8 \
-    > reports/emission_8192.log 2>&1
 
 echo "[queue] emission drift attacks at 1024 x 8 seeds"
 PYTHONPATH= JAX_PLATFORMS=cpu python - > reports/emission_attacks.log 2>&1 <<'EOF'
@@ -27,11 +19,20 @@ compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
         attack="hidden_byzantine", dead_ratio=0.25)
 EOF
 
+echo "[queue] emission drift 8192 honest x 8 seeds"
+PYTHONPATH= JAX_PLATFORMS=cpu python -m \
+    wittgenstein_tpu.scenarios.emission_drift reports 8192 8 \
+    > reports/emission_8192.log 2>&1
+
 echo "[queue] reference-scale scenario sweeps (2048 x 8)"
 python tools/scenario_sweeps_2048.py > reports/sweeps_2048.log 2>&1
 
 echo "[queue] dfinity variance (32 seeds x 300 s)"
 python tools/dfinity_variance.py 32 300 > reports/dfinity_variance.log 2>&1
+
+echo "[queue] 262k cardinal on the 8-device mesh"
+WTPU_CARDINAL_N=262144 python tools/cardinal_1m.py 120 \
+    > reports/cardinal_262k.log 2>&1
 
 echo "[queue] 1M cardinal unsharded (single device; GSPMD at 1M x 8"
 echo "        partitions exceeds this host's compile/exec workspace)"

@@ -35,7 +35,13 @@ def _freeze_chunk(protocol, chunk, cont):
     """Jitted: advance every run by `chunk` ms, keeping stopped runs frozen
     at their stop-time state."""
 
-    one_chunk = scan_chunk(protocol, chunk)
+    # Every run's time is a multiple of `chunk` at chunk boundaries
+    # (frozen runs stop exactly on one), so when `chunk` is also a
+    # multiple of the protocol's static schedule lcm the phase-specialized
+    # scan applies to every run (bit-identical — tests/test_phase_hints.py).
+    lcm = getattr(protocol, "schedule_lcm", None)
+    one_chunk = scan_chunk(protocol, chunk,
+                           t0_mod=0 if (lcm and chunk % lcm == 0) else None)
 
     @jax.jit
     def chunk_all(nets, ps, stopped, stopped_at):

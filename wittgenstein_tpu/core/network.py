@@ -235,9 +235,15 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     latency draw, sorts them, and links them into per-ms buckets
     (Network.java:449-487).  Here: one latency draw per message, then the
     sort-based binning of `_bin_into_ring`.
+
+    The outbox may be NARROWER than cfg.out_deg (a contiguous slot window
+    starting at out.slot0 — see Outbox.slot0): latency draws are keyed on
+    the stable full-width slot id, so a narrow outbox whose live columns
+    carry the same slot ids produces bit-identical arrivals while the
+    binning sort runs over n * K_narrow entries instead of n * out_deg.
     """
     nodes = net.nodes
-    n, k = cfg.n, cfg.out_deg
+    n, k = cfg.n, out.dest.shape[1]
     m = n * k
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
     dest = out.dest.reshape(m)
@@ -257,7 +263,10 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     net = net.replace(nodes=nodes)
 
     seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
-    delta = prng.uniform_delta(seed_t, jnp.arange(m, dtype=jnp.int32))
+    # Stable full-width slot id (== arange(m) for a full-width outbox).
+    midx = src * cfg.out_deg + out.slot0 + \
+        jnp.arange(m, dtype=jnp.int32) % k
+    delta = prng.uniform_delta(seed_t, midx)
     lat = full_latency(model, nodes, src, dest_c, delta)
     not_discarded = lat < cfg.msg_discard_time
     # `delay` is sender-chosen scheduling (send-at-future-time,
@@ -317,8 +326,16 @@ def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
     )
 
 
-def step_ms(protocol, net: NetState, pstate):
-    """Advance the simulation by exactly one millisecond (pure, jittable)."""
+def step_ms(protocol, net: NetState, pstate, hints=None):
+    """Advance the simulation by exactly one millisecond (pure, jittable).
+
+    `hints` is an optional static phase-hint dict (see `scan_chunk`): when
+    the protocol's task schedule is statically known, it tells the step
+    which masked sub-computations cannot fire this ms so they are never
+    traced at all — the tensor analogue of the reference's empty-ms
+    skip in nextMessage (Network.java:533-570), where a ms with no events
+    costs nothing.
+    """
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
     net = _retire_broadcasts(cfg, net)
@@ -328,7 +345,11 @@ def step_ms(protocol, net: NetState, pstate):
     net = net.replace(nodes=nodes, clamped=net.clamped + bc_clamped)
 
     key = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
-    pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key)
+    if hints is None:
+        pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key)
+    else:
+        pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key,
+                                           hints=hints)
     net = net.replace(nodes=nodes)
 
     # Clear the consumed slot, then route new sends (their arrivals are
@@ -339,10 +360,67 @@ def step_ms(protocol, net: NetState, pstate):
     return net.replace(time=t + 1), pstate
 
 
-def scan_chunk(protocol, ms: int):
+def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False):
     """Returns ``run(net, pstate) -> (net, pstate)`` advancing `ms`
     milliseconds as one `lax.scan` — the single shared chunk body used by
-    `Runner`, the harness, and the sharded runner."""
+    `Runner`, the harness, and the sharded runner.
+
+    Phase specialization: protocols whose task schedule is statically
+    known (no desynchronized start, constant node speed) expose
+    ``schedule_lcm`` (the ms period after which the schedule repeats) and
+    ``phase_hints(tmod)`` (which masked sub-computations can fire at
+    ``time % lcm == tmod``).  Passing ``t0_mod`` (= entry ``net.time %
+    lcm``, usually 0) then scans over lcm-sized blocks whose body UNROLLS
+    one schedule period with per-ms static hints, so e.g. Handel's
+    [N, Q, W] verification scoring is only traced on the
+    1-in-pairing_time ms where any node can verify — the reference's own
+    empty-ms skip (Network.java:533-570), recovered under jit.  (An
+    earlier design dispatched each ms through ``lax.switch`` over the
+    distinct hint variants — much cheaper to compile, but conditionals
+    block XLA's in-place buffer aliasing, and copying the full simulator
+    carry per ms cost far more than the skipped work saved; the unrolled
+    block keeps every step inlined and alias-friendly.)  Results are
+    bit-identical to the plain path (tests/test_phase_hints.py); callers
+    must enter with ``net.time % schedule_lcm == t0_mod``.
+
+    Nearly every caller REUSES the returned function for consecutive
+    chunks, which keeps the alignment invariant only when ``ms`` is a
+    multiple of the lcm — so that is enforced here (the one central
+    guard; a config change that alters the lcm then fails loudly instead
+    of silently dispatching the wrong phases from the second chunk on).
+    A deliberately unaligned one-shot chunk may pass
+    ``allow_unaligned=True`` (the sub-lcm tail is unrolled after the
+    block scan); the next chunk's t0_mod is then ``(t0_mod + ms) % lcm``.
+    """
+    lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
+        else None
+    if lcm:
+        if ms % lcm and not allow_unaligned:
+            raise ValueError(
+                f"phase-specialized chunk length {ms} is not a multiple of "
+                f"the protocol schedule lcm {lcm}: reusing this chunk "
+                "function would misalign the phase schedule after the "
+                "first call. Use an lcm-multiple chunk, or pass "
+                "allow_unaligned=True for a one-shot chunk and track "
+                "t0_mod yourself.")
+        hints = [protocol.phase_hints((t0_mod + dt) % lcm)
+                 for dt in range(lcm)]
+        blocks, tail = divmod(ms, lcm)
+
+        def run_spec(net, pstate):
+            def body(carry, _):
+                net, ps = carry
+                for h in hints:
+                    net, ps = step_ms(protocol, net, ps, hints=h)
+                return (net, ps), ()
+            if blocks:
+                (net, pstate), _ = jax.lax.scan(body, (net, pstate),
+                                                length=blocks)
+            for h in hints[:tail]:
+                net, pstate = step_ms(protocol, net, pstate, hints=h)
+            return net, pstate
+
+        return run_spec
 
     def run(net, pstate):
         def body(carry, _):

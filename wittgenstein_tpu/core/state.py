@@ -218,10 +218,19 @@ class Outbox:
     bcast: jnp.ndarray          # bool [N]
     bcast_payload: jnp.ndarray  # int32 [N, F]
     bcast_size: jnp.ndarray     # int32 [N]
+    # Static slot-id offset for NARROW outboxes (K < cfg.out_deg): the
+    # engine keys each message's latency draw on the stable id
+    # `src * cfg.out_deg + slot0 + column`, so a step that can only use a
+    # contiguous sub-range of its outbox slots (e.g. a phase-hinted ms
+    # where just the fast-path slots are live) may return only those
+    # columns and still draw bit-identical latencies.
+    slot0: int = struct.field(pytree_node=False, default=0)
 
 
-def empty_outbox(cfg: EngineConfig) -> Outbox:
-    n, k, f = cfg.n, cfg.out_deg, cfg.payload_words
+def empty_outbox(cfg: EngineConfig, k: int | None = None,
+                 slot0: int = 0) -> Outbox:
+    n, f = cfg.n, cfg.payload_words
+    k = cfg.out_deg if k is None else k
     return Outbox(
         dest=jnp.full((n, k), -1, jnp.int32),
         payload=jnp.zeros((n, k, f), jnp.int32),
@@ -230,4 +239,5 @@ def empty_outbox(cfg: EngineConfig) -> Outbox:
         bcast=jnp.zeros((n,), bool),
         bcast_payload=jnp.zeros((n, f), jnp.int32),
         bcast_size=jnp.ones((n,), jnp.int32),
+        slot0=slot0,
     )

@@ -13,6 +13,8 @@ levels 1..5).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -20,6 +22,33 @@ from ..ops import bitset, prng
 from ..ops.flat import gather2d
 
 U32 = jnp.uint32
+
+
+class StaticScheduleMixin:
+    """Static task-schedule declaration shared by the Handel variants
+    (models/handel.py exact + models/handel_cardinal.py): verification
+    picks — and their ``pend_at = t + pairing`` completions — fire at
+    t ≡ 1 (mod pairing_time), periodic dissemination at t ≡ 1 (mod
+    period).  The schedule is static only when every node shares the
+    start (no desynchronizedStart) and the pairing time (constant-speed
+    builder, so nodePairingTime == pairing_time for all); otherwise
+    ``schedule_lcm`` is None and `core/network.scan_chunk` never
+    specializes.  Requires self.desynchronized_start, self.builder,
+    self.pairing_time, self.period."""
+
+    @property
+    def schedule_lcm(self):
+        """Period (ms) after which the task schedule repeats, or None
+        when it is data-dependent."""
+        if self.desynchronized_start or self.builder.speed != "constant":
+            return None
+        return math.lcm(max(1, self.pairing_time), max(1, self.period))
+
+    def phase_hints(self, tmod):
+        """Static phase hints for ``time % schedule_lcm == tmod``: which
+        gated sub-computations can fire this ms."""
+        return {"verify": (tmod - 1) % max(1, self.pairing_time) == 0,
+                "periodic": (tmod - 1) % max(1, self.period) == 0}
 
 
 def keyed_level_peer(seed, tag, ids, level, pos):

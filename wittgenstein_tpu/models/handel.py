@@ -63,7 +63,8 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import add2d, gather2d, gather_rows, set2d, set_rows
-from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
+from ._levels import (LevelMixin, StaticScheduleMixin,
+                      get_bit_rows as _get_bit_rows,
                       keyed_level_peer, merge_bounded_queue, sibling_base)
 
 TAG_RANK = 0x48524E4B     # reception-rank permutation keys
@@ -113,7 +114,7 @@ class HandelState:
 
 
 @register
-class Handel(LevelMixin):
+class Handel(LevelMixin, StaticScheduleMixin):
     """Parameters mirror Handel.HandelParameters (Handel.java:22-142).
 
     ``mode="cardinal"`` dispatches to the O(N*L)-state tier-3 variant
@@ -369,7 +370,8 @@ class Handel(LevelMixin):
 
     # ---------------------------------------------------------------- step
 
-    def step(self, p: HandelState, nodes, inbox, t, key):
+    def step(self, p: HandelState, nodes, inbox, t, key, hints=None):
+        h = hints or {}
         ids = jnp.arange(self.node_count, dtype=jnp.int32)
         active = (~nodes.down) & (t >= p.start_at + 1)
         onehot = None if self.prefix_pc else self._word_onehot(ids)
@@ -377,9 +379,12 @@ class Handel(LevelMixin):
         hi = ids >> 5
 
         p = self._receive(p, nodes, inbox, t)
-        p, nodes = self._apply_pending(p, nodes, t, onehot, subm, hi)
-        p = self._pick_verification(p, nodes, t, active, onehot, subm, hi)
-        p, out = self._disseminate(p, nodes, t, active, onehot, subm, hi)
+        if h.get("verify", True):
+            p, nodes = self._apply_pending(p, nodes, t, onehot, subm, hi)
+            p = self._pick_verification(p, nodes, t, active, onehot,
+                                        subm, hi)
+        p, out = self._disseminate(p, nodes, t, active, onehot, subm, hi,
+                                   periodic=h.get("periodic", True))
         return p, nodes, out
 
     # -- receive: queue incoming aggregates (onNewSig, Handel.java:753-786)
@@ -686,77 +691,93 @@ class Handel(LevelMixin):
     # -- dissemination (doCycle, :331-343,:470-504) + outbox assembly
 
     def _disseminate(self, p: HandelState, nodes, t, active,
-                     onehot, subm, hi):
+                     onehot, subm, hi, periodic=True):
         n, w, L = self.node_count, self.w, self.levels
         ids = jnp.arange(n, dtype=jnp.int32)
         done = nodes.done_at > 0
         halfs_np = self.half                                   # numpy [L]
         halfs = jnp.asarray(halfs_np)[None, :]
-
-        per_due = active & ((t - (p.start_at + 1)) % self.period == 0)
-        # extraCycle (:331-343): done nodes keep disseminating for
-        # added_cycle more periods.
-        send_ok = per_due & (~done | (p.added_cycle > 0))
-        added_cycle = jnp.where(per_due & done,
-                                jnp.maximum(p.added_cycle - 1, 0),
-                                p.added_cycle)
-
         total_inc = p.last_agg | p.ver_ind
-        inc_pc = self._level_pc(total_inc, onehot, subm, hi)   # [N, L]
-        og_size = 1 + jnp.cumsum(inc_pc, axis=1) - inc_pc      # sum l'<l + own
-        og_complete = og_size >= halfs
-        inc_complete = inc_pc >= halfs
-        lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
-        is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
-                   og_complete) & (halfs > 0)
-
-        # Candidate existence per level: any waited peer not finished and
-        # not blacklisted (else outgoingFinished, :470-504).
-        fin_pc = self._level_pc(p.finished_peers | p.blacklist, onehot,
-                                subm, hi)
-        any_cand = (halfs - fin_pc) > 0
-
-        # Round-robin pick: next non-finished peer in emission order,
-        # looking ahead `look` entries from posInLevel.
-        look = self.emission_lookahead
-        half_cols = jnp.maximum(halfs, 1)                      # [1, L]
-        offs = (p.pos[:, :, None] + jnp.arange(look)[None, None, :]) % \
-            half_cols[:, :, None]                              # [N, L, k]
-        if self.emission_mode == "stored":
-            cols = jnp.minimum(half_cols[:, :, None] + offs, n - 1)
-            cand_ids = gather2d(p.emission, ids[:, None, None], cols)
-        else:
-            cand_ids = self._emission_peer(p.seed, ids[:, None, None],
-                                           lvl_idx[:, :, None], offs)
         bad_bits = p.finished_peers | p.blacklist
-        okc = ~_get_bit_rows(bad_bits, cand_ids)               # [N, L, k]
-        found = jnp.any(okc, axis=2)
-        first = jnp.argmax(okc, axis=2)
-        # candidate at the first ok position (max trick: invalid -> -1).
-        peer = jnp.max(jnp.where(
-            okc & (jnp.arange(look)[None, None, :] == first[..., None]),
-            cand_ids, -1), axis=2)                             # [N, L]
-
-        send_l = send_ok[:, None] & is_open & any_cand & found
-        adv = per_due[:, None] & is_open & any_cand
-        pos = jnp.where(adv,
-                        (p.pos + jnp.where(found, first + 1, look)) %
-                        half_cols, p.pos)
-
         rslot = (t // self.period) % self.rounds
-        K = self.cfg.out_deg
+        # Non-periodic ms can only populate the fast-path slots: emit a
+        # NARROW outbox covering just those columns (slot ids preserved
+        # via Outbox.slot0, so latency draws stay bit-identical) — the
+        # engine's binning sort then runs over n*fast_path entries
+        # instead of n*out_deg.
+        K = self.cfg.out_deg if periodic else max(1, self.fast_path)
+        koff = L - 1 if periodic else 0
         dest = jnp.full((n, K), -1, jnp.int32)
         payload = jnp.zeros((n, K, 3), jnp.int32)
         sizes = jnp.ones((n, K), jnp.int32)
-        # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
-        sz_l = 1 + halfs // 8 + 192                            # [1, L]
-        dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
-        payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
-        payload = payload.at[:, :L - 1, 1].set(
-            inc_complete.astype(jnp.int32)[:, 1:])
-        payload = payload.at[:, :L - 1, 2].set(rslot)
-        sizes = sizes.at[:, :L - 1].set(
-            jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+
+        # `periodic=False` (static phase hint, see `scan_chunk`): no node
+        # can be on a period boundary this ms, so the per-period
+        # dissemination block below — level popcounts, open-level tests
+        # and the emission-list lookahead — reduces to the identity it
+        # would have computed (send_l all-False, pos/added_cycle
+        # unchanged, level outbox slots empty) and is skipped entirely.
+        # Only the fast path (which drains every ms) remains.
+        if periodic:
+            per_due = active & ((t - (p.start_at + 1)) % self.period == 0)
+            # extraCycle (:331-343): done nodes keep disseminating for
+            # added_cycle more periods.
+            send_ok = per_due & (~done | (p.added_cycle > 0))
+            added_cycle = jnp.where(per_due & done,
+                                    jnp.maximum(p.added_cycle - 1, 0),
+                                    p.added_cycle)
+
+            inc_pc = self._level_pc(total_inc, onehot, subm, hi)  # [N, L]
+            og_size = 1 + jnp.cumsum(inc_pc, axis=1) - inc_pc  # sum l'<l + own
+            og_complete = og_size >= halfs
+            inc_complete = inc_pc >= halfs
+            lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+            is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
+                       og_complete) & (halfs > 0)
+
+            # Candidate existence per level: any waited peer not finished and
+            # not blacklisted (else outgoingFinished, :470-504).
+            fin_pc = self._level_pc(bad_bits, onehot, subm, hi)
+            any_cand = (halfs - fin_pc) > 0
+
+            # Round-robin pick: next non-finished peer in emission order,
+            # looking ahead `look` entries from posInLevel.
+            look = self.emission_lookahead
+            half_cols = jnp.maximum(halfs, 1)                  # [1, L]
+            offs = (p.pos[:, :, None] + jnp.arange(look)[None, None, :]) % \
+                half_cols[:, :, None]                          # [N, L, k]
+            if self.emission_mode == "stored":
+                cols = jnp.minimum(half_cols[:, :, None] + offs, n - 1)
+                cand_ids = gather2d(p.emission, ids[:, None, None], cols)
+            else:
+                cand_ids = self._emission_peer(p.seed, ids[:, None, None],
+                                               lvl_idx[:, :, None], offs)
+            okc = ~_get_bit_rows(bad_bits, cand_ids)           # [N, L, k]
+            found = jnp.any(okc, axis=2)
+            first = jnp.argmax(okc, axis=2)
+            # candidate at the first ok position (max trick: invalid -> -1).
+            peer = jnp.max(jnp.where(
+                okc & (jnp.arange(look)[None, None, :] == first[..., None]),
+                cand_ids, -1), axis=2)                         # [N, L]
+
+            send_l = send_ok[:, None] & is_open & any_cand & found
+            adv = per_due[:, None] & is_open & any_cand
+            pos = jnp.where(adv,
+                            (p.pos + jnp.where(found, first + 1, look)) %
+                            half_cols, p.pos)
+
+            # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
+            sz_l = 1 + halfs // 8 + 192                        # [1, L]
+            dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
+            payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
+            payload = payload.at[:, :L - 1, 1].set(
+                inc_complete.astype(jnp.int32)[:, 1:])
+            payload = payload.at[:, :L - 1, 2].set(rslot)
+            sizes = sizes.at[:, :L - 1].set(
+                jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+        else:
+            added_cycle = p.added_cycle
+            pos = p.pos
 
         # Fast-path sends on level completion (:738-743), bypassing the
         # period gate: drain the lowest queued level's fast_path peers.
@@ -781,7 +802,6 @@ class Handel(LevelMixin):
             fok = ~_get_bit_rows(bad_bits, fids)
             fsend = (fl > 0) & active & ~done
             fdest = jnp.where(fsend[:, None] & fok, fids, -1)
-            koff = L - 1
             dest = dest.at[:, koff:koff + fp].set(fdest)
             payload = payload.at[:, koff:koff + fp, 0].set(fl[:, None])
             payload = payload.at[:, koff:koff + fp, 2].set(rslot)
@@ -803,8 +823,9 @@ class Handel(LevelMixin):
         else:
             pool = p.pool
 
-        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
-                                             size=sizes)
+        out = empty_outbox(self.cfg, k=K,
+                           slot0=0 if periodic else L - 1).replace(
+            dest=dest, payload=payload, size=sizes)
         return p.replace(pos=pos, added_cycle=added_cycle, pool=pool,
                          fast_pending=fast_pending), out
 

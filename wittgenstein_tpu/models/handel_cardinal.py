@@ -63,7 +63,8 @@ from ..core.protocol import register
 from ..core.state import EngineConfig, empty_outbox, init_net
 from ..ops import bitset, prng
 from ..ops.flat import gather2d, set2d
-from ._levels import (LevelMixin, get_bit_rows as _get_bit_rows,
+from ._levels import (LevelMixin, StaticScheduleMixin,
+                      get_bit_rows as _get_bit_rows,
                       keyed_level_peer, merge_bounded_queue, sibling_base)
 from .handel import TAG_BAD, TAG_EMIT, TAG_LEVEL, TAG_RANK, TAG_START
 
@@ -101,7 +102,7 @@ class HandelCardinalState:
 
 
 @register
-class HandelCardinal(LevelMixin):
+class HandelCardinal(LevelMixin, StaticScheduleMixin):
     """O(N*L)-state Handel; construct directly or via Handel(mode="cardinal").
 
     Parameters mirror Handel.HandelParameters (Handel.java:22-142) minus the
@@ -264,12 +265,15 @@ class HandelCardinal(LevelMixin):
 
     # ---------------------------------------------------------------- step
 
-    def step(self, p: HandelCardinalState, nodes, inbox, t, key):
+    def step(self, p: HandelCardinalState, nodes, inbox, t, key, hints=None):
+        h = hints or {}
         active = (~nodes.down) & (t >= p.start_at + 1)
         p = self._receive(p, nodes, inbox, t)
-        p, nodes = self._apply_pending(p, nodes, t)
-        p = self._pick_verification(p, nodes, t, active)
-        p, out = self._disseminate(p, nodes, t, active)
+        if h.get("verify", True):
+            p, nodes = self._apply_pending(p, nodes, t)
+            p = self._pick_verification(p, nodes, t, active)
+        p, out = self._disseminate(p, nodes, t, active,
+                                   periodic=h.get("periodic", True))
         return p, nodes, out
 
     # -- receive: queue incoming counts (onNewSig, Handel.java:753-786)
@@ -519,52 +523,65 @@ class HandelCardinal(LevelMixin):
 
     # -- dissemination (doCycle, :331-343,:470-504) + outbox assembly
 
-    def _disseminate(self, p: HandelCardinalState, nodes, t, active):
+    def _disseminate(self, p: HandelCardinalState, nodes, t, active,
+                     periodic=True):
         n, L = self.node_count, self.levels
         ids = jnp.arange(n, dtype=jnp.int32)
         done = nodes.done_at > 0
         halfs_np = self.half
         halfs = jnp.asarray(halfs_np)[None, :]
-
-        per_due = active & ((t - (p.start_at + 1)) % self.period == 0)
-        send_ok = per_due & (~done | (p.added_cycle > 0))
-        added_cycle = jnp.where(per_due & done,
-                                jnp.maximum(p.added_cycle - 1, 0),
-                                p.added_cycle)
-
         og_size = 1 + jnp.cumsum(p.lvl_best, axis=1) - p.lvl_best  # [N, L]
-        og_complete = og_size >= halfs
-        inc_complete = p.lvl_best >= halfs
-        lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
-        is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
-                   og_complete) & (halfs > 0)
-
-        # Round-robin through the keyed emission permutation.  No
-        # finishedPeers/blacklist candidate filtering in cardinal mode
-        # (O(N^2) bits; the skip is a traffic optimization, :470-504).
-        peer = self._emission_peer(p.seed, ids[:, None], lvl_idx, p.pos)
-        send_l = send_ok[:, None] & is_open
-        adv = per_due[:, None] & is_open
-        half_cols = jnp.maximum(halfs, 1)
-        pos = jnp.where(adv, (p.pos + 1) % half_cols, p.pos)
-
-        K = self.cfg.out_deg
+        # Non-periodic ms can only populate the fast-path slots: narrow
+        # outbox with preserved slot ids (Outbox.slot0) — see
+        # models/handel.py._disseminate.
+        K = self.cfg.out_deg if periodic else max(1, self.fast_path)
+        koff = L - 1 if periodic else 0
         dest = jnp.full((n, K), -1, jnp.int32)
         payload = jnp.zeros((n, K, 3), jnp.int32)
         sizes = jnp.ones((n, K), jnp.int32)
-        # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
-        sz_l = 1 + halfs // 8 + 192                            # [1, L]
-        dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
-        payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
-        # Word 1 (levelFinished flag) is wire-format parity with exact
-        # mode only: cardinal receivers ignore it (no finishedPeers
-        # tracking), but message introspection tooling still sees the
-        # same 3-word layout.
-        payload = payload.at[:, :L - 1, 1].set(
-            inc_complete.astype(jnp.int32)[:, 1:])
-        payload = payload.at[:, :L - 1, 2].set(og_size[:, 1:])
-        sizes = sizes.at[:, :L - 1].set(
-            jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+
+        # `periodic=False` (static phase hint, see core/network.scan_chunk):
+        # no node can be on a period boundary, so the per-period block is
+        # the identity (send_l all-False, pos/added_cycle unchanged) and
+        # only the every-ms fast path below remains.
+        if periodic:
+            per_due = active & ((t - (p.start_at + 1)) % self.period == 0)
+            send_ok = per_due & (~done | (p.added_cycle > 0))
+            added_cycle = jnp.where(per_due & done,
+                                    jnp.maximum(p.added_cycle - 1, 0),
+                                    p.added_cycle)
+
+            og_complete = og_size >= halfs
+            inc_complete = p.lvl_best >= halfs
+            lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+            is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
+                       og_complete) & (halfs > 0)
+
+            # Round-robin through the keyed emission permutation.  No
+            # finishedPeers/blacklist candidate filtering in cardinal mode
+            # (O(N^2) bits; the skip is a traffic optimization, :470-504).
+            peer = self._emission_peer(p.seed, ids[:, None], lvl_idx, p.pos)
+            send_l = send_ok[:, None] & is_open
+            adv = per_due[:, None] & is_open
+            half_cols = jnp.maximum(halfs, 1)
+            pos = jnp.where(adv, (p.pos + 1) % half_cols, p.pos)
+
+            # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
+            sz_l = 1 + halfs // 8 + 192                        # [1, L]
+            dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
+            payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
+            # Word 1 (levelFinished flag) is wire-format parity with exact
+            # mode only: cardinal receivers ignore it (no finishedPeers
+            # tracking), but message introspection tooling still sees the
+            # same 3-word layout.
+            payload = payload.at[:, :L - 1, 1].set(
+                inc_complete.astype(jnp.int32)[:, 1:])
+            payload = payload.at[:, :L - 1, 2].set(og_size[:, 1:])
+            sizes = sizes.at[:, :L - 1].set(
+                jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+        else:
+            added_cycle = p.added_cycle
+            pos = p.pos
 
         # Fast-path sends on level completion (:738-743).
         fast_pending = p.fast_pending
@@ -584,7 +601,6 @@ class HandelCardinal(LevelMixin):
             fsend = (fl > 0) & active & ~done
             fdest = jnp.where(fsend[:, None], fids, -1)
             fcnt = gather2d(og_size, ids, fl)
-            koff = L - 1
             dest = dest.at[:, koff:koff + fp].set(fdest)
             payload = payload.at[:, koff:koff + fp, 0].set(fl[:, None])
             payload = payload.at[:, koff:koff + fp, 2].set(fcnt[:, None])
@@ -597,8 +613,9 @@ class HandelCardinal(LevelMixin):
                                      fast_pending)
             fast_pending = jnp.where(done, 0, fast_pending)
 
-        out = empty_outbox(self.cfg).replace(dest=dest, payload=payload,
-                                             size=sizes)
+        out = empty_outbox(self.cfg, k=K,
+                           slot0=0 if periodic else L - 1).replace(
+            dest=dest, payload=payload, size=sizes)
         return p.replace(pos=pos, added_cycle=added_cycle,
                          fast_pending=fast_pending), out
 

@@ -265,14 +265,17 @@ class ShardedRunner:
                                   t % cfg.horizon].set(0))
 
             # ---- split outbox by destination shard ----
-            m = nl * k
+            # Width may be narrower than cfg.out_deg (Outbox.slot0): the
+            # latency key below stays on the full-width slot id.
+            ke = out.dest.shape[1]
+            m = nl * ke
             gids = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
-            src_g = jnp.repeat(gids, k)
+            src_g = jnp.repeat(gids, ke)
             dest = out.dest.reshape(m)
             payload = out.payload.reshape(m, fw)
             size = out.size.reshape(m)
             delay = out.delay.reshape(m)
-            want = (dest >= 0) & (~nodes.down[jnp.arange(m) // k])
+            want = (dest >= 0) & (~nodes.down[jnp.arange(m) // ke])
             dshard = jnp.clip(dest, 0, cfg.n - 1) // nl
             # rank within destination-shard group
             order = jnp.argsort(jnp.where(want, dshard, S), stable=True)
@@ -292,17 +295,17 @@ class ShardedRunner:
             b_payload = scatter(payload, 0)
             b_size = scatter(size, 0)
             b_delay = scatter(delay, 0)
-            # Global flat message index (src_g * k + outbox slot): the
+            # Global stable message index (src_g * out_deg + slot id): the
             # single-chip engine keys its latency delta on exactly this
             # (enqueue_unicast), so carrying it through the exchange keeps
             # jittered models bit-identical to the unsharded run.
-            b_midx = scatter(src_g * k + idx % k, 0)
+            b_midx = scatter(src_g * k + out.slot0 + idx % ke, 0)
             xdrop = jnp.sum((ds_s < S) & ~ok_s).astype(jnp.int32)
 
             # counters for attempted sends (parity with enqueue_unicast)
-            sent = nodes.msg_sent.at[jnp.arange(m) // k].add(
+            sent = nodes.msg_sent.at[jnp.arange(m) // ke].add(
                 want.astype(jnp.int32))
-            sbytes = nodes.bytes_sent.at[jnp.arange(m) // k].add(
+            sbytes = nodes.bytes_sent.at[jnp.arange(m) // ke].add(
                 jnp.where(want, size, 0))
             net = net.replace(nodes=nodes.replace(msg_sent=sent,
                                                   bytes_sent=sbytes))
