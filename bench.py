@@ -32,23 +32,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact"):
+def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
+                 horizon=256, inbox_cap=12):
     from wittgenstein_tpu.core.network import scan_chunk
     from wittgenstein_tpu.models.handel import Handel
 
     down = n // 10
-    kw = {}
+    # Ring sizing is engine CAPACITY, not protocol semantics: the asserts
+    # below require zero drops/clamps/evictions, so an undersized ring
+    # fails loudly rather than silently changing behavior.  hz 256 /
+    # inbox 12 measured drop-free at the headline config and keeps every
+    # ring plane under the TPU runtime's ~1 GB single-buffer execution
+    # limit for larger seed batches (BENCH_NOTES.md round 3).
+    kw = dict(horizon=horizon, inbox_cap=inbox_cap)
     if mode == "cardinal" and n > 32768:
-        # Tier-2 config: bounded ring for the int32 flat-index limit
-        # (3 * 256 * n * 8 < 2^31 up to ~349k nodes).  Past that the ring
-        # must shrink below what ByDistanceWJitter's latency tail allows
-        # on one chip — use tools/cardinal_1m.py (mesh sharding + a
-        # bounded-latency model) for the 1M-class evidence runs.
-        if n > 349_000:
-            raise ValueError(
-                "cardinal bench supports n <= ~349k on one chip; see "
-                "tools/cardinal_1m.py for larger runs")
-        kw = dict(queue_cap=8, inbox_cap=8, horizon=256)
+        # Tier-2 caps layered ON TOP of the requested sizing (never
+        # silently above it): bounded queue + ring keep the state in one
+        # chip's HBM (per-plane int32 flat indexing now reaches ~1M nodes
+        # at 256*n*8; memory binds first — SCALE.md).  Use
+        # tools/cardinal_1m.py (mesh sharding + a bounded-latency model)
+        # for 1M-class runs.
+        kw = dict(queue_cap=8, inbox_cap=min(inbox_cap, 8),
+                  horizon=min(horizon, 256))
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,
@@ -56,8 +61,11 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact"):
     # t0_mod=0: runs start at time 0 and `chunk` is a multiple of the
     # schedule lcm, so the phase-specialized scan applies (bit-identical,
     # tests/test_phase_hints.py) — masked verification/dissemination work
-    # is only traced on the ms where it can fire.
+    # is only traced on the ms where it can fire.  WTPU_BENCH_SPEC=0
+    # forces the plain per-ms scan (debug/bisect knob).
     lcm = getattr(proto, "schedule_lcm", None)
+    if os.environ.get("WTPU_BENCH_SPEC") == "0":
+        lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
     step = jax.jit(jax.vmap(scan_chunk(proto, chunk, t0_mod=t0)))
     nets, ps = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
@@ -145,14 +153,41 @@ def main():
                    WTPU_BENCH_SEEDS=str(min(
                        2, int(os.environ.get("WTPU_BENCH_SEEDS", 2)))),
                    WTPU_BENCH_MS=str(min(
-                       1000, int(os.environ.get("WTPU_BENCH_MS", 1000)))))
+                       1000, int(os.environ.get("WTPU_BENCH_MS", 1000)))),
+                   WTPU_BENCH_HORIZON=str(min(256, int(
+                       os.environ.get("WTPU_BENCH_HORIZON", 256)))),
+                   WTPU_BENCH_INBOX=str(min(12, int(
+                       os.environ.get("WTPU_BENCH_INBOX", 12)))))
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
     n = int(os.environ.get("WTPU_BENCH_NODES", 2048))
-    seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 8))
+    seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 16))
     sim_ms = int(os.environ.get("WTPU_BENCH_MS", 1000))
     mode = os.environ.get("WTPU_BENCH_MODE", "exact")
-    agg = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms, mode=mode)
+    horizon = int(os.environ.get("WTPU_BENCH_HORIZON", 256))
+    inbox_cap = int(os.environ.get("WTPU_BENCH_INBOX", 12))
+    try:
+        agg = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms, mode=mode,
+                           horizon=horizon, inbox_cap=inbox_cap)
+    except jax.errors.JaxRuntimeError as e:
+        # The axon TPU runtime faults ("UNAVAILABLE: TPU device error")
+        # or OOMs on working sets that scale with the seed batch (first
+        # observed 2026-07-31, BENCH_NOTES.md) — and a device fault
+        # POISONS the process, so degrade by re-exec'ing with half the
+        # seeds rather than reporting nothing.  The metric name keeps the
+        # actual seed count, so a degraded number is self-describing.
+        # Only these seed-count-dependent signatures degrade; anything
+        # else (INVALID_ARGUMENT, compile errors) surfaces immediately.
+        if seeds <= 1 or not ("UNAVAILABLE" in str(e) or
+                              "RESOURCE_EXHAUSTED" in str(e) or
+                              "ResourceExhausted" in str(e)):
+            raise
+        print(f"bench: device fault at {n}n x {seeds} seeds ({e!s:.200});"
+              f" retrying in a fresh process with {seeds // 2} seeds",
+              file=sys.stderr)
+        env = dict(os.environ, WTPU_BENCH_SEEDS=str(seeds // 2))
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
     suffix = "_cpu_fallback" if fallback else ""
     if mode != "exact":
         suffix = f"_{mode}{suffix}"

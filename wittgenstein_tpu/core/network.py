@@ -82,12 +82,11 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     nodes = net.nodes
     n, c, b, f = cfg.n, cfg.inbox_cap, cfg.bcast_slots, cfg.payload_words
     h = t % cfg.horizon
-    hnc_total = cfg.horizon * n * c
 
-    # --- unicast slice: contiguous [N*C] window per field at h*N*C ---
+    # --- unicast slice: contiguous [N*C] window per plane at h*N*C ---
     base = h * (n * c)
     uc_data = jnp.stack(
-        [jax.lax.dynamic_slice(net.box_data, (fi * hnc_total + base,),
+        [jax.lax.dynamic_slice(net.box_data[fi], (base,),
                                (n * c,)).reshape(n, c)
          for fi in range(f)], axis=-1)              # [N, C, F]
     uc_src = jax.lax.dynamic_slice(net.box_src, (base,),
@@ -164,13 +163,10 @@ def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
     flat = (h_s * n + d_s) * c + jnp.where(ok_s, slot, 0)
     flat_w = jnp.where(ok_s, flat, hnc)
     payload_s = payload[order]
-    box_data = net.box_data
-    for fi in range(cfg.payload_words):
-        # OOB sentinel must clear the WHOLE [F*hnc] array, not field fi's
-        # window, so dropped entries never write into field fi+1.
-        idx_f = jnp.where(ok_s, fi * hnc + flat, cfg.payload_words * hnc)
-        box_data = box_data.at[idx_f].set(
-            payload_s[:, fi], mode="drop", unique_indices=True)
+    box_data = tuple(
+        net.box_data[fi].at[flat_w].set(payload_s[:, fi], mode="drop",
+                                        unique_indices=True)
+        for fi in range(cfg.payload_words))
     box_src = net.box_src.at[flat_w].set(src[order], mode="drop",
                                          unique_indices=True)
     box_size = net.box_size.at[flat_w].set(size[order], mode="drop",

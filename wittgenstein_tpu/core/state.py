@@ -123,8 +123,12 @@ class NetState:
     # scan-carry layout and the scatter/slice layouts agree — multi-dim ring
     # buffers made XLA:TPU relayout the whole ring every iteration (hundreds
     # of MB/step).  Cell (h, n, c) lives at flat index (h*N + n)*C + c; the
-    # F payload words are field-major at f*H*N*C + idx.
-    box_data: jnp.ndarray       # int32 [F * H*N*C]
+    # F payload words live in F separate PLANES (a tuple of [H*N*C] arrays,
+    # not one [F*H*N*C] buffer): the TPU runtime faults on executions
+    # touching single buffers past ~1 GB (observed 2026-07-31 at 2048 nodes
+    # x 8 vmapped seeds), and per-plane scatters need no cross-field OOB
+    # sentinel arithmetic.
+    box_data: tuple             # F x int32 [H*N*C]
     box_src: jnp.ndarray        # int32 [H*N*C]
     box_size: jnp.ndarray       # int32 [H*N*C]
     box_count: jnp.ndarray      # int32 [H, N] — slots filled per (ms, node)
@@ -151,12 +155,13 @@ class NetState:
 def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
     h, n, c, f, b = (cfg.horizon, cfg.n, cfg.inbox_cap, cfg.payload_words,
                      cfg.bcast_slots)
-    if f * h * n * c >= 1 << 31:
-        # Flat ring indices are int32; beyond this the single-chip mailbox
-        # must be sharded (the node axis partitions cleanly across devices).
+    if h * n * c >= 1 << 31:
+        # Flat ring indices are int32, per payload-word plane; beyond this
+        # the single-chip mailbox must be sharded (the node axis
+        # partitions cleanly across devices).
         raise ValueError(
             f"mailbox ring too large for int32 flat indexing: "
-            f"{f}x{h}x{n}x{c} >= 2^31; shrink horizon/inbox_cap or shard "
+            f"{h}x{n}x{c} >= 2^31; shrink horizon/inbox_cap or shard "
             f"the node axis across devices")
     return NetState(
         time=jnp.asarray(0, jnp.int32),
@@ -165,7 +170,8 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
         # appear twice in an executable's arguments.
         seed=jnp.asarray(seed, jnp.int32) + 0,
         nodes=nodes,
-        box_data=jnp.zeros((f * h * n * c,), jnp.int32),
+        box_data=tuple(jnp.zeros((h * n * c,), jnp.int32)
+                       for _ in range(f)),
         box_src=jnp.zeros((h * n * c,), jnp.int32),
         box_size=jnp.zeros((h * n * c,), jnp.int32),
         box_count=jnp.zeros((h, n), jnp.int32),

@@ -150,10 +150,9 @@ class ShardedRunner:
         nl, c, b, f = lcfg.n, cfg.inbox_cap, cfg.bcast_slots, \
             cfg.payload_words
         h = t % cfg.horizon
-        hnc_total = cfg.horizon * nl * c
         base = h * (nl * c)
         uc_data = jnp.stack(
-            [jax.lax.dynamic_slice(net.box_data, (fi * hnc_total + base,),
+            [jax.lax.dynamic_slice(net.box_data[fi], (base,),
                                    (nl * c,)).reshape(nl, c)
              for fi in range(f)], axis=-1)
         uc_src = jax.lax.dynamic_slice(net.box_src, (base,),
@@ -369,11 +368,10 @@ class ShardedRunner:
                 jnp.where(ok2, slot2, 0)
             flat_w = jnp.where(ok2, flat, hnc)
             pl_s = r_payload[order2]
-            box_data = net.box_data
-            for fi in range(fw):
-                idx_f = jnp.where(ok2, fi * hnc + flat, fw * hnc)
-                box_data = box_data.at[idx_f].set(pl_s[:, fi], mode="drop",
-                                                  unique_indices=True)
+            box_data = tuple(
+                net.box_data[fi].at[flat_w].set(pl_s[:, fi], mode="drop",
+                                                unique_indices=True)
+                for fi in range(fw))
             box_src = net.box_src.at[flat_w].set(r_src[order2], mode="drop",
                                                  unique_indices=True)
             box_size = net.box_size.at[flat_w].set(r_size[order2],

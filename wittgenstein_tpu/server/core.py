@@ -208,7 +208,7 @@ class Server:
 
         count = np.asarray(self.net.box_count)                   # [H, N]
         src = np.asarray(self.net.box_src).reshape(H, n, c)
-        data = np.asarray(self.net.box_data)[:f * H * n * c].reshape(
+        data = np.stack([np.asarray(p) for p in self.net.box_data]).reshape(
             f, H, n, c)
         for h in np.nonzero(count.sum(axis=1))[0]:
             arriving = t + int((int(h) - t) % H)
