@@ -46,13 +46,16 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     # limit for larger seed batches (BENCH_NOTES.md round 3).
     kw = dict(horizon=horizon, inbox_cap=inbox_cap)
     if mode == "cardinal" and n > 32768:
-        # Tier-2 caps layered ON TOP of the requested sizing (never
-        # silently above it): bounded queue + ring keep the state in one
-        # chip's HBM (per-plane int32 flat indexing now reaches ~1M nodes
-        # at 256*n*8; memory binds first — SCALE.md).  Use
-        # tools/cardinal_1m.py (mesh sharding + a bounded-latency model)
-        # for 1M-class runs.
-        kw = dict(queue_cap=8, inbox_cap=min(inbox_cap, 8),
+        # Tier-2: bounded queue + ring keep the state in one chip's HBM
+        # (per-plane int32 flat indexing now reaches ~1M nodes at
+        # 256*n*8; memory binds first — SCALE.md).  inbox_cap is honored
+        # as passed (main() picks a tier-appropriate default); horizon
+        # never exceeds the tier bound.  Use tools/cardinal_1m.py (mesh
+        # sharding + a bounded-latency model) for 1M-class runs.
+        # queue_cap 16: cardinal queue columns are [N, Q] int32 (no
+        # [N, Q, W] sig rows), so the larger cap costs ~4 MB at 65k and
+        # avoids the evictions queue_cap=8 shows there.
+        kw = dict(queue_cap=16, inbox_cap=inbox_cap,
                   horizon=min(horizon, 256))
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
@@ -165,6 +168,8 @@ def main():
     sim_ms = int(os.environ.get("WTPU_BENCH_MS", 1000))
     mode = os.environ.get("WTPU_BENCH_MODE", "exact")
     horizon = int(os.environ.get("WTPU_BENCH_HORIZON", 256))
+    # inbox 12 measured drop-free at both the 2048-node headline config
+    # and the 65536-node cardinal tier-2 config (BENCH_NOTES.md r3).
     inbox_cap = int(os.environ.get("WTPU_BENCH_INBOX", 12))
     try:
         agg = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms, mode=mode,
@@ -180,7 +185,8 @@ def main():
         # else (INVALID_ARGUMENT, compile errors) surfaces immediately.
         if seeds <= 1 or not ("UNAVAILABLE" in str(e) or
                               "RESOURCE_EXHAUSTED" in str(e) or
-                              "ResourceExhausted" in str(e)):
+                              "ResourceExhausted" in str(e) or
+                              "Ran out of memory" in str(e)):
             raise
         print(f"bench: device fault at {n}n x {seeds} seeds ({e!s:.200});"
               f" retrying in a fresh process with {seeds // 2} seeds",
