@@ -28,7 +28,13 @@ def gather_rows(arr3, i, j):
     ~1.5 GB/s on the TPU runtime inside the simulator scan — the layout
     the scan picks defeats element gathers — and was 39% of the whole
     Handel step at 2048 nodes; the row form measured 1.6x faster
-    end-to-end on-chip (2026-07-31 A/B)."""
+    end-to-end on-chip (2026-07-31 A/B).
+
+    Semantic note (not just a perf rewrite): `mode="clip"` clamps
+    out-of-range row indices, whereas the old flat-index form followed
+    jnp negative-index wrap semantics.  Callers must pass NON-NEGATIVE
+    indices (all current ones do: box_src is zero-initialized, slot/level
+    indices come from argmax or are clamped)."""
     a, b, c = arr3.shape
     return jnp.take(arr3.reshape(a * b, c), i * b + j, axis=0, mode="clip")
 
