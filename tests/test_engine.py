@@ -285,3 +285,47 @@ def test_runner_big_donation_bit_identical():
     assert r._split is not None and len(r._split[1]) > 0
     for a, b in zip(jax.tree.leaves((n1, p1)), jax.tree.leaves((n2, p2))):
         assert jnp.array_equal(a, b)
+
+
+def test_box_split_bit_equal():
+    """EngineConfig.box_split (node-range ring sub-planes — the TPU
+    runtime's ~1 GB single-buffer workaround at 100k-1M nodes) must be a
+    pure layout change: full-pytree bit-equality at any P, including the
+    reassembled inbox slices and every scatter path."""
+    import dataclasses
+    from wittgenstein_tpu.models.handel import Handel
+    outs = []
+    for p in (1, 2, 4):
+        proto = Handel(node_count=128, nodes_down=12, threshold=114,
+                       pairing_time=4, dissemination_period_ms=20)
+        proto.cfg = dataclasses.replace(proto.cfg, box_split=p)
+        r = Runner(proto, donate=False)
+        net, ps = proto.init(3)
+        net, ps = r.run_ms(net, ps, 300)
+        outs.append((net, ps))
+    import numpy as np
+    base_net, base_ps = outs[0]
+    # Compare the LOGICAL ring (concatenated sub-planes) + all other state.
+    def logical(net, ps, p):
+        cfg_h, cfg_n, cfg_c = 512, 128, 16
+        ns = cfg_n // p
+        def cat(planes):
+            return np.concatenate(
+                [np.asarray(pl).reshape(cfg_h, ns, cfg_c) for pl in planes],
+                axis=1)
+        f = len(net.box_data) // p
+        data = [cat(net.box_data[fi * p:(fi + 1) * p]) for fi in range(f)]
+        rest = [x for x in jax.tree.leaves((net, ps))
+                if not any(x is y for y in
+                           (*net.box_data, *net.box_src, *net.box_size))]
+        return data, cat(net.box_src), cat(net.box_size), rest
+    d0, s0, z0, rest0 = logical(base_net, base_ps, 1)
+    for (net, ps), p in zip(outs[1:], (2, 4)):
+        d, s, z, rest = logical(net, ps, p)
+        for a, b in zip(d0, d):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(s0, s)
+        np.testing.assert_array_equal(z0, z)
+        assert len(rest0) == len(rest)
+        for a, b in zip(rest0, rest):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,103 @@
+"""2-ms super-step (core/network.step_2ms) — bit-equality with the plain
+per-ms path.
+
+The engine's minimum latency is 1 ms, so a send at t arrives no earlier
+than t+2: nothing produced inside a (t, t+1) pair is consumed inside it.
+The super-step exploits that to fuse the pair's inbox reads, ring binning
+(one sort over both outboxes) and slot clears — halving the engine's
+per-ms fixed op count, which is the dominant cost in the op-latency-bound
+regime (BENCH_NOTES.md r3).  The fusion must be EXACTLY a no-op on
+results: these tests assert full pytree equality against the per-ms scan
+for a broadcast-using protocol (PingPong), the flagship (Handel, both
+with and without phase specialization, including the odd-lcm hint
+doubling), and cardinal mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.network import scan_chunk
+from wittgenstein_tpu.models.handel import Handel
+from wittgenstein_tpu.models.pingpong import PingPong
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _run_pair(proto, ms, seeds=2, t0_mod=None):
+    plain = jax.jit(jax.vmap(scan_chunk(proto, ms, t0_mod=t0_mod)))
+    fused = jax.jit(jax.vmap(scan_chunk(proto, ms, t0_mod=t0_mod,
+                                        superstep=2)))
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    out_plain = plain(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    out_fused = fused(nets, ps)
+    return out_plain, out_fused
+
+
+def test_superstep_pingpong_broadcasts():
+    # PingPong sendAlls through the broadcast table: covers the
+    # retire/enqueue interleaving the super-step must preserve.
+    proto = PingPong(node_count=64)
+    a, b = _run_pair(proto, 40)
+    _trees_equal(a, b)
+    _, ps = b
+    assert int(np.asarray(ps.pongs).sum()) > 0
+
+
+def test_superstep_handel_plain_scan():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+    a, b = _run_pair(proto, 80)
+    _trees_equal(a, b)
+    _, ps = b
+    assert int(np.asarray(ps.sigs_checked).sum()) > 0
+
+
+def test_superstep_handel_phase_specialized():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+    assert proto.schedule_lcm == 20
+    a, b = _run_pair(proto, 120, t0_mod=0)
+    _trees_equal(a, b)
+
+
+def test_superstep_handel_odd_lcm_doubles():
+    # pairing 3 x period 5 -> lcm 15 (odd): the super-step pairs hints
+    # across a doubled 30-ms super-period.
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=3, dissemination_period_ms=5,
+                   level_wait_time=50, fast_path=10)
+    assert proto.schedule_lcm == 15
+    a, b = _run_pair(proto, 60, t0_mod=0)
+    _trees_equal(a, b)
+
+
+def test_superstep_handel_cardinal():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   fast_path=10, mode="cardinal")
+    a, b = _run_pair(proto, 80, t0_mod=0)
+    _trees_equal(a, b)
+
+
+def test_superstep_rejects_bad_configs():
+    import dataclasses
+    proto = Handel(node_count=64, threshold=60, nodes_down=0)
+    with pytest.raises(ValueError, match="even chunk"):
+        scan_chunk(proto, 41, superstep=2)
+    with pytest.raises(ValueError, match="even entry"):
+        scan_chunk(proto, 40, t0_mod=1, superstep=2)
+    spill_proto = Handel(node_count=64, threshold=60, nodes_down=0)
+    spill_proto.cfg = dataclasses.replace(spill_proto.cfg, spill_cap=8)
+    with pytest.raises(ValueError, match="spill_cap"):
+        scan_chunk(spill_proto, 40, superstep=2)

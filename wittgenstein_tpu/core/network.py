@@ -40,9 +40,9 @@ from .latency import full_latency
 from .state import EngineConfig, Inbox, NetState, Outbox
 
 
-def _retire_broadcasts(cfg: EngineConfig, net: NetState) -> NetState:
+def _retire_broadcasts(cfg: EngineConfig, net: NetState, t) -> NetState:
     # A broadcast's last possible arrival is bc_time + horizon - 1.
-    live = net.bc_active & ((net.time - net.bc_time) < cfg.horizon)
+    live = net.bc_active & ((t - net.bc_time) < cfg.horizon)
     return net.replace(bc_active=live)
 
 
@@ -81,22 +81,43 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     """
     nodes = net.nodes
     n, c, b, f = cfg.n, cfg.inbox_cap, cfg.bcast_slots, cfg.payload_words
+    p, ns = cfg.box_split, cfg.split_n
     h = t % cfg.horizon
 
-    # --- unicast slice: contiguous [N*C] window per plane at h*N*C ---
-    base = h * (n * c)
+    # --- unicast slice: contiguous [Ns*C] window per sub-plane at
+    # h*Ns*C, node-range sub-planes concatenated back to [N, C] ---
+    base = h * (ns * c)
+
+    def rd(plane):
+        return jax.lax.dynamic_slice(plane, (base,),
+                                     (ns * c,)).reshape(ns, c)
+
+    def rd_all(planes):
+        if p == 1:
+            return rd(planes[0])
+        return jnp.concatenate([rd(pl) for pl in planes], axis=0)
+
     uc_data = jnp.stack(
-        [jax.lax.dynamic_slice(net.box_data[fi], (base,),
-                               (n * c,)).reshape(n, c)
-         for fi in range(f)], axis=-1)              # [N, C, F]
-    uc_src = jax.lax.dynamic_slice(net.box_src, (base,),
-                                   (n * c,)).reshape(n, c)
-    uc_size = jax.lax.dynamic_slice(net.box_size, (base,),
-                                    (n * c,)).reshape(n, c)
+        [rd_all(net.box_data[fi * p:(fi + 1) * p]) for fi in range(f)],
+        axis=-1)                                    # [N, C, F]
+    uc_src = rd_all(net.box_src)
+    uc_size = rd_all(net.box_size)
     uc_valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
     deliver_ok = (~nodes.down[:, None]) & (
         nodes.partition[uc_src] == nodes.partition[:, None])
     uc_valid = uc_valid & deliver_ok
+
+    if b == 0:
+        # Static no-broadcast path (protocols that never sendAll set
+        # bcast_slots=0): no [B, N] latency recompute, and the inbox IS
+        # the unicast slice — no concatenate materializing a copy.
+        recv = jnp.sum(uc_valid, 1).astype(jnp.int32)
+        rbytes = jnp.sum(jnp.where(uc_valid, uc_size, 0), 1).astype(
+            jnp.int32)
+        nodes = nodes.replace(msg_received=nodes.msg_received + recv,
+                              bytes_received=nodes.bytes_received + rbytes)
+        inbox = Inbox(data=uc_data, src=uc_src, valid=uc_valid)
+        return inbox, nodes, jnp.asarray(0, jnp.int32)
 
     # --- broadcast recompute: which records arrive at exactly t? ---
     arrival, bc_ok, clamped = broadcast_arrivals(cfg, model, net, nodes)
@@ -131,8 +152,11 @@ def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
     A stable sort on (arrival, dest) bins messages into ring slots; rank
     within a (ms, dest) group + the current fill count gives each message
     its slot.  `dest` must already be clipped to [0, n); arrivals must lie
-    within the ring (rel in [1, horizon-1]).  Returns (net', n_dropped) —
-    entries that found their (ms, dest) cell full.
+    within the ring: rel = arrival - t in [1, horizon-1] for the per-ms
+    path, or [2, horizon] for the fused `step_2ms` path — rel == horizon
+    lands in the row t % horizon, which is valid ONLY because step_2ms
+    clears both consumed rows BEFORE binning (do not reorder).  Returns
+    (net', n_dropped) — entries that found their (ms, dest) cell full.
     """
     n, c = cfg.n, cfg.inbox_cap
     m = src.shape[0]
@@ -157,25 +181,36 @@ def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
     slot = net.box_count[h_s, d_s] + rank
     ok_s = ok_s & (slot < c)
 
-    # Flat 1-D scatters (cell (h, d, slot) at (h*N + d)*C + slot); the flat
-    # total size is the OOB sentinel for dropped entries.
-    hnc = cfg.horizon * n * c
-    flat = (h_s * n + d_s) * c + jnp.where(ok_s, slot, 0)
-    flat_w = jnp.where(ok_s, flat, hnc)
+    # Flat 1-D scatters per node-range sub-plane (cell (h, d, slot) at
+    # (h*Ns + d - j*Ns)*C + slot of sub-plane j = d // Ns); each
+    # sub-plane's total size is the OOB sentinel for entries that belong
+    # to another sub-plane or were dropped.
+    p, ns = cfg.box_split, cfg.split_n
+    f = cfg.payload_words
     payload_s = payload[order]
-    box_data = tuple(
-        net.box_data[fi].at[flat_w].set(payload_s[:, fi], mode="drop",
-                                        unique_indices=True)
-        for fi in range(cfg.payload_words))
-    box_src = net.box_src.at[flat_w].set(src[order], mode="drop",
-                                         unique_indices=True)
-    box_size = net.box_size.at[flat_w].set(size[order], mode="drop",
-                                           unique_indices=True)
+    src_s, size_s = src[order], size[order]
+    box_data = list(net.box_data)
+    box_src = list(net.box_src)
+    box_size = list(net.box_size)
+    sub_total = cfg.horizon * ns * c
+    for j in range(p):
+        dj = d_s - j * ns
+        in_j = ok_s & (dj >= 0) & (dj < ns)
+        flat_j = (h_s * ns + dj) * c + jnp.where(in_j, slot, 0)
+        flat_jw = jnp.where(in_j, flat_j, sub_total)
+        for fi in range(f):
+            box_data[fi * p + j] = box_data[fi * p + j].at[flat_jw].set(
+                payload_s[:, fi], mode="drop", unique_indices=True)
+        box_src[j] = box_src[j].at[flat_jw].set(src_s, mode="drop",
+                                                unique_indices=True)
+        box_size[j] = box_size[j].at[flat_jw].set(size_s, mode="drop",
+                                                  unique_indices=True)
     box_count = net.box_count.at[h_s, d_s].add(ok_s.astype(jnp.int32),
                                                mode="drop")
     n_dropped = jnp.sum(valid[order] & ~ok_s).astype(jnp.int32)
-    return net.replace(box_data=box_data, box_src=box_src,
-                       box_size=box_size, box_count=box_count), n_dropped
+    return net.replace(box_data=tuple(box_data), box_src=tuple(box_src),
+                       box_size=tuple(box_size), box_count=box_count), \
+        n_dropped
 
 
 def _alloc_free_slots(free, want):
@@ -224,13 +259,13 @@ def _drain_spill(cfg: EngineConfig, net: NetState, t):
         dropped=net2.dropped + n_drop)
 
 
-def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
-    """Route the step's unicast sends into the mailbox ring.
+def _route_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
+    """Shared unicast routing: sender counters, latency draws, validity.
 
-    The reference creates one MessageArrival per destination with a fresh
-    latency draw, sorts them, and links them into per-ms buckets
-    (Network.java:449-487).  Here: one latency draw per message, then the
-    sort-based binning of `_bin_into_ring`.
+    Returns ``(net', batch, abs_arrival_raw)`` where `batch` is the
+    binnable tuple ``(src, dest_c, arrival, payload, size, valid, far)``
+    — `arrival` already clamped into the ring relative to t, and
+    `abs_arrival_raw` the unclamped absolute arrival (spill parking).
 
     The outbox may be NARROWER than cfg.out_deg (a contiguous slot window
     starting at out.slot0 — see Outbox.slot0): latency draws are keyed on
@@ -252,9 +287,12 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
 
     # Attempted sends bump the sender's counters regardless of whether the
     # destination is reachable (Network.java:475-477 increments before the
-    # partition/down checks).
-    sent = nodes.msg_sent.at[src].add(want.astype(jnp.int32))
-    sbytes = nodes.bytes_sent.at[src].add(jnp.where(want, size, 0))
+    # partition/down checks).  src is repeat(arange(n), k), so the
+    # scatter-add is just a per-row sum.
+    sent = nodes.msg_sent + jnp.sum(
+        want.reshape(n, k), axis=1, dtype=jnp.int32)
+    sbytes = nodes.bytes_sent + jnp.sum(
+        jnp.where(want, size, 0).reshape(n, k), axis=1, dtype=jnp.int32)
     nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
     net = net.replace(nodes=nodes)
 
@@ -275,8 +313,22 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
     valid = want & not_discarded & (~nodes.down[dest_c]) & (
         nodes.partition[src] == nodes.partition[dest_c])
     far = valid & (raw_total > cfg.horizon - 2)
+    batch = (src, dest_c, t + 1 + total, payload, size, valid, far)
+    return net, batch, t + 1 + raw_total
+
+
+def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
+    """Route the step's unicast sends into the mailbox ring.
+
+    The reference creates one MessageArrival per destination with a fresh
+    latency draw, sorts them, and links them into per-ms buckets
+    (Network.java:449-487).  Here: one latency draw per message, then the
+    sort-based binning of `_bin_into_ring`.
+    """
+    net, batch, arrival_raw = _route_unicast(cfg, model, net, out, t)
+    src, dest_c, arrival, payload, size, valid, far = batch
     if cfg.spill_cap > 0:
-        net = _park_in_spill(cfg, net, src, dest_c, t + 1 + raw_total,
+        net = _park_in_spill(cfg, net, src, dest_c, arrival_raw,
                              payload, size, far)
         ring_valid = valid & ~far
         n_clamped = jnp.asarray(0, jnp.int32)
@@ -284,7 +336,6 @@ def enqueue_unicast(cfg: EngineConfig, model, net: NetState, out: Outbox, t):
         ring_valid = valid
         n_clamped = jnp.sum(far).astype(jnp.int32)
 
-    arrival = t + 1 + total
     net, n_dropped = _bin_into_ring(cfg, net, t, src, dest_c, arrival,
                                     payload, size, ring_valid)
     return net.replace(dropped=net.dropped + n_dropped,
@@ -334,7 +385,8 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
     """
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
-    net = _retire_broadcasts(cfg, net)
+    if cfg.bcast_slots > 0:
+        net = _retire_broadcasts(cfg, net, t)
     if cfg.spill_cap > 0:
         net = _drain_spill(cfg, net, t)
     inbox, nodes, bc_clamped = build_inbox(cfg, model, net, t)
@@ -352,11 +404,96 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
     # >= t+2, so they can never land in the slot just cleared).
     net = net.replace(box_count=net.box_count.at[t % cfg.horizon].set(0))
     net = enqueue_unicast(cfg, model, net, out, t)
-    net = enqueue_broadcast(cfg, net, out, t)
+    if cfg.bcast_slots > 0:
+        net = enqueue_broadcast(cfg, net, out, t)
     return net.replace(time=t + 1), pstate
 
 
-def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False):
+def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
+    """Advance TWO milliseconds in one fused engine pass.
+
+    Bit-identical to two `step_ms` calls (tests/test_superstep.py), because
+    the engine's minimum latency is 1 ms: a send at t arrives no earlier
+    than t+2, so nothing produced inside the pair can be consumed inside
+    the pair.  That licenses:
+
+      * both inbox slices read up-front (one contiguous 2-slot window —
+        sends at t cannot land at t+1);
+      * ONE sort-based binning over both steps' outboxes (keyed on
+        (rel, dest) with rel relative to t, spanning [2, horizon]; batch
+        order inside a (ms, dest) cell equals the sequential order the
+        per-ms path produces, so slots are identical);
+      * both consumed ring slots cleared with one 2-row update.
+
+    This halves the engine's per-ms fixed cost (sorts, scatter passes,
+    slices, clears) — the op-latency-bound regime's dominant term
+    (BENCH_NOTES.md r3).  Broadcast-table ordering is preserved exactly
+    (retire(t) .. enqueue(t), retire(t+1), enqueue(t+1) — records
+    expiring at t+1 contribute no arrivals at t or t+1, so the up-front
+    inbox reads are unaffected).
+
+    Requirements (enforced by `scan_chunk(superstep=2)`): spill_cap == 0,
+    horizon even, entry time even.
+    """
+    cfg, model = protocol.cfg, protocol.latency
+    if cfg.spill_cap > 0:
+        raise ValueError("step_2ms requires spill_cap == 0 (spill drain "
+                         "is inherently per-ms)")
+    t = net.time
+    if cfg.bcast_slots > 0:
+        net = _retire_broadcasts(cfg, net, t)
+
+    inbox0, nodes, cl0 = build_inbox(cfg, model, net, t)
+    net = net.replace(nodes=nodes, clamped=net.clamped + cl0)
+    inbox1, nodes, cl1 = build_inbox(cfg, model, net, t + 1)
+    net = net.replace(nodes=nodes, clamped=net.clamped + cl1)
+
+    key0 = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
+    key1 = jax.random.fold_in(jax.random.PRNGKey(net.seed), t + 1)
+    if hints2[0] is None:
+        pstate, nodes, out0 = protocol.step(pstate, net.nodes, inbox0, t,
+                                            key0)
+    else:
+        pstate, nodes, out0 = protocol.step(pstate, net.nodes, inbox0, t,
+                                            key0, hints=hints2[0])
+    net = net.replace(nodes=nodes)
+    if hints2[1] is None:
+        pstate, nodes, out1 = protocol.step(pstate, net.nodes, inbox1,
+                                            t + 1, key1)
+    else:
+        pstate, nodes, out1 = protocol.step(pstate, net.nodes, inbox1,
+                                            t + 1, key1, hints=hints2[1])
+    net = net.replace(nodes=nodes)
+
+    # Clear both consumed slots in one 2-row window (h even, no wrap).
+    h = t % cfg.horizon
+    net = net.replace(box_count=jax.lax.dynamic_update_slice(
+        net.box_count, jnp.zeros((2, cfg.n), jnp.int32), (h, 0)))
+
+    # Route both outboxes (latency draws keyed on each step's own t),
+    # then bin them together: one sort + one scatter pass for two ms.
+    net, b0, _ = _route_unicast(cfg, model, net, out0, t)
+    net, b1, _ = _route_unicast(cfg, model, net, out1, t + 1)
+    src = jnp.concatenate([b0[0], b1[0]])
+    dest = jnp.concatenate([b0[1], b1[1]])
+    arrival = jnp.concatenate([b0[2], b1[2]])
+    payload = jnp.concatenate([b0[3], b1[3]])
+    size = jnp.concatenate([b0[4], b1[4]])
+    valid = jnp.concatenate([b0[5], b1[5]])
+    n_clamped = (jnp.sum(b0[6]) + jnp.sum(b1[6])).astype(jnp.int32)
+    net, n_dropped = _bin_into_ring(cfg, net, t, src, dest, arrival,
+                                    payload, size, valid)
+    net = net.replace(dropped=net.dropped + n_dropped,
+                      clamped=net.clamped + n_clamped)
+    if cfg.bcast_slots > 0:
+        net = enqueue_broadcast(cfg, net, out0, t)
+        net = _retire_broadcasts(cfg, net, t + 1)
+        net = enqueue_broadcast(cfg, net, out1, t + 1)
+    return net.replace(time=t + 2), pstate
+
+
+def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
+               superstep: int = 1):
     """Returns ``run(net, pstate) -> (net, pstate)`` advancing `ms`
     milliseconds as one `lax.scan` — the single shared chunk body used by
     `Runner`, the harness, and the sharded runner.
@@ -388,8 +525,31 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False):
     ``allow_unaligned=True`` (the sub-lcm tail is unrolled after the
     block scan); the next chunk's t0_mod is then ``(t0_mod + ms) % lcm``.
     """
+    if superstep not in (1, 2):
+        raise ValueError(f"superstep must be 1 or 2, got {superstep}")
+    if superstep == 2:
+        # step_2ms preconditions (see its docstring).  Entry-time evenness
+        # cannot be checked statically for t0_mod=None callers; every
+        # in-tree driver enters at an even time (init time=0, even
+        # chunks), and the phase-specialized path checks t0_mod below.
+        cfg = protocol.cfg
+        if cfg.spill_cap > 0 or cfg.horizon % 2 or ms % 2:
+            raise ValueError(
+                f"superstep=2 needs spill_cap == 0 (got {cfg.spill_cap}), "
+                f"an even horizon (got {cfg.horizon}) and an even chunk "
+                f"(got {ms})")
+        if getattr(protocol, "mutates_liveness", False):
+            raise ValueError(
+                "superstep=2 is invalid for protocols whose step() mutates "
+                "node liveness (down flags): the second ms's inbox is "
+                "built before the first ms's step runs")
+        if t0_mod is not None and t0_mod % 2:
+            raise ValueError(f"superstep=2 needs an even entry time "
+                             f"(t0_mod={t0_mod})")
     lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
         else None
+    if lcm and superstep == 2 and lcm % 2:
+        lcm *= 2                    # pair hints across an even super-period
     if lcm:
         if ms % lcm and not allow_unaligned:
             raise ValueError(
@@ -399,15 +559,21 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False):
                 "first call. Use an lcm-multiple chunk, or pass "
                 "allow_unaligned=True for a one-shot chunk and track "
                 "t0_mod yourself.")
-        hints = [protocol.phase_hints((t0_mod + dt) % lcm)
+        sched = getattr(protocol, "schedule_lcm")
+        hints = [protocol.phase_hints((t0_mod + dt) % sched)
                  for dt in range(lcm)]
         blocks, tail = divmod(ms, lcm)
 
         def run_spec(net, pstate):
             def body(carry, _):
                 net, ps = carry
-                for h in hints:
-                    net, ps = step_ms(protocol, net, ps, hints=h)
+                if superstep == 2:
+                    for i in range(0, len(hints), 2):
+                        net, ps = step_2ms(protocol, net, ps,
+                                           hints2=(hints[i], hints[i + 1]))
+                else:
+                    for h in hints:
+                        net, ps = step_ms(protocol, net, ps, hints=h)
                 return (net, ps), ()
             if blocks:
                 (net, pstate), _ = jax.lax.scan(body, (net, pstate),
@@ -417,6 +583,16 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False):
             return net, pstate
 
         return run_spec
+
+    if superstep == 2:
+        def run2(net, pstate):
+            def body(carry, _):
+                return step_2ms(protocol, *carry), ()
+            (net2, p2), _ = jax.lax.scan(body, (net, pstate),
+                                         length=ms // 2)
+            return net2, p2
+
+        return run2
 
     def run(net, pstate):
         def body(carry, _):
@@ -452,7 +628,7 @@ class Runner:
     """
 
     def __init__(self, protocol, donate="auto", chunk_limit=10_000,
-                 donate_threshold=1 << 20):
+                 donate_threshold=1 << 20, superstep=1):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
@@ -462,10 +638,21 @@ class Runner:
         self._split = None          # (treedef, big_idx) for donate="big"
         self._validated = False
         self.chunk_limit = chunk_limit
+        # superstep=2 fuses engine work across ms pairs (step_2ms,
+        # bit-identical).  Applied per chunk only when the chunk length
+        # and the entry time are even and the config allows it; otherwise
+        # that chunk silently runs the per-ms path (results identical).
+        if superstep == 2:
+            cfg = protocol.cfg
+            if (cfg.spill_cap > 0 or cfg.horizon % 2
+                    or getattr(protocol, "mutates_liveness", False)):
+                superstep = 1
+        self._superstep = superstep
 
-    def _chunk_fn(self, ms):
-        if ms not in self._jits:
-            base = scan_chunk(self.protocol, ms)
+    def _chunk_fn(self, ms, superstep=1):
+        key = (ms, superstep)
+        if key not in self._jits:
+            base = scan_chunk(self.protocol, ms, superstep=superstep)
             if self._donate == "big":
                 treedef, big_idx = self._split
 
@@ -477,11 +664,11 @@ class Runner:
                     net, ps = jax.tree.unflatten(treedef, leaves)
                     return base(net, ps)
 
-                self._jits[ms] = jax.jit(split_run, donate_argnums=(0,))
+                self._jits[key] = jax.jit(split_run, donate_argnums=(0,))
             else:
                 kw = {"donate_argnums": (0, 1)} if self._donate else {}
-                self._jits[ms] = jax.jit(base, **kw)
-        return self._jits[ms]
+                self._jits[key] = jax.jit(base, **kw)
+        return self._jits[key]
 
     def _call(self, fn, net, pstate):
         if self._donate != "big":
@@ -506,14 +693,30 @@ class Runner:
                 i for i, x in enumerate(leaves)
                 if x.size * x.dtype.itemsize >= self._donate_threshold))
         ms = int(ms)
+        # Per-chunk superstep eligibility: even chunk + (statically
+        # checkable) even entry time; a tracer entry time conservatively
+        # falls back to the per-ms path.  The entry-time readback blocks
+        # on the previous chunk, so it only happens when superstep is
+        # actually enabled — the default path keeps fully async dispatch.
+        t_entry = None
+        if self._superstep == 2 and not isinstance(net.time,
+                                                   jax.core.Tracer):
+            t_entry = int(jax.device_get(net.time).reshape(-1)[0])
+        def eff(chunk_ms, t0):
+            return 2 if (self._superstep == 2 and chunk_ms % 2 == 0
+                         and t0 is not None and t0 % 2 == 0) else 1
         if self.chunk_limit and ms > self.chunk_limit:
             # n_chunks equal pieces + one remainder piece at most: two
             # compiled programs for any length.
             whole, rem = divmod(ms, self.chunk_limit)
-            fn = self._chunk_fn(self.chunk_limit)
+            fn = self._chunk_fn(self.chunk_limit,
+                                eff(self.chunk_limit, t_entry))
             for _ in range(whole):
                 net, pstate = self._call(fn, net, pstate)
+                if t_entry is not None:
+                    t_entry += self.chunk_limit
             if rem:
-                net, pstate = self._call(self._chunk_fn(rem), net, pstate)
+                net, pstate = self._call(
+                    self._chunk_fn(rem, eff(rem, t_entry)), net, pstate)
             return net, pstate
-        return self._call(self._chunk_fn(ms), net, pstate)
+        return self._call(self._chunk_fn(ms, eff(ms, t_entry)), net, pstate)

@@ -56,10 +56,23 @@ class EngineConfig:
     bcast_slots: int = 4        # B: max concurrently in-flight broadcasts
     msg_discard_time: int = 1 << 30
     spill_cap: int = 0          # S: far-future parked messages (0 = clamp)
+    # P: ring planes are split into P node-range sub-planes of N/P nodes
+    # each.  The TPU runtime faults on executions touching any single
+    # buffer past ~1 GB (BENCH_NOTES.md r3), which capped cardinal mode
+    # at 65k nodes/chip and exact-mode seed batches at 16; splitting by
+    # node range keeps every sub-plane under the limit while the flat
+    # per-sub-plane layout stays identical (bit-equal for any P —
+    # tests/test_engine.py::test_box_split_bit_equal).
+    box_split: int = 1
 
     @property
     def inbox_width(self):
         return self.inbox_cap + self.bcast_slots
+
+    @property
+    def split_n(self):
+        """Nodes per ring sub-plane."""
+        return self.n // self.box_split
 
 
 @struct.dataclass
@@ -122,15 +135,18 @@ class NetState:
     # Unicast mailbox ring, logically [H, N, C] but stored FLAT (1-D) so the
     # scan-carry layout and the scatter/slice layouts agree — multi-dim ring
     # buffers made XLA:TPU relayout the whole ring every iteration (hundreds
-    # of MB/step).  Cell (h, n, c) lives at flat index (h*N + n)*C + c; the
-    # F payload words live in F separate PLANES (a tuple of [H*N*C] arrays,
-    # not one [F*H*N*C] buffer): the TPU runtime faults on executions
-    # touching single buffers past ~1 GB (observed 2026-07-31 at 2048 nodes
-    # x 8 vmapped seeds), and per-plane scatters need no cross-field OOB
-    # sentinel arithmetic.
-    box_data: tuple             # F x int32 [H*N*C]
-    box_src: jnp.ndarray        # int32 [H*N*C]
-    box_size: jnp.ndarray       # int32 [H*N*C]
+    # of MB/step).  The F payload words live in F separate PLANES (not one
+    # [F*H*N*C] buffer): the TPU runtime faults on executions touching
+    # single buffers past ~1 GB (observed 2026-07-31 at 2048 nodes x 8
+    # vmapped seeds), and per-plane scatters need no cross-field OOB
+    # sentinel arithmetic.  Each plane is further split into
+    # P = cfg.box_split node-range SUB-planes of Ns = N/P nodes (same
+    # buffer-size limit, at 100k-1M node counts): cell (h, n, c) with
+    # n in sub-range j lives at flat index (h*Ns + n - j*Ns)*C + c of
+    # sub-plane j.  P == 1 reproduces the round-3 layout exactly.
+    box_data: tuple             # F*P x int32 [H*Ns*C] (plane f*P + j)
+    box_src: tuple              # P x int32 [H*Ns*C]
+    box_size: tuple             # P x int32 [H*Ns*C]
     box_count: jnp.ndarray      # int32 [H, N] — slots filled per (ms, node)
     # Broadcast table [B] (sendAll with recomputed per-dest latencies):
     bc_active: jnp.ndarray      # bool [B]
@@ -155,14 +171,17 @@ class NetState:
 def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
     h, n, c, f, b = (cfg.horizon, cfg.n, cfg.inbox_cap, cfg.payload_words,
                      cfg.bcast_slots)
-    if h * n * c >= 1 << 31:
-        # Flat ring indices are int32, per payload-word plane; beyond this
-        # the single-chip mailbox must be sharded (the node axis
-        # partitions cleanly across devices).
+    p = cfg.box_split
+    if n % p:
+        raise ValueError(f"box_split {p} must divide node count {n}")
+    ns = cfg.split_n
+    if h * ns * c >= 1 << 31:
+        # Flat ring indices are int32, per sub-plane; beyond this raise
+        # box_split or shard the node axis across devices.
         raise ValueError(
-            f"mailbox ring too large for int32 flat indexing: "
-            f"{h}x{n}x{c} >= 2^31; shrink horizon/inbox_cap or shard "
-            f"the node axis across devices")
+            f"mailbox ring sub-plane too large for int32 flat indexing: "
+            f"{h}x{ns}x{c} >= 2^31; shrink horizon/inbox_cap or raise "
+            f"box_split / shard the node axis across devices")
     return NetState(
         time=jnp.asarray(0, jnp.int32),
         # + 0 forces a fresh buffer: protocols keep their own copy of the
@@ -170,10 +189,12 @@ def init_net(cfg: EngineConfig, nodes: NodeState, seed) -> NetState:
         # appear twice in an executable's arguments.
         seed=jnp.asarray(seed, jnp.int32) + 0,
         nodes=nodes,
-        box_data=tuple(jnp.zeros((h * n * c,), jnp.int32)
-                       for _ in range(f)),
-        box_src=jnp.zeros((h * n * c,), jnp.int32),
-        box_size=jnp.zeros((h * n * c,), jnp.int32),
+        box_data=tuple(jnp.zeros((h * ns * c,), jnp.int32)
+                       for _ in range(f * p)),
+        box_src=tuple(jnp.zeros((h * ns * c,), jnp.int32)
+                      for _ in range(p)),
+        box_size=tuple(jnp.zeros((h * ns * c,), jnp.int32)
+                       for _ in range(p)),
         box_count=jnp.zeros((h, n), jnp.int32),
         bc_active=jnp.zeros((b,), bool),
         bc_src=jnp.zeros((b,), jnp.int32),

@@ -81,6 +81,11 @@ class ENRState:
 class ENRGossiping:
     """Parameters mirror ENRParameters (ENRGossiping.java:26-106)."""
 
+    # Churn mutates nodes.down inside step() (joins/exits) — the fused
+    # 2-ms super-step would read stale liveness for the second ms
+    # (core/network.scan_chunk rejects superstep=2 for this protocol).
+    mutates_liveness = True
+
     def __init__(self, time_to_change=60_000, cap_gossip_time=10_000,
                  discard_time=100, time_to_leave=60_000, total_peers=5,
                  nodes=50, changing_nodes=10.0, max_peers=50,

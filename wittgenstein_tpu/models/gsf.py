@@ -132,7 +132,7 @@ class GSFSignature(LevelMixin):
         k = (self.levels - 1) + self.accel
         self.cfg = EngineConfig(n=node_count, horizon=horizon,
                                 inbox_cap=inbox_cap, payload_words=3,
-                                out_deg=k, bcast_slots=1)
+                                out_deg=k, bcast_slots=0)
 
     # ------------------------------------------------------------ primitives
 

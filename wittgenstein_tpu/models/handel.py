@@ -239,7 +239,7 @@ class Handel(LevelMixin, StaticScheduleMixin):
         k = (self.levels - 1) + fast_path
         self.cfg = EngineConfig(n=node_count, horizon=horizon,
                                 inbox_cap=inbox_cap, payload_words=3,
-                                out_deg=k, bcast_slots=1)
+                                out_deg=k, bcast_slots=0)
 
     # ------------------------------------------------------------ primitives
 
@@ -823,8 +823,13 @@ class Handel(LevelMixin, StaticScheduleMixin):
         else:
             pool = p.pool
 
+        # slot0 clamped into [0, out_deg): with fast_path == 0 the narrow
+        # non-periodic outbox is a single always-empty column (dest all
+        # -1), and slot0 == L-1 == out_deg would collide its stable
+        # latency-key slot id with the next node's slot 0 (ADVICE r3).
         out = empty_outbox(self.cfg, k=K,
-                           slot0=0 if periodic else L - 1).replace(
+                           slot0=0 if periodic else
+                           min(L - 1, self.cfg.out_deg - 1)).replace(
             dest=dest, payload=payload, size=sizes)
         return p.replace(pos=pos, added_cycle=added_cycle, pool=pool,
                          fast_pending=fast_pending), out

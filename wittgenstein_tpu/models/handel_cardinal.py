@@ -169,7 +169,7 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
         k = (self.levels - 1) + fast_path
         self.cfg = EngineConfig(n=node_count, horizon=horizon,
                                 inbox_cap=inbox_cap, payload_words=3,
-                                out_deg=k, bcast_slots=1)
+                                out_deg=k, bcast_slots=0)
 
     # ------------------------------------------------------------ primitives
 
@@ -613,8 +613,11 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
                                      fast_pending)
             fast_pending = jnp.where(done, 0, fast_pending)
 
+        # slot0 clamped into [0, out_deg) — see models/handel.py (the
+        # fast_path == 0 narrow-outbox slot-id collision, ADVICE r3).
         out = empty_outbox(self.cfg, k=K,
-                           slot0=0 if periodic else L - 1).replace(
+                           slot0=0 if periodic else
+                           min(L - 1, self.cfg.out_deg - 1)).replace(
             dest=dest, payload=payload, size=sizes)
         return p.replace(pos=pos, added_cycle=added_cycle,
                          fast_pending=fast_pending), out

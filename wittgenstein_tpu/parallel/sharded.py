@@ -82,6 +82,11 @@ class ShardedRunner:
             raise NotImplementedError(
                 "ShardedRunner does not support EngineConfig.spill_cap > 0;"
                 " size `horizon` for the protocol instead")
+        if protocol.cfg.box_split != 1:
+            raise NotImplementedError(
+                "ShardedRunner shards the ring by node range itself; use "
+                "box_split == 1 (sub-plane splitting is a single-chip "
+                "buffer-limit workaround)")
         self.protocol = protocol
         self.mesh = mesh
         self.n_shards = mesh.shape["sp"]
@@ -155,9 +160,9 @@ class ShardedRunner:
             [jax.lax.dynamic_slice(net.box_data[fi], (base,),
                                    (nl * c,)).reshape(nl, c)
              for fi in range(f)], axis=-1)
-        uc_src = jax.lax.dynamic_slice(net.box_src, (base,),
+        uc_src = jax.lax.dynamic_slice(net.box_src[0], (base,),
                                        (nl * c,)).reshape(nl, c)
-        uc_size = jax.lax.dynamic_slice(net.box_size, (base,),
+        uc_size = jax.lax.dynamic_slice(net.box_size[0], (base,),
                                         (nl * c,)).reshape(nl, c)
         uc_valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
         uc_valid = uc_valid & (~nodes.down[:, None])
@@ -372,11 +377,10 @@ class ShardedRunner:
                 net.box_data[fi].at[flat_w].set(pl_s[:, fi], mode="drop",
                                                 unique_indices=True)
                 for fi in range(fw))
-            box_src = net.box_src.at[flat_w].set(r_src[order2], mode="drop",
-                                                 unique_indices=True)
-            box_size = net.box_size.at[flat_w].set(r_size[order2],
-                                                   mode="drop",
-                                                   unique_indices=True)
+            box_src = (net.box_src[0].at[flat_w].set(
+                r_src[order2], mode="drop", unique_indices=True),)
+            box_size = (net.box_size[0].at[flat_w].set(
+                r_size[order2], mode="drop", unique_indices=True),)
             box_count = net.box_count.at[
                 jnp.clip(h_s, 0, cfg.horizon - 1),
                 jnp.clip(d_s, 0, nl - 1)].add(ok2.astype(jnp.int32),

@@ -207,9 +207,18 @@ class Server:
         out = []
 
         count = np.asarray(self.net.box_count)                   # [H, N]
-        src = np.asarray(self.net.box_src).reshape(H, n, c)
-        data = np.stack([np.asarray(p) for p in self.net.box_data]).reshape(
-            f, H, n, c)
+        # Sub-planes (EngineConfig.box_split) reassemble along the node
+        # axis: sub-plane j holds nodes [j*Ns, (j+1)*Ns) as [H, Ns, C].
+        p, ns = cfg.box_split, cfg.split_n
+        src = np.concatenate(
+            [np.asarray(pl).reshape(H, ns, c) for pl in self.net.box_src],
+            axis=1)                                              # [H, N, C]
+        data = np.stack(
+            [np.concatenate([np.asarray(pl).reshape(H, ns, c)
+                             for pl in self.net.box_data[fi * p:
+                                                         (fi + 1) * p]],
+                            axis=1)
+             for fi in range(f)])                                # [F,H,N,C]
         for h in np.nonzero(count.sum(axis=1))[0]:
             arriving = t + int((int(h) - t) % H)
             for d in np.nonzero(count[h])[0]:
