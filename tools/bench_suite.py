@@ -1,0 +1,155 @@
+"""Multi-config benchmark: the BASELINE.md tracked configs, one honest
+JSON line each (VERDICT r3 next #7).
+
+Configs (BASELINE.md "Tracked configs"):
+  * PingPong 1k    — the README example (README.md:123-135 curve)
+  * GSFSignature 4k
+  * SanFermin 32k
+  * Dfinity 10k validators (10 BPs + 10,000 attesters, rotating
+    100-attester committees)
+
+Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
+module (the same one `bench.py` uses — ONE implementation of the
+un-fakeable protocol).  A config that faults or fails its convergence
+assert emits an `"error"` line instead of killing the suite.
+
+Usage: python tools/bench_suite.py [config ...]   (default: all)
+Output: one JSON line per config on stdout.
+"""
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax                                        # noqa: E402
+import jax.numpy as jnp                           # noqa: E402
+import numpy as np                                # noqa: E402
+
+from wittgenstein_tpu.core.network import scan_chunk   # noqa: E402
+from wittgenstein_tpu.utils.measure import timed_chunks  # noqa: E402
+
+
+def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
+               superstep=1):
+    """Build the jitted step/init for one config and measure it."""
+    sc = scan_chunk(proto, chunk, t0_mod=t0_mod, superstep=superstep)
+    if seeds is None:
+        step = jax.jit(sc)
+        init = lambda: jax.jit(proto.init)(jnp.asarray(0, jnp.int32))  # noqa: E731
+    else:
+        step = jax.jit(jax.vmap(sc))
+        init = lambda: jax.vmap(proto.init)(                           # noqa: E731
+            jnp.arange(seeds, dtype=jnp.int32))
+    steps = max(1, -(-sim_ms // chunk))
+    out = timed_chunks(step, init, steps, seeds or 1, chunk, check,
+                       reps=reps)
+    out.update(sim_ms=steps * chunk, batch=seeds or 1,
+               platform=jax.default_backend())
+    return out
+
+
+def bench_pingpong():
+    """README example: 1000 nodes, ByDistanceWJitter; every pong is back
+    at the witness by 800 ms (README.md:123-135: 1000 at 700 ms)."""
+    from wittgenstein_tpu.models.pingpong import PingPong
+    proto = PingPong(node_count=1000)
+    # 4 seeds: the [seeds, H*N*C] mailbox planes stay at 524 MB, under
+    # the TPU runtime's ~1 GB single-buffer limit (BENCH_NOTES.md r3).
+    seeds = 4
+
+    def check(nets, ps):
+        pongs = np.asarray(ps.pongs)
+        dropped = int(np.asarray(nets.dropped).sum())
+        assert dropped == 0, f"dropped={dropped}"
+        assert (pongs >= 1000).all(), f"pongs={pongs.tolist()}"
+        return {"pongs_min": int(pongs.min())}
+
+    return run_config(proto, seeds, 800, 100, check)
+
+
+def bench_gsf():
+    from wittgenstein_tpu.models.gsf import GSFSignature
+    proto = GSFSignature(node_count=4096)      # threshold 0.99N
+    seeds = 4
+
+    def check(nets, ps):
+        done_at = np.asarray(nets.nodes.done_at)
+        dropped = int(np.asarray(nets.dropped).sum())
+        clamped = int(np.asarray(nets.clamped).sum())
+        frac = (done_at > 0).mean()
+        assert dropped == 0 and clamped == 0, (dropped, clamped)
+        assert frac > 0.99, f"frac_done={frac:.3f}"
+        return {"frac_done": round(float(frac), 4)}
+
+    return run_config(proto, seeds, 2500, 250, check)
+
+
+def bench_sanfermin():
+    """32k nodes.  inbox_cap 8 keeps each mailbox plane (H*N*C int32)
+    at 512 MB, under the TPU runtime's ~1 GB single-buffer execution
+    limit (BENCH_NOTES.md r3)."""
+    from wittgenstein_tpu.models.sanfermin import SanFermin
+    proto = SanFermin(node_count=32768, inbox_cap=8)
+    seeds = None                                # single seed, unbatched
+
+    def check(nets, ps):
+        done_at = np.asarray(nets.nodes.done_at)
+        dropped = int(np.asarray(nets.dropped).sum())
+        finished = done_at[done_at > 0]
+        stranded = 1.0 - finished.size / done_at.size
+        assert dropped == 0, f"dropped={dropped}"
+        # The reference itself strands candidate-exhausted nodes
+        # (SanFerminSignature.java:330-340); small tail allowed.
+        assert stranded <= 0.02, f"stranded={stranded:.1%}"
+        return {"stranded_pct": round(100 * stranded, 2),
+                "done_mean_ms": round(float(finished.mean()), 1)}
+
+    return run_config(proto, seeds, 6000, 500, check)
+
+
+def bench_dfinity():
+    """10k validators: 10 block producers + 10,000 attesters in rotating
+    100-attester committees, ~3 s per height (Dfinity.java:467-481
+    pacing), 120 simulated seconds."""
+    from wittgenstein_tpu.models.dfinity import Dfinity
+    proto = Dfinity(block_producers_count=10, attesters_count=10_000,
+                    attesters_per_round=100, block_capacity=512)
+    seeds = None
+
+    def check(nets, ps):
+        heights = np.asarray(ps.arena.height)[np.asarray(ps.head)]
+        dropped = int(np.asarray(nets.dropped).sum())
+        arena_dropped = int(np.asarray(ps.arena.dropped))
+        assert dropped == 0 and arena_dropped == 0, (dropped, arena_dropped)
+        assert heights.min() == heights.max(), "nodes disagree on height"
+        assert heights.max() >= 30, f"height={heights.max()} after 120 s"
+        return {"height": int(heights.max())}
+
+    return run_config(proto, seeds, 120_000, 2000, check)
+
+
+CONFIGS = {
+    "pingpong_1000n": bench_pingpong,
+    "gsf_4096n": bench_gsf,
+    "sanfermin_32768n": bench_sanfermin,
+    "dfinity_10k_validators": bench_dfinity,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        try:
+            res = CONFIGS[name]()
+            res = {"metric": f"{name}_agg_sim_ms_per_sec", **res}
+        except Exception as e:                  # noqa: BLE001 — per-config
+            res = {"metric": f"{name}_agg_sim_ms_per_sec",
+                   "error": f"{type(e).__name__}: {e!s:.300}"}
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

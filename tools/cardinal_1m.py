@@ -1,12 +1,20 @@
-"""Tier-3 evidence run: 1M-node cardinal Handel on the virtual 8-device mesh.
+"""Tier-3 evidence run: 1M-node cardinal Handel.
 
-Builds HandelCardinal at node_count=2^20, GSPMD-shards the node axis over an
-8-device virtual CPU mesh (the same layout dryrun_multichip validates), runs
->= 100 simulated ms, and asserts zero drops/clamps/evictions.  Writes
-reports/CARDINAL_1M.md with wall-clock, per-ms cost, peak RSS, and the state
-memory breakdown (SCALE.md tier-3 design -> measured).
+Builds HandelCardinal at node_count=2^20 and either (a) GSPMD-shards the
+node axis over an n-device virtual CPU mesh (the same layout
+dryrun_multichip validates), or (b) with WTPU_CARDINAL_PLATFORM=tpu runs
+single-device on the REAL chip (state 11.7 GB vs 16 GB HBM; the mailbox
+ring is split into node-range sub-planes, EngineConfig.box_split, to
+stay under the runtime's ~1 GB single-buffer execution limit).  Runs
+>= 100 simulated ms and asserts zero drops/clamps/evictions.  Writes
+reports/CARDINAL_<label>.md — every config-dependent value in the
+report prose is derived from the live config (the r3 template hardcoded
+them, which produced a mislabeled report; BENCH_NOTES.md postmortem).
 
 Usage:  python tools/cardinal_1m.py [sim_ms]    (default 120)
+Env:    WTPU_CARDINAL_N (default 2^20), WTPU_CARDINAL_DEVS (default 8),
+        WTPU_CARDINAL_PLATFORM=tpu (real chip, forces DEVS=1),
+        WTPU_CARDINAL_SPLIT (box_split override)
 """
 
 import pathlib
@@ -21,21 +29,25 @@ import os  # noqa: E402
 
 from wittgenstein_tpu.utils.platform import force_virtual_cpu  # noqa: E402
 
+ON_TPU = os.environ.get("WTPU_CARDINAL_PLATFORM") == "tpu"
 # WTPU_CARDINAL_DEVS=1 runs unsharded on one device: the GSPMD pipeline
 # at N=2^20 x 8 partitions needs more compile/exec workspace than this
 # 125 GB host has; the 1-device run proves tier-3 state + engine at 1M,
 # and the mesh path is separately proven at smaller N (dryrun equality)
 # and at the largest N the host fits.
-N_DEV = int(os.environ.get("WTPU_CARDINAL_DEVS", 8))
-# 8 virtual devices time-slice ONE physical core here, so the per-device
-# compute between collectives (minutes at 1M nodes) far exceeds XLA:CPU's
-# default 40 s rendezvous termination timeout — raise both timeouts; on a
-# real 8-chip mesh devices run concurrently and the skew disappears.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") +
-    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
-    " --xla_cpu_collective_call_terminate_timeout_seconds=86400").strip()
-force_virtual_cpu(N_DEV)
+N_DEV = 1 if ON_TPU else int(os.environ.get("WTPU_CARDINAL_DEVS", 8))
+if not ON_TPU:
+    # 8 virtual devices time-slice ONE physical core here, so the
+    # per-device compute between collectives (minutes at 1M nodes) far
+    # exceeds XLA:CPU's default 40 s rendezvous termination timeout —
+    # raise both timeouts; on a real 8-chip mesh devices run
+    # concurrently and the skew disappears.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=86400"
+    ).strip()
+    force_virtual_cpu(N_DEV)
 
 import jax                                         # noqa: E402
 import jax.numpy as jnp                            # noqa: E402
@@ -49,17 +61,30 @@ from wittgenstein_tpu.models.handel_cardinal import (  # noqa: E402
 
 
 def main():
+    import dataclasses
     import os
     sim_ms = int(sys.argv[1]) if len(sys.argv) > 1 else 120
     n = int(os.environ.get("WTPU_CARDINAL_N", 1 << 20))   # override: smoke
     # horizon 128 keeps the flat mailbox ring under the int32 index limit
-    # (3 * 128 * 2^20 * 4 = 1.61e9 < 2^31); NetworkUniformLatency(100)
+    # (128 * 2^20 * 4 entries per plane); NetworkUniformLatency(100)
     # keeps every arrival inside the ring, so nothing can clamp or drop.
     proto = HandelCardinal(
         node_count=n, threshold=int(0.99 * n), nodes_down=0,
         pairing_time=4, dissemination_period_ms=20, fast_path=10,
         queue_cap=8, inbox_cap=4, horizon=128,
         network_latency_name="NetworkUniformLatency(100)")
+    # Keep every ring sub-plane under the TPU runtime's ~1 GB
+    # single-buffer execution limit (BENCH_NOTES.md r3): at 2^20 x hz128
+    # x ic4 a monolithic plane is 2.1 GB -> split 4 ways (537 MB each).
+    plane_bytes = 4 * proto.cfg.horizon * n * proto.cfg.inbox_cap
+    min_split = max(1, -(-plane_bytes // (800 * 1024 * 1024)))
+    # Round up to a power of two: box_split must divide the (power-of-two)
+    # node count.
+    pow2_split = 1 << (min_split - 1).bit_length()
+    split = int(os.environ.get("WTPU_CARDINAL_SPLIT",
+                               pow2_split if ON_TPU else 1))
+    if split > 1:
+        proto.cfg = dataclasses.replace(proto.cfg, box_split=split)
 
     devices = jax.devices()[:N_DEV]
     mesh = Mesh(np.array(devices), ("sp",))
@@ -135,7 +160,16 @@ def main():
 
     cfg = proto.cfg
     label = f"{n // 1024}k_{N_DEV}dev"
-    if N_DEV > 1:
+    if ON_TPU:
+        label += "_tpu"
+        plat = jax.devices()[0].device_kind
+        topo = (f"single REAL {plat} chip ({jax.default_backend()} "
+                f"backend), mailbox ring split into {cfg.box_split} "
+                "node-range sub-planes (EngineConfig.box_split) to stay "
+                "under the runtime's ~1 GB single-buffer execution limit")
+        per_chip = (f"measured here directly: {state_bytes / 1e9:.2f} GB "
+                    "resident on one chip's 16 GB HBM.")
+    elif N_DEV > 1:
         topo = (f"GSPMD node-axis sharding over a {N_DEV}-device virtual "
                 f"CPU mesh (`xla_force_host_platform_device_count="
                 f"{N_DEV}`, the same layout "
@@ -168,7 +202,7 @@ nothing may clamp).
 | simulated ms | {total_ms} |
 | init wall-clock | {t_init:.1f} s |
 | first {chunk}-ms chunk (incl. compile) | {t_compile:.1f} s |
-| steady-state wall per sim-ms | {per_ms:.2f} s (1-core CPU host) |
+| steady-state wall per sim-ms | {per_ms:.2f} s ({"real TPU chip" if ON_TPU else "1-core CPU host"}) |
 | device state | {state_bytes / 1e9:.2f} GB across {N_DEV} device(s) |
 | peak host RSS | {peak_rss:.1f} GB |
 | dropped / clamped / bc_dropped / evicted | {dropped} / {clamped} / {bc_dropped} / {evicted} |
@@ -180,9 +214,9 @@ mailbox ring ({cfg.payload_words} x {cfg.horizon} x {n:,} x
 {cfg.inbox_cap} int32 words + src/size/count) dominates at this scale;
 {per_chip}
 
-Wall-clock caveat: this host is a 1-core CPU; the run validates fit +
-correct execution, not speed.  The per-sim-ms cost above is an upper
-bound that a real 8-chip mesh shrinks by the usual 2-3 orders.
+{"Measured on the real chip: fit, correct execution and honest per-ms cost at 1M-class N on one device."
+ if ON_TPU else
+ "Wall-clock caveat: this host is a 1-core CPU; the run validates fit + correct execution, not speed.  The per-sim-ms cost above is an upper bound that a real 8-chip mesh shrinks by the usual 2-3 orders."}
 """)
     print(f"wrote {report}", flush=True)
 
