@@ -60,6 +60,18 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         # avoids the evictions queue_cap=8 shows there.
         kw = dict(queue_cap=16, inbox_cap=inbox_cap,
                   horizon=min(horizon, 256))
+    if mode == "exact":
+        # Tier-2 exact-mode on one chip: hashed emission drops the
+        # [N, 2N] stored lists (2.1 GB at 16k — over the runtime's
+        # single-buffer limit) while keeping reference-exact aggregation
+        # semantics; WTPU_BENCH_POOL=0 additionally drops the [N, R, W]
+        # send-time snapshot pool.
+        if os.environ.get("WTPU_BENCH_EMISSION"):
+            kw["emission_mode"] = os.environ["WTPU_BENCH_EMISSION"]
+        if os.environ.get("WTPU_BENCH_POOL"):
+            kw["snapshot_pool"] = os.environ["WTPU_BENCH_POOL"] == "1"
+        if os.environ.get("WTPU_BENCH_QUEUE"):
+            kw["queue_cap"] = int(os.environ["WTPU_BENCH_QUEUE"])
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,

@@ -38,10 +38,13 @@ def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
     sc = scan_chunk(proto, chunk, t0_mod=t0_mod, superstep=superstep)
     if seeds is None:
         step = jax.jit(sc)
-        init = lambda: jax.jit(proto.init)(jnp.asarray(0, jnp.int32))  # noqa: E731
+        init_jit = jax.jit(proto.init)      # built once: keep the trace
+        #                                     cache across measurement reps
+        init = lambda: init_jit(jnp.asarray(0, jnp.int32))   # noqa: E731
     else:
         step = jax.jit(jax.vmap(sc))
-        init = lambda: jax.vmap(proto.init)(                           # noqa: E731
+        init_jit = jax.vmap(proto.init)
+        init = lambda: init_jit(                             # noqa: E731
             jnp.arange(seeds, dtype=jnp.int32))
     steps = max(1, -(-sim_ms // chunk))
     out = timed_chunks(step, init, steps, seeds or 1, chunk, check,

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-4 serialized measurement queue — TPU-first (each item probes the
+# tunnel itself; CPU fallback only where the tool supports it).  Ordered
+# by evidence value; logs land in reports/, each tool writes its own
+# report.  Run from anywhere: cd's to the repo root.
+cd "$(dirname "$0")/.."
+
+echo "[q] 1M cardinal on the REAL chip (tier-3 evidence)"
+WTPU_CARDINAL_PLATFORM=tpu python tools/cardinal_1m.py 120 \
+    > reports/cardinal_1m_tpu.log 2>&1
+
+echo "[q] on-chip op profile (superstep=2)"
+python tools/tpu_profile.py > reports/profile_r4.log 2>&1
+
+echo "[q] 256-seed microbatched headline (2048n, 16x16)"
+WTPU_BENCH_SEEDS=256 WTPU_BENCH_SEED_BATCH=16 python bench.py \
+    > reports/bench_r4_256seed.log 2>&1
+
+echo "[q] tier-2 exact-hashed 16384n on the chip"
+WTPU_BENCH_NODES=16384 WTPU_BENCH_SEEDS=1 WTPU_BENCH_MS=2000 \
+    WTPU_BENCH_REPS=1 WTPU_BENCH_EMISSION=hashed \
+    python bench.py > reports/bench_r4_exact16k.log 2>&1
+
+echo "[q] tier-2 exact-hashed 32768n attempt (q_sig 939 MB at Q=7,"
+echo "    pool off: the [N,R,W] pool alone would be 1.9 GB)"
+WTPU_BENCH_NODES=32768 WTPU_BENCH_SEEDS=1 WTPU_BENCH_MS=2400 \
+    WTPU_BENCH_REPS=1 WTPU_BENCH_EMISSION=hashed WTPU_BENCH_POOL=0 \
+    WTPU_BENCH_QUEUE=7 WTPU_BENCH_BOX_SPLIT=2 \
+    python bench.py > reports/bench_r4_exact32k.log 2>&1
+
+echo "[q] tracked-config suite (PingPong/GSF/SanFermin/Dfinity)"
+python tools/bench_suite.py > reports/bench_suite_r4.jsonl 2>&1
+
+echo "[q] dfinity variance (32 seeds x 300 s)"
+python tools/dfinity_variance.py 32 300 > reports/dfinity_variance.log 2>&1
+
+echo "[q] reference-scale scenario sweeps (2048 x 8)"
+python tools/scenario_sweeps_2048.py > reports/sweeps_2048.log 2>&1
+
+echo "[q] emission drift 8192 honest x 8 seeds (device if up)"
+python -m wittgenstein_tpu.scenarios.emission_drift reports 8192 8 \
+    > reports/emission_8192.log 2>&1
+
+echo "[q] emission drift attacks at 1024 x 8 seeds"
+python - > reports/emission_attacks.log 2>&1 <<'EOF'
+from wittgenstein_tpu.scenarios.emission_drift import compare
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="byzantine_suicide", dead_ratio=0.25)
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="hidden_byzantine", dead_ratio=0.25)
+EOF
+
+echo "[q] done"

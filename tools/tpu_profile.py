@@ -1,0 +1,124 @@
+"""On-chip op-level profile of the benchmark step -> reports/PROFILE_r4.md.
+
+Runs the headline Handel config for one warmed chunk under
+`jax.profiler.trace`, parses the Chrome-trace JSON the profiler writes
+(plugins/profile/<ts>/*.trace.json.gz — no external xplane tooling
+needed), and aggregates device-op durations by HLO op-name prefix.
+This is the data that directs op-count reduction work: the engine is
+op-latency-bound at small shapes (~5 us/op — BENCH_NOTES.md r3).
+
+Usage: python tools/tpu_profile.py [out.md]
+Env:   WTPU_BENCH_* as for bench.py (nodes/seeds/superstep/box_split).
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import pathlib
+import re
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+def collect_trace(trace_dir):
+    """Aggregate device-lane op durations from the chrome trace."""
+    paths = glob.glob(str(pathlib.Path(trace_dir) /
+                          "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # Device lanes: pid whose process_name mentions the accelerator.
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {pid for pid, nm in pid_names.items()
+                if re.search(r"TPU|/device:|Device", nm)
+                and "CPU" not in nm.upper()}
+    if not dev_pids:
+        # CPU backend: ops run on the /host:CPU lane.
+        dev_pids = {pid for pid, nm in pid_names.items()
+                    if nm and nm.startswith("/host:")}
+    per_op = collections.Counter()
+    per_op_n = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        dur = e.get("dur", 0) / 1e6            # us -> s
+        name = e.get("name", "?")
+        # Strip HLO uniquifier suffixes: fusion.123 -> fusion
+        base = re.sub(r"[._]\d+$", "", name)
+        per_op[base] += dur
+        per_op_n[base] += 1
+        total += dur
+    return per_op, per_op_n, total, pid_names
+
+
+def main():
+    out_md = pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else \
+        REPO / "reports" / "PROFILE_r4.md"
+    import jax
+
+    from bench import _handel_setup
+
+    n = int(os.environ.get("WTPU_BENCH_NODES", 2048))
+    seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 16))
+    superstep = int(os.environ.get("WTPU_BENCH_SUPERSTEP", 2))
+    box_split = int(os.environ.get("WTPU_BENCH_BOX_SPLIT", 1))
+    chunk = 200
+    step, init, _, _ = _handel_setup(
+        n, seeds, 1000, chunk, "exact", 256, 12, superstep,
+        box_split=box_split)
+
+    nets, ps = init()
+    nets, ps = step(nets, ps)
+    np.asarray(nets.time)                       # warm + materialize
+    tdir = tempfile.mkdtemp(prefix="wtpu-trace-")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(tdir):
+        nets, ps = step(nets, ps)
+        np.asarray(nets.time)
+    wall = time.perf_counter() - t0
+
+    per_op, per_op_n, total, pid_names = collect_trace(tdir)
+    plat = jax.default_backend()
+    lines = [
+        f"# On-chip profile — {n}n x {seeds} seeds, superstep={superstep}, "
+        f"box_split={box_split} ({plat})",
+        "",
+        f"One warmed {chunk}-ms chunk under `jax.profiler.trace`; device "
+        f"lanes only.  Wall {wall:.2f} s, device-op total {total:.2f} s "
+        f"({1000 * total / (chunk * seeds):.2f} ms device time per "
+        "aggregate sim-ms).",
+        "",
+        "| op (top 25 by device time) | total s | count | avg us |",
+        "|---|---|---|---|",
+    ]
+    for name, dur in per_op.most_common(25):
+        cnt = per_op_n[name]
+        lines.append(f"| `{name}` | {dur:.3f} | {cnt} | "
+                     f"{1e6 * dur / max(1, cnt):.1f} |")
+    n_ops = sum(per_op_n.values())
+    lines += ["",
+              f"Total device ops in chunk: {n_ops} "
+              f"({n_ops / chunk:.0f} per simulated ms).",
+              f"Trace dir: {tdir} (lanes: "
+              f"{sorted(set(pid_names.values()))[:6]})"]
+    out_md.write_text("\n".join(lines) + "\n")
+    print("\n".join(lines[:12]))
+    print(f"wrote {out_md}")
+
+
+if __name__ == "__main__":
+    main()

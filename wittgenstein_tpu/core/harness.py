@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import stats as stats_mod
-from .network import scan_chunk
+from .network import scan_chunk, superstep_ok
 
 
 def cont_until_done(net, pstate):
@@ -39,9 +39,14 @@ def _freeze_chunk(protocol, chunk, cont):
     # (frozen runs stop exactly on one), so when `chunk` is also a
     # multiple of the protocol's static schedule lcm the phase-specialized
     # scan applies to every run (bit-identical — tests/test_phase_hints.py).
+    # Entry times at chunk boundaries are even whenever `chunk` is even,
+    # so the fused super-step (step_2ms — also bit-identical,
+    # tests/test_superstep.py) applies under the same alignment argument.
     lcm = getattr(protocol, "schedule_lcm", None)
+    ss = 2 if (chunk % 2 == 0 and superstep_ok(protocol)) else 1
     one_chunk = scan_chunk(protocol, chunk,
-                           t0_mod=0 if (lcm and chunk % lcm == 0) else None)
+                           t0_mod=0 if (lcm and chunk % lcm == 0) else None,
+                           superstep=ss)
 
     @jax.jit
     def chunk_all(nets, ps, stopped, stopped_at):

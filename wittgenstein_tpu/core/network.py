@@ -492,6 +492,16 @@ def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
     return net.replace(time=t + 2), pstate
 
 
+def superstep_ok(protocol) -> bool:
+    """True iff `step_2ms` is valid for this protocol (the chunk length
+    and entry time must additionally be even — per-call properties the
+    caller checks).  The single shared eligibility predicate: scan_chunk
+    raises on violations, Runner/harness demote to the per-ms path."""
+    cfg = protocol.cfg
+    return (cfg.spill_cap == 0 and cfg.horizon % 2 == 0
+            and not getattr(protocol, "mutates_liveness", False))
+
+
 def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
                superstep: int = 1):
     """Returns ``run(net, pstate) -> (net, pstate)`` advancing `ms`
@@ -533,16 +543,13 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
         # in-tree driver enters at an even time (init time=0, even
         # chunks), and the phase-specialized path checks t0_mod below.
         cfg = protocol.cfg
-        if cfg.spill_cap > 0 or cfg.horizon % 2 or ms % 2:
+        if not superstep_ok(protocol) or ms % 2:
             raise ValueError(
                 f"superstep=2 needs spill_cap == 0 (got {cfg.spill_cap}), "
-                f"an even horizon (got {cfg.horizon}) and an even chunk "
-                f"(got {ms})")
-        if getattr(protocol, "mutates_liveness", False):
-            raise ValueError(
-                "superstep=2 is invalid for protocols whose step() mutates "
-                "node liveness (down flags): the second ms's inbox is "
-                "built before the first ms's step runs")
+                f"an even horizon (got {cfg.horizon}), an even chunk "
+                f"(got {ms}), and a protocol whose step() does not mutate "
+                "node liveness (the second ms's inbox is built before the "
+                "first ms's step runs)")
         if t0_mod is not None and t0_mod % 2:
             raise ValueError(f"superstep=2 needs an even entry time "
                              f"(t0_mod={t0_mod})")
@@ -642,11 +649,8 @@ class Runner:
         # bit-identical).  Applied per chunk only when the chunk length
         # and the entry time are even and the config allows it; otherwise
         # that chunk silently runs the per-ms path (results identical).
-        if superstep == 2:
-            cfg = protocol.cfg
-            if (cfg.spill_cap > 0 or cfg.horizon % 2
-                    or getattr(protocol, "mutates_liveness", False)):
-                superstep = 1
+        if superstep == 2 and not superstep_ok(protocol):
+            superstep = 1
         self._superstep = superstep
 
     def _chunk_fn(self, ms, superstep=1):
