@@ -90,8 +90,19 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
     if os.environ.get("WTPU_BENCH_SPEC") == "0":
         lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
-    step = jax.jit(jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
-                                       superstep=superstep)))
+    if os.environ.get("WTPU_BENCH_BATCHED") == "1":
+        # Seed-folded mailbox machinery (core/batched.py): avoids the
+        # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
+        # bit-identical (tests/test_batched.py).  The batched path is
+        # hard-wired to the fused 2-ms step; refuse configurations that
+        # would silently mislabel a superstep A/B.
+        assert superstep == 2, \
+            "WTPU_BENCH_BATCHED=1 implies superstep=2 (core/batched.py)"
+        from wittgenstein_tpu.core.batched import scan_chunk_batched
+        step = jax.jit(scan_chunk_batched(proto, chunk, t0_mod=t0))
+    else:
+        step = jax.jit(jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
+                                           superstep=superstep)))
     steps = max(1, -(-sim_ms // chunk))
 
     def init(seed0=0):

@@ -1,0 +1,77 @@
+"""Batch-folded engine (core/batched.py) — bit-equality with the
+vmapped per-seed scan.
+
+The folded path exists purely as a lowering workaround (the vmapped
+mailbox scatter serializes per seed on TPU, reports/PROFILE_r4.md), so
+its results must be EXACTLY the vmapped path's across the full pytree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.batched import scan_chunk_batched
+from wittgenstein_tpu.core.network import scan_chunk
+from wittgenstein_tpu.models.handel import Handel
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _run_both(proto, ms, seeds=3, t0_mod=None):
+    ref = jax.jit(jax.vmap(scan_chunk(proto, ms, t0_mod=t0_mod,
+                                      superstep=2)))
+    bat = jax.jit(scan_chunk_batched(proto, ms, t0_mod=t0_mod))
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    out_ref = ref(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    out_bat = bat(nets, ps)
+    return out_ref, out_bat
+
+
+def test_batched_matches_vmapped_plain():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+    a, b = _run_both(proto, 80)
+    _trees_equal(a, b)
+    _, ps = b
+    assert int(np.asarray(ps.sigs_checked).sum()) > 0
+
+
+def test_batched_matches_vmapped_phase_specialized():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+    a, b = _run_both(proto, 120, t0_mod=0)
+    _trees_equal(a, b)
+
+
+def test_batched_matches_vmapped_cardinal():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   fast_path=10, mode="cardinal")
+    a, b = _run_both(proto, 80, t0_mod=0)
+    _trees_equal(a, b)
+
+
+def test_batched_box_split():
+    import dataclasses
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   fast_path=10)
+    proto.cfg = dataclasses.replace(proto.cfg, box_split=2)
+    a, b = _run_both(proto, 80)
+    _trees_equal(a, b)
+
+
+def test_batched_rejects_broadcast_protocols():
+    from wittgenstein_tpu.models.pingpong import PingPong
+    with pytest.raises(ValueError, match="broadcast-free"):
+        scan_chunk_batched(PingPong(node_count=64), 40)

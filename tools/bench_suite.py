@@ -127,7 +127,9 @@ def bench_dfinity():
         dropped = int(np.asarray(nets.dropped).sum())
         arena_dropped = int(np.asarray(ps.arena.dropped))
         assert dropped == 0 and arena_dropped == 0, (dropped, arena_dropped)
-        assert heights.min() == heights.max(), "nodes disagree on height"
+        # At a mid-round snapshot one block can legitimately be in
+        # flight: heads may skew by 1, never more.
+        assert heights.max() - heights.min() <= 1, "nodes disagree"
         assert heights.max() >= 30, f"height={heights.max()} after 120 s"
         return {"height": int(heights.max())}
 

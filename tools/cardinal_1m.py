@@ -120,7 +120,17 @@ def main():
     chunk = 20
     # superstep=2: fused 2-ms engine pass, bit-identical
     # (tests/test_superstep.py) — halves per-ms fixed cost at 1M shapes.
-    step = jax.jit(scan_chunk(proto, chunk, t0_mod=0, superstep=2))
+    base_step = scan_chunk(proto, chunk, t0_mod=0, superstep=2)
+    # Selective >=1MB-leaf donation (network.split_donate_jit — the
+    # Runner donate="big" mechanics, validated on this hardware in r3):
+    # without it the while-loop carry cannot alias the 11.7 GB input
+    # state and the program OOMs at compile (17.9 GB HLO temp vs
+    # 15.75 GB HBM, observed 2026-07-31).
+    from wittgenstein_tpu.core.network import split_donate_jit
+    leaves0, treedef = jax.tree.flatten((net, ps))
+    big_idx = frozenset(i for i, x in enumerate(leaves0)
+                        if x.size * x.dtype.itemsize >= 1 << 20)
+    step = split_donate_jit(base_step, treedef, big_idx)
     t0 = time.perf_counter()
     with mesh:
         net, ps = step(net, ps)

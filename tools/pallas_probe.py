@@ -1,0 +1,67 @@
+"""Probe: does Pallas/Mosaic compile and run through the axon remote-compile
+path?  Decides whether a fused delivery kernel (merge + gathers — ~30% of
+the step per reports/PROFILE_r4.md) is buildable this round.
+
+Runs a trivial elementwise kernel and a small row-topk-style kernel shape.
+Prints PALLAS_OK / PALLAS_FAIL with the error head.
+"""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    try:
+        from jax.experimental import pallas as pl
+
+        def add_kernel(x_ref, y_ref, o_ref):
+            o_ref[...] = x_ref[...] + y_ref[...]
+
+        x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+        out = pl.pallas_call(
+            add_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x, x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(2 * x))
+
+        # Row-local compute at the delivery-merge shape class: [rows, W]
+        # u32 word ops + a row reduction (the building blocks the fused
+        # delivery kernel needs).
+        def popmerge_kernel(a_ref, b_ref, o_ref, s_ref):
+            a = a_ref[...]
+            b = b_ref[...]
+            u = a | b
+            o_ref[...] = u
+            # popcount via bit tricks (no lax.population_count in some
+            # Mosaic versions — test the fallback formula too)
+            v = u - ((u >> 1) & 0x55555555)
+            v = (v & 0x33333333) + ((v >> 2) & 0x33333333)
+            v = (((v + (v >> 4)) & 0x0F0F0F0F) * 0x01010101) >> 24
+            s_ref[...] = jnp.sum(v.astype(jnp.int32), axis=1,
+                                 keepdims=True)
+
+        rows, w = 256, 128
+        a = jnp.arange(rows * w, dtype=jnp.uint32).reshape(rows, w)
+        b = a ^ jnp.uint32(0xFFFF)
+        u, s = pl.pallas_call(
+            popmerge_kernel,
+            out_shape=(jax.ShapeDtypeStruct((rows, w), jnp.uint32),
+                       jax.ShapeDtypeStruct((rows, 1), jnp.int32)))(a, b)
+        ref_u = np.asarray(a) | np.asarray(b)
+        np.testing.assert_array_equal(np.asarray(u), ref_u)
+        ref_s = np.unpackbits(
+            ref_u.view(np.uint8), axis=1).sum(axis=1, dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(s)[:, 0], ref_s)
+        print(f"PALLAS_OK platform={jax.default_backend()}")
+    except Exception as e:  # noqa: BLE001 — probe reports, caller decides
+        print(f"PALLAS_FAIL {type(e).__name__}: {e!s:.500}")
+
+
+if __name__ == "__main__":
+    main()

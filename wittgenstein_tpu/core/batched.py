@@ -1,0 +1,242 @@
+"""Batch-folded engine: the seed axis folded into the mailbox scatter.
+
+Why this exists (reports/PROFILE_r4.md): under `jax.vmap`, the mailbox
+ring scatter lowers to a SEQUENTIAL loop over the seed batch — XLA
+materializes each seed's updated plane and copies it back with a
+whole-plane dynamic-update-slice (80 x 25 MB per fused superstep at the
+2048n x 16 headline config = 5.2 s per 200-ms chunk, 13% of device
+time).  Folding the seed index into the flat scatter index turns those
+8000 serialized plane copies into ONE scatter per plane.
+
+Scope: the high-throughput bench path — protocols with
+``spill_cap == 0`` and ``bcast_slots == 0`` (Handel exact + cardinal,
+GSF).  Everything except the mailbox machinery stays the SAME code,
+vmapped (protocol steps, routing, latency draws — their lowering was
+already efficient).  All runs advance in lockstep (same `t`), which the
+bench/harness batch paths guarantee.
+
+Bit-equality with `jax.vmap(scan_chunk(...))` is asserted in
+tests/test_batched.py: the folded scatter writes the same cells in the
+same deterministic order (the per-seed sort keys and ranks are
+unchanged; seeds never collide since the fold offsets by seed stride).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .network import _route_unicast, superstep_ok
+from .state import EngineConfig, Inbox, NetState
+
+
+def _batched_bin(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
+                 payload, size, valid):
+    """[R, m]-batched ring binning with the seed axis folded into the
+    flat scatter index.  Mirrors network._bin_into_ring exactly per seed
+    (same keys, same stable order, same slot assignment)."""
+    n, c = cfg.n, cfg.inbox_cap
+    p, ns = cfg.box_split, cfg.split_n
+    r, m = src.shape
+    rel = arrival - t
+    big = jnp.int32(0x7FFFFFFF)
+    rel_k = jnp.where(valid, rel, big)
+    dest_k = jnp.where(valid, dest, big)
+    o1 = jnp.argsort(dest_k, axis=1, stable=True)
+    order = jnp.take_along_axis(
+        o1, jnp.argsort(jnp.take_along_axis(rel_k, o1, axis=1), axis=1,
+                        stable=True), axis=1)
+    rel_s = jnp.take_along_axis(rel_k, order, axis=1)
+    dest_s = jnp.take_along_axis(dest_k, order, axis=1)
+    idx = jnp.arange(m, dtype=jnp.int32)[None, :]
+    new_grp = ((rel_s != jnp.roll(rel_s, 1, axis=1)) |
+               (dest_s != jnp.roll(dest_s, 1, axis=1)))
+    new_grp = new_grp.at[:, 0].set(True)
+    rank = idx - jax.lax.cummax(jnp.where(new_grp, idx, 0), axis=1)
+
+    h_s = jnp.take_along_axis(arrival % cfg.horizon, order, axis=1)
+    d_s = jnp.take_along_axis(dest, order, axis=1)
+    ok_s = jnp.take_along_axis(valid, order, axis=1)
+    # box_count gather/scatter with the seed axis folded: [R, H, N] flat.
+    rix = jnp.arange(r, dtype=jnp.int32)[:, None]
+    cnt_flat = net.box_count.reshape(r * cfg.horizon * n)
+    cell = (rix * cfg.horizon + h_s) * n + d_s
+    slot = cnt_flat[jnp.where(ok_s, cell, 0)] + rank
+    ok_s = ok_s & (slot < c)
+
+    sub_total = cfg.horizon * ns * c
+    payload_s = jnp.take_along_axis(payload, order[:, :, None], axis=1)
+    src_s = jnp.take_along_axis(src, order, axis=1)
+    size_s = jnp.take_along_axis(size, order, axis=1)
+    box_data = list(net.box_data)
+    box_src = list(net.box_src)
+    box_size = list(net.box_size)
+    for j in range(p):
+        dj = d_s - j * ns
+        in_j = ok_s & (dj >= 0) & (dj < ns)
+        # Per-seed cell index + seed-stride fold: one scatter, no
+        # per-seed serialization.
+        flat_j = (h_s * ns + dj) * c + jnp.where(in_j, slot, 0) + \
+            rix * sub_total
+        flat_jw = jnp.where(in_j, flat_j, r * sub_total).reshape(-1)
+        for fi in range(cfg.payload_words):
+            pl = box_data[fi * p + j]
+            box_data[fi * p + j] = pl.reshape(-1).at[flat_jw].set(
+                payload_s[:, :, fi].reshape(-1), mode="drop",
+                unique_indices=True).reshape(pl.shape)
+        box_src[j] = box_src[j].reshape(-1).at[flat_jw].set(
+            src_s.reshape(-1), mode="drop",
+            unique_indices=True).reshape(box_src[j].shape)
+        box_size[j] = box_size[j].reshape(-1).at[flat_jw].set(
+            size_s.reshape(-1), mode="drop",
+            unique_indices=True).reshape(box_size[j].shape)
+    cell_w = jnp.where(ok_s, cell, r * cfg.horizon * n).reshape(-1)
+    box_count = cnt_flat.at[cell_w].add(
+        jnp.ones_like(cell_w, dtype=jnp.int32) *
+        ok_s.reshape(-1).astype(jnp.int32),
+        mode="drop").reshape(net.box_count.shape)
+    n_dropped = jnp.sum(jnp.take_along_axis(valid, order, axis=1) & ~ok_s,
+                        axis=1).astype(jnp.int32)
+    return net.replace(box_data=tuple(box_data), box_src=tuple(box_src),
+                       box_size=tuple(box_size), box_count=box_count), \
+        n_dropped
+
+
+def _batched_inbox(cfg: EngineConfig, model, net: NetState, t):
+    """build_inbox for the batched state ([R, ...] leaves), bcast-free."""
+    nodes = net.nodes
+    n, c, f = cfg.n, cfg.inbox_cap, cfg.payload_words
+    p, ns = cfg.box_split, cfg.split_n
+    r = net.box_count.shape[0]
+    h = t % cfg.horizon
+
+    def rd(plane):
+        # [R, H*Ns*C] -> [R, 1, Ns*C] slice at h -> [R, Ns, C]
+        return jax.lax.dynamic_slice(
+            plane.reshape(r, cfg.horizon, ns * c), (0, h, 0),
+            (r, 1, ns * c)).reshape(r, ns, c)
+
+    def rd_all(planes):
+        if p == 1:
+            return rd(planes[0])
+        return jnp.concatenate([rd(pl) for pl in planes], axis=1)
+
+    uc_data = jnp.stack(
+        [rd_all(net.box_data[fi * p:(fi + 1) * p]) for fi in range(f)],
+        axis=-1)                                    # [R, N, C, F]
+    uc_src = rd_all(net.box_src)
+    uc_size = rd_all(net.box_size)
+    cnt_h = jax.lax.dynamic_slice(
+        net.box_count, (0, h, 0), (r, 1, n)).reshape(r, n)
+    uc_valid = jnp.arange(c)[None, None, :] < cnt_h[:, :, None]
+    part_src = jnp.take_along_axis(nodes.partition, uc_src.reshape(r, -1),
+                                   axis=1).reshape(r, n, c)
+    deliver_ok = (~nodes.down[:, :, None]) & (
+        part_src == nodes.partition[:, :, None])
+    uc_valid = uc_valid & deliver_ok
+    recv = jnp.sum(uc_valid, 2).astype(jnp.int32)
+    rbytes = jnp.sum(jnp.where(uc_valid, uc_size, 0), 2).astype(jnp.int32)
+    nodes = nodes.replace(msg_received=nodes.msg_received + recv,
+                          bytes_received=nodes.bytes_received + rbytes)
+    return Inbox(data=uc_data, src=uc_src, valid=uc_valid), nodes
+
+
+def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
+    """Batched twin of network.step_2ms (seed-folded mailbox machinery;
+    vmapped protocol steps and routing).  Preconditions: spill_cap == 0,
+    bcast_slots == 0, per-seed times all equal and even."""
+    cfg, model = protocol.cfg, protocol.latency
+    assert cfg.spill_cap == 0 and cfg.bcast_slots == 0
+    r = net.box_count.shape[0]
+    t = net.time[0]
+
+    inbox0, nodes = _batched_inbox(cfg, model, net, t)
+    net = net.replace(nodes=nodes)
+    inbox1, nodes = _batched_inbox(cfg, model, net, t + 1)
+    net = net.replace(nodes=nodes)
+
+    def pstep(ps, nodes_r, inbox_r, seed, tt, hints):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), tt)
+        if hints is None:
+            return protocol.step(ps, nodes_r, inbox_r, tt, key)
+        return protocol.step(ps, nodes_r, inbox_r, tt, key, hints=hints)
+
+    pstate, nodes, out0 = jax.vmap(
+        lambda ps, nd, ib, sd: pstep(ps, nd, ib, sd, t, hints2[0]))(
+        pstate, net.nodes, inbox0, net.seed)
+    net = net.replace(nodes=nodes)
+    pstate, nodes, out1 = jax.vmap(
+        lambda ps, nd, ib, sd: pstep(ps, nd, ib, sd, t + 1, hints2[1]))(
+        pstate, net.nodes, inbox1, net.seed)
+    net = net.replace(nodes=nodes)
+
+    h = t % cfg.horizon
+    n = cfg.n
+    net = net.replace(box_count=jax.lax.dynamic_update_slice(
+        net.box_count, jnp.zeros((r, 2, n), jnp.int32), (0, h, 0)))
+
+    # Routing per seed (vmapped — elementwise + latency model), then ONE
+    # folded bin for both ms across all seeds.
+    def route(net_r, out_r, tt):
+        return _route_unicast(cfg, model, net_r, out_r, tt)
+
+    net, b0, _ = jax.vmap(lambda nr, orr: route(nr, orr, t))(net, out0)
+    net, b1, _ = jax.vmap(lambda nr, orr: route(nr, orr, t + 1))(net, out1)
+    src = jnp.concatenate([b0[0], b1[0]], axis=1)
+    dest = jnp.concatenate([b0[1], b1[1]], axis=1)
+    arrival = jnp.concatenate([b0[2], b1[2]], axis=1)
+    payload = jnp.concatenate([b0[3], b1[3]], axis=1)
+    size = jnp.concatenate([b0[4], b1[4]], axis=1)
+    valid = jnp.concatenate([b0[5], b1[5]], axis=1)
+    n_clamped = (jnp.sum(b0[6], axis=1) +
+                 jnp.sum(b1[6], axis=1)).astype(jnp.int32)
+    net, n_dropped = _batched_bin(cfg, net, t, src, dest, arrival,
+                                  payload, size, valid)
+    net = net.replace(dropped=net.dropped + n_dropped,
+                      clamped=net.clamped + n_clamped,
+                      time=net.time + 2)
+    return net, pstate
+
+
+def scan_chunk_batched(protocol, ms: int, t0_mod=None):
+    """Batched twin of scan_chunk(superstep=2) for vmap-batched state
+    (leaves [R, ...]).  Same phase-specialization contract; chunk must
+    be even and a multiple of the (even-adjusted) schedule lcm when
+    t0_mod is given."""
+    if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
+            or not superstep_ok(protocol)):
+        raise ValueError("scan_chunk_batched needs an even chunk and a "
+                         "spill-free, broadcast-free, superstep-eligible "
+                         "protocol")
+    lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
+        else None
+    if lcm and lcm % 2:
+        lcm *= 2
+    if lcm:
+        if ms % lcm:
+            raise ValueError(f"chunk {ms} not a multiple of lcm {lcm}")
+        sched = getattr(protocol, "schedule_lcm")
+        hints = [protocol.phase_hints((t0_mod + dt) % sched)
+                 for dt in range(lcm)]
+        blocks = ms // lcm
+
+        def run_spec(net, pstate):
+            def body(carry, _):
+                net, ps = carry
+                for i in range(0, len(hints), 2):
+                    net, ps = step_2ms_batched(
+                        protocol, net, ps, hints2=(hints[i], hints[i + 1]))
+                return (net, ps), ()
+            (net, pstate), _ = jax.lax.scan(body, (net, pstate),
+                                            length=blocks)
+            return net, pstate
+
+        return run_spec
+
+    def run(net, pstate):
+        def body(carry, _):
+            return step_2ms_batched(protocol, *carry), ()
+        (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms // 2)
+        return net2, p2
+
+    return run

@@ -492,6 +492,32 @@ def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
     return net.replace(time=t + 2), pstate
 
 
+def split_donate_jit(fn, treedef, big_idx):
+    """Jit `fn(state_pytree) -> state_pytree` donating ONLY the large
+    leaves: the axon TPU plugin fails (INVALID_ARGUMENT, poisoning the
+    process) when the FULL simulator pytree is donated, while donating
+    the >=1MB leaves alone halves peak memory for exactly the buffers
+    that matter (SCALE.md).  `treedef`/`big_idx` come from
+    `jax.tree.flatten` of an example state; returns ``call(*state)``.
+    The single shared implementation of the leaf-interleaving trick —
+    used by `Runner(donate="big")` and tools/cardinal_1m.py."""
+    def split_run(big, small):
+        bi, si = iter(big), iter(small)
+        leaves = [next(bi) if i in big_idx else next(si)
+                  for i in range(len(big) + len(small))]
+        return fn(*jax.tree.unflatten(treedef, leaves))
+
+    jitted = jax.jit(split_run, donate_argnums=(0,))
+
+    def call(*state):
+        leaves = jax.tree.leaves(state)
+        big = tuple(x for i, x in enumerate(leaves) if i in big_idx)
+        small = tuple(x for i, x in enumerate(leaves) if i not in big_idx)
+        return jitted(big, small)
+
+    return call
+
+
 def superstep_ok(protocol) -> bool:
     """True iff `step_2ms` is valid for this protocol (the chunk length
     and entry time must additionally be even — per-call properties the
@@ -658,31 +684,14 @@ class Runner:
         if key not in self._jits:
             base = scan_chunk(self.protocol, ms, superstep=superstep)
             if self._donate == "big":
-                treedef, big_idx = self._split
-
-                def split_run(big, small):
-                    leaves = [None] * (len(big) + len(small))
-                    bi, si = iter(big), iter(small)
-                    for i in range(len(leaves)):
-                        leaves[i] = next(bi) if i in big_idx else next(si)
-                    net, ps = jax.tree.unflatten(treedef, leaves)
-                    return base(net, ps)
-
-                self._jits[key] = jax.jit(split_run, donate_argnums=(0,))
+                self._jits[key] = split_donate_jit(base, *self._split)
             else:
                 kw = {"donate_argnums": (0, 1)} if self._donate else {}
                 self._jits[key] = jax.jit(base, **kw)
         return self._jits[key]
 
     def _call(self, fn, net, pstate):
-        if self._donate != "big":
-            return fn(net, pstate)
-        treedef, big_idx = self._split
-        leaves = jax.tree.leaves((net, pstate))
-        big = tuple(x for i, x in enumerate(leaves) if i in big_idx)
-        small = tuple(x for i, x in enumerate(leaves)
-                      if i not in big_idx)
-        return fn(big, small)
+        return fn(net, pstate)
 
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:

@@ -141,9 +141,19 @@ def select_queue(keyv, valid, q_cap, cols2d, cols3d):
     (models/handel.py / models/gsf.py receive paths): keep the `q_cap`
     best candidate entries by ascending key — invalid entries sort last —
     and gather every queue column through the same order.  Returns
-    (selected 2-D columns dict, selected 3-D columns dict, order)."""
+    (selected 2-D columns dict, selected 3-D columns dict, order).
+
+    Selection uses `lax.top_k` on the negated key rather than a full
+    argsort — bit-identical to argsort(...)[:, :q_cap] because (a) every
+    VALID entry's key is unique within its row (callers encode the
+    column position into the key, see merge_bounded_queue), and (b) the
+    INVALID entries all sharing the 0x7FFFFFFF sentinel are returned in
+    ascending-index order by top_k's documented lower-index tie rule —
+    the same order stable argsort gives them.  top_k's partial selection
+    avoids sorting the full row (the merge argsort was 17% of on-chip
+    device time, reports/PROFILE_r4.md)."""
     big = jnp.int32(0x7FFFFFFF)
-    order = jnp.argsort(jnp.where(valid, keyv, big), axis=1)[:, :q_cap]
+    _, order = jax.lax.top_k(-jnp.where(valid, keyv, big), q_cap)
     sel2 = {k: jnp.take_along_axis(v, order, axis=1)
             for k, v in cols2d.items()}
     sel3 = {k: jnp.take_along_axis(v, order[:, :, None], axis=1)

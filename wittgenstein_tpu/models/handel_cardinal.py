@@ -533,12 +533,14 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
         og_size = 1 + jnp.cumsum(p.lvl_best, axis=1) - p.lvl_best  # [N, L]
         # Non-periodic ms can only populate the fast-path slots: narrow
         # outbox with preserved slot ids (Outbox.slot0) — see
-        # models/handel.py._disseminate.
+        # models/handel.py._disseminate.  The outbox pieces are built by
+        # CONSTRUCTION (stack/concatenate of broadcasts), never by slice
+        # updates into a zero [N, K, 3] buffer: XLA materializes such
+        # scatter operands with (8, 128)-tiled padding on the tiny
+        # trailing dims — 12.8x expansion, 1.5 GB at 2^20 nodes
+        # (observed in the r4 1M-run OOM dump).
         K = self.cfg.out_deg if periodic else max(1, self.fast_path)
         koff = L - 1 if periodic else 0
-        dest = jnp.full((n, K), -1, jnp.int32)
-        payload = jnp.zeros((n, K, 3), jnp.int32)
-        sizes = jnp.ones((n, K), jnp.int32)
 
         # `periodic=False` (static phase hint, see core/network.scan_chunk):
         # no node can be on a period boundary, so the per-period block is
@@ -568,17 +570,15 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
 
             # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
             sz_l = 1 + halfs // 8 + 192                        # [1, L]
-            dest = dest.at[:, :L - 1].set(jnp.where(send_l, peer, -1)[:, 1:])
-            payload = payload.at[:, :L - 1, 0].set(lvl_idx[:, 1:])
+            lvl_dest = jnp.where(send_l, peer, -1)[:, 1:]      # [N, L-1]
             # Word 1 (levelFinished flag) is wire-format parity with exact
             # mode only: cardinal receivers ignore it (no finishedPeers
             # tracking), but message introspection tooling still sees the
             # same 3-word layout.
-            payload = payload.at[:, :L - 1, 1].set(
-                inc_complete.astype(jnp.int32)[:, 1:])
-            payload = payload.at[:, :L - 1, 2].set(og_size[:, 1:])
-            sizes = sizes.at[:, :L - 1].set(
-                jnp.broadcast_to(sz_l, (n, L))[:, 1:])
+            lvl_words = (jnp.broadcast_to(lvl_idx, (n, L))[:, 1:],
+                         inc_complete.astype(jnp.int32)[:, 1:],
+                         og_size[:, 1:])
+            lvl_sizes = jnp.broadcast_to(sz_l, (n, L))[:, 1:]
         else:
             added_cycle = p.added_cycle
             pos = p.pos
@@ -599,19 +599,39 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
             fids = self._emission_peer(p.seed, ids[:, None],
                                        fl[:, None], foffs)
             fsend = (fl > 0) & active & ~done
-            fdest = jnp.where(fsend[:, None], fids, -1)
+            fast_dest = jnp.where(fsend[:, None], fids, -1)
             fcnt = gather2d(og_size, ids, fl)
-            dest = dest.at[:, koff:koff + fp].set(fdest)
-            payload = payload.at[:, koff:koff + fp, 0].set(fl[:, None])
-            payload = payload.at[:, koff:koff + fp, 2].set(fcnt[:, None])
-            sizes = sizes.at[:, koff:koff + fp].set(
-                (1 + fhalf // 8 + 192)[:, None])
+            fast_words = (jnp.broadcast_to(fl[:, None], (n, fp)),
+                          jnp.zeros((n, fp), jnp.int32),
+                          jnp.broadcast_to(fcnt[:, None], (n, fp)))
+            fast_sizes = jnp.broadcast_to((1 + fhalf // 8 + 192)[:, None],
+                                          (n, fp))
             pos = set2d(pos, ids, jnp.maximum(fl, 1),
                         (gather2d(pos, ids, jnp.maximum(fl, 1)) + fp) %
                         jnp.maximum(fhalf, 1), ok=fsend)
             fast_pending = jnp.where(fsend, fast_pending & ~lsb,
                                      fast_pending)
             fast_pending = jnp.where(done, 0, fast_pending)
+        else:
+            # No fast path: zero extra columns on a periodic ms, one
+            # always-empty column otherwise (K = max(1, fast_path)).
+            fcols = 0 if periodic else 1
+            fast_dest = jnp.full((n, fcols), -1, jnp.int32)
+            fast_words = tuple(jnp.zeros((n, fcols), jnp.int32)
+                               for _ in range(3))
+            fast_sizes = jnp.ones((n, fcols), jnp.int32)
+
+        if periodic:
+            dest = jnp.concatenate([lvl_dest, fast_dest], axis=1)
+            payload = jnp.stack(
+                [jnp.concatenate([lw, fw], axis=1)
+                 for lw, fw in zip(lvl_words, fast_words)], axis=-1)
+            sizes = jnp.concatenate([lvl_sizes, fast_sizes], axis=1)
+        else:
+            dest = fast_dest
+            payload = jnp.stack(list(fast_words), axis=-1)
+            sizes = fast_sizes
+        assert dest.shape[1] == K, (dest.shape, K)
 
         # slot0 clamped into [0, out_deg) — see models/handel.py (the
         # fast_path == 0 narrow-outbox slot-id collision, ADVICE r3).
