@@ -90,6 +90,7 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
     if os.environ.get("WTPU_BENCH_SPEC") == "0":
         lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
+    donate_big = os.environ.get("WTPU_BENCH_DONATE") == "big"
     if os.environ.get("WTPU_BENCH_BATCHED") == "1":
         # Seed-folded mailbox machinery (core/batched.py): avoids the
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
@@ -99,15 +100,26 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         assert superstep == 2, \
             "WTPU_BENCH_BATCHED=1 implies superstep=2 (core/batched.py)"
         from wittgenstein_tpu.core.batched import scan_chunk_batched
-        step = jax.jit(scan_chunk_batched(proto, chunk, t0_mod=t0))
+        base = scan_chunk_batched(proto, chunk, t0_mod=t0)
+        step = jax.jit(base)
     else:
-        step = jax.jit(jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
-                                           superstep=superstep)))
+        base = jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
+                                   superstep=superstep))
+        step = jax.jit(base)
     steps = max(1, -(-sim_ms // chunk))
 
     def init(seed0=0):
         return jax.vmap(proto.init)(
             seed0 + jnp.arange(seeds, dtype=jnp.int32))
+
+    if donate_big:
+        # Selective >=1MB-leaf donation (network.split_donate_jit,
+        # validated on this hardware r3): lets tier-2 exact configs whose
+        # carry would otherwise double in HLO temp fit one chip (the 32k
+        # attempt needed 22 GB undonated vs 15.75 GB HBM).
+        from wittgenstein_tpu.core.network import (split_donate_jit,
+                                                    split_spec)
+        step = split_donate_jit(base, *split_spec(jax.eval_shape(init)))
 
     def check(nets, ps):
         done_at = np.asarray(nets.nodes.done_at)

@@ -126,11 +126,9 @@ def main():
     # without it the while-loop carry cannot alias the 11.7 GB input
     # state and the program OOMs at compile (17.9 GB HLO temp vs
     # 15.75 GB HBM, observed 2026-07-31).
-    from wittgenstein_tpu.core.network import split_donate_jit
-    leaves0, treedef = jax.tree.flatten((net, ps))
-    big_idx = frozenset(i for i, x in enumerate(leaves0)
-                        if x.size * x.dtype.itemsize >= 1 << 20)
-    step = split_donate_jit(base_step, treedef, big_idx)
+    from wittgenstein_tpu.core.network import (split_donate_jit,
+                                                split_spec)
+    step = split_donate_jit(base_step, *split_spec((net, ps)))
     t0 = time.perf_counter()
     with mesh:
         net, ps = step(net, ps)

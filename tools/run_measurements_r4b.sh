@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 phase-2 chip queue: waits for the running suite, then the
+# priority list — 1M rerun (fixed donation + construction outboxes),
+# batched-engine A/B, pallas probe, 32k exact with donation, then the
+# remaining phase-1 items.
+cd "$(dirname "$0")/.."
+while pgrep -f "tools/bench_suite.py" > /dev/null; do sleep 30; done
+
+echo "[q2] 1M cardinal on the REAL chip (donation + folded outboxes)"
+WTPU_CARDINAL_PLATFORM=tpu python tools/cardinal_1m.py 120 \
+    > reports/cardinal_1m_tpu.log 2>&1
+
+echo "[q2] batched-engine A/B at the headline config"
+WTPU_BENCH_BATCHED=1 WTPU_BENCH_REPS=2 python bench.py \
+    > reports/bench_r4_batched.log 2>&1
+
+echo "[q2] pallas availability probe"
+timeout 600 python tools/pallas_probe.py > reports/pallas_probe.log 2>&1
+
+echo "[q2] tier-2 exact-hashed 32768n with big-leaf donation"
+WTPU_BENCH_NODES=32768 WTPU_BENCH_SEEDS=1 WTPU_BENCH_MS=2400 \
+    WTPU_BENCH_REPS=1 WTPU_BENCH_EMISSION=hashed WTPU_BENCH_POOL=0 \
+    WTPU_BENCH_QUEUE=7 WTPU_BENCH_BOX_SPLIT=2 WTPU_BENCH_DONATE=big \
+    python bench.py > reports/bench_r4_exact32k.log 2>&1
+
+echo "[q2] dfinity variance (32 seeds x 300 s)"
+python tools/dfinity_variance.py 32 300 > reports/dfinity_variance.log 2>&1
+
+echo "[q2] reference-scale scenario sweeps (2048 x 8)"
+python tools/scenario_sweeps_2048.py > reports/sweeps_2048.log 2>&1
+
+echo "[q2] emission drift 8192 honest x 8 seeds"
+python -m wittgenstein_tpu.scenarios.emission_drift reports 8192 8 \
+    > reports/emission_8192.log 2>&1
+
+echo "[q2] emission drift attacks at 1024 x 8 seeds"
+python - > reports/emission_attacks.log 2>&1 <<'PYEOF'
+from wittgenstein_tpu.scenarios.emission_drift import compare
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="byzantine_suicide", dead_ratio=0.25)
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="hidden_byzantine", dead_ratio=0.25)
+PYEOF
+
+echo "[q2] done"

@@ -102,8 +102,10 @@ def _batched_bin(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
         n_dropped
 
 
-def _batched_inbox(cfg: EngineConfig, model, net: NetState, t):
-    """build_inbox for the batched state ([R, ...] leaves), bcast-free."""
+def _batched_inbox(cfg: EngineConfig, net: NetState, t):
+    """build_inbox for the batched state ([R, ...] leaves).  No `model`
+    parameter: the broadcast recompute that needs the latency model is
+    unreachable here (bcast_slots == 0 by precondition)."""
     nodes = net.nodes
     n, c, f = cfg.n, cfg.inbox_cap, cfg.payload_words
     p, ns = cfg.box_split, cfg.split_n
@@ -150,9 +152,9 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
     r = net.box_count.shape[0]
     t = net.time[0]
 
-    inbox0, nodes = _batched_inbox(cfg, model, net, t)
+    inbox0, nodes = _batched_inbox(cfg, net, t)
     net = net.replace(nodes=nodes)
-    inbox1, nodes = _batched_inbox(cfg, model, net, t + 1)
+    inbox1, nodes = _batched_inbox(cfg, net, t + 1)
     net = net.replace(nodes=nodes)
 
     def pstep(ps, nodes_r, inbox_r, seed, tt, hints):
@@ -208,6 +210,10 @@ def scan_chunk_batched(protocol, ms: int, t0_mod=None):
         raise ValueError("scan_chunk_batched needs an even chunk and a "
                          "spill-free, broadcast-free, superstep-eligible "
                          "protocol")
+    if t0_mod is not None and t0_mod % 2:
+        raise ValueError(f"scan_chunk_batched needs an even entry time "
+                         f"(t0_mod={t0_mod}) — same contract as "
+                         "scan_chunk(superstep=2)")
     lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
         else None
     if lcm and lcm % 2:

@@ -492,6 +492,21 @@ def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
     return net.replace(time=t + 2), pstate
 
 
+def split_spec(example, threshold=1 << 20):
+    """(treedef, big_idx) for `split_donate_jit`: which leaves of the
+    example state pytree are 'big' (>= threshold bytes) and get donated.
+    The ONE place the predicate lives — Runner, bench.py and
+    tools/cardinal_1m.py all derive their split through it.  Works on
+    concrete arrays and on `jax.eval_shape` results alike."""
+    import numpy as np
+    leaves, treedef = jax.tree.flatten(example)
+    big_idx = frozenset(
+        i for i, x in enumerate(leaves)
+        if int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+        >= threshold)
+    return treedef, big_idx
+
+
 def split_donate_jit(fn, treedef, big_idx):
     """Jit `fn(state_pytree) -> state_pytree` donating ONLY the large
     leaves: the axon TPU plugin fails (INVALID_ARGUMENT, poisoning the
@@ -690,9 +705,6 @@ class Runner:
                 self._jits[key] = jax.jit(base, **kw)
         return self._jits[key]
 
-    def _call(self, fn, net, pstate):
-        return fn(net, pstate)
-
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:
             validate = getattr(self.protocol.latency, "validate", None)
@@ -701,10 +713,8 @@ class Runner:
                 validate(net.nodes)
             self._validated = True
         if self._donate == "big" and self._split is None:
-            leaves, treedef = jax.tree.flatten((net, pstate))
-            self._split = (treedef, frozenset(
-                i for i, x in enumerate(leaves)
-                if x.size * x.dtype.itemsize >= self._donate_threshold))
+            self._split = split_spec((net, pstate),
+                                     self._donate_threshold)
         ms = int(ms)
         # Per-chunk superstep eligibility: even chunk + (statically
         # checkable) even entry time; a tracer entry time conservatively
@@ -725,11 +735,11 @@ class Runner:
             fn = self._chunk_fn(self.chunk_limit,
                                 eff(self.chunk_limit, t_entry))
             for _ in range(whole):
-                net, pstate = self._call(fn, net, pstate)
+                net, pstate = fn(net, pstate)
                 if t_entry is not None:
                     t_entry += self.chunk_limit
             if rem:
-                net, pstate = self._call(
-                    self._chunk_fn(rem, eff(rem, t_entry)), net, pstate)
+                net, pstate = self._chunk_fn(rem, eff(rem, t_entry))(
+                    net, pstate)
             return net, pstate
-        return self._call(self._chunk_fn(ms, eff(ms, t_entry)), net, pstate)
+        return self._chunk_fn(ms, eff(ms, t_entry))(net, pstate)
