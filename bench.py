@@ -91,14 +91,21 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
     donate_big = os.environ.get("WTPU_BENCH_DONATE") == "big"
-    if os.environ.get("WTPU_BENCH_BATCHED") == "1":
+    # Batched (seed-folded) engine is the default: measured 92.3 vs 81.0
+    # agg sim-ms/s at the headline config (BENCH_NOTES.md r4), bit
+    # identical.  WTPU_BENCH_BATCHED=0 falls back to the vmapped path;
+    # superstep=1 falls back automatically UNLESS batched was requested
+    # EXPLICITLY, which would silently mislabel a superstep A/B — refuse
+    # loudly instead.
+    env_batched = os.environ.get("WTPU_BENCH_BATCHED")
+    if env_batched == "1" and superstep != 2:
+        raise ValueError("WTPU_BENCH_BATCHED=1 implies superstep=2 "
+                         "(core/batched.py is hard-wired to the fused "
+                         "2-ms step)")
+    if (env_batched or "1") == "1" and superstep == 2:
         # Seed-folded mailbox machinery (core/batched.py): avoids the
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
-        # bit-identical (tests/test_batched.py).  The batched path is
-        # hard-wired to the fused 2-ms step; refuse configurations that
-        # would silently mislabel a superstep A/B.
-        assert superstep == 2, \
-            "WTPU_BENCH_BATCHED=1 implies superstep=2 (core/batched.py)"
+        # bit-identical (tests/test_batched.py).
         from wittgenstein_tpu.core.batched import scan_chunk_batched
         base = scan_chunk_batched(proto, chunk, t0_mod=t0)
         step = jax.jit(base)

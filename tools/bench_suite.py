@@ -91,11 +91,16 @@ def bench_gsf():
 
 
 def bench_sanfermin():
-    """32k nodes.  inbox_cap 8 keeps each mailbox plane (H*N*C int32)
-    at 512 MB, under the TPU runtime's ~1 GB single-buffer execution
-    limit (BENCH_NOTES.md r3)."""
+    """32k nodes.  inbox_cap 8 dropped 61k messages at this scale (r4
+    first attempt — the optimistic-reply bursts need headroom), so the
+    inbox doubles to 16 and box_split=2 keeps each mailbox sub-plane at
+    512 MB, under the TPU runtime's ~1 GB single-buffer execution limit
+    (BENCH_NOTES.md r3)."""
+    import dataclasses
+
     from wittgenstein_tpu.models.sanfermin import SanFermin
-    proto = SanFermin(node_count=32768, inbox_cap=8)
+    proto = SanFermin(node_count=32768, inbox_cap=16)
+    proto.cfg = dataclasses.replace(proto.cfg, box_split=2)
     seeds = None                                # single seed, unbatched
 
     def check(nets, ps):

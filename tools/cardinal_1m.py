@@ -68,11 +68,15 @@ def main():
     # horizon 128 keeps the flat mailbox ring under the int32 index limit
     # (128 * 2^20 * 4 entries per plane); NetworkUniformLatency(100)
     # keeps every arrival inside the ring, so nothing can clamp or drop.
+    # horizon 96 > Uniform(90)'s max one-way latency + 2, so every
+    # arrival fits the ring (nothing may clamp); the tighter ring plus
+    # cardinal's 2-word messages keep the donated state ~13 GB on a
+    # 15.75 GB chip (the hz128/3-word config measured 17.16 GB — OOM).
     proto = HandelCardinal(
         node_count=n, threshold=int(0.99 * n), nodes_down=0,
         pairing_time=4, dissemination_period_ms=20, fast_path=10,
-        queue_cap=8, inbox_cap=4, horizon=128,
-        network_latency_name="NetworkUniformLatency(100)")
+        queue_cap=8, inbox_cap=4, horizon=96,
+        network_latency_name="NetworkUniformLatency(90)")
     # Keep every ring sub-plane under the TPU runtime's ~1 GB
     # single-buffer execution limit (BENCH_NOTES.md r3): at 2^20 x hz128
     # x ic4 a monolithic plane is 2.1 GB -> split 4 ways (537 MB each).

@@ -17,9 +17,13 @@ keeps o1; reproduced).  The main() demo exercises map partitions
 TPU-native notes: votes and beacon exchanges accumulate as voter bitsets
 ([N, A, Vw] / [N, H, Rw]); majority triggers are evaluated once per tick
 after the whole inbox lands (within-tick message order coarsening —
-statistical equivalence, SURVEY §7.4.3).  Unicast fan-outs (proposal /
-vote to every attester, exchange to every beacon node) queue per node and
-drain one batch per tick.
+statistical equivalence, SURVEY §7.4.3).  Unicast fan-outs queue per
+node and drain one batch per tick, COMMITTEE-addressed: proposals and
+votes go to the target height's attester committee (the strided residue
+class `_my_round` rotates — all attesters when att_rounds == 1, the
+reference-default shape), beacon exchanges to every beacon node.  The
+outbox therefore scales with committee width, not validator count,
+which is what makes 10k-validator configs tractable.
 """
 
 from __future__ import annotations
@@ -105,10 +109,22 @@ class Dfinity:
         # Broadcast budget: every attester re-broadcasts each committee
         # block and every beacon node each beacon result, all alive for
         # `horizon` ticks — size the table for two overlapping waves.
-        k = max(self.n_att, self.n_rb)            # one fan-out batch per tick
+        # Unicast fan-out is COMMITTEE-addressed (proposals/votes go to
+        # the height's attester committee, the strided id set _my_round
+        # rotates; identical to all-attester addressing when att_rounds
+        # == 1, i.e. every reference-default config), so the outbox
+        # width scales with committee size, not validator count — what
+        # makes the 10k-validator tracked config tractable.
+        # Committee width: a residue class holds ceil(n_att/att_rounds)
+        # members when the counts do not divide evenly (15 attesters in
+        # 10-member rounds -> att_rounds 1, class size 15) — size the
+        # fan-out for the largest class, masking overshoot ids at send.
+        self.att_width = -(-self.n_att // self.att_rounds)
+        k = max(self.att_width, self.n_rb)        # one fan-out batch per tick
         self.cfg = EngineConfig(
             n=self.node_count, horizon=horizon,
-            inbox_cap=inbox_cap or (self.n_att + self.bp_per_round + 8),
+            inbox_cap=inbox_cap or (self.att_width +
+                                    self.bp_per_round + 8),
             payload_words=2, out_deg=k, bcast_slots=bcast_slots)
 
     # role masks ------------------------------------------------------
@@ -389,37 +405,51 @@ class Dfinity:
 
         # ---- outbox ----
         K = self.cfg.out_deg
+        A = self.att_width
         dest = jnp.full((n, K), -1, jnp.int32)
         payload = jnp.zeros((n, K, 2), jnp.int32)
-        att_ids = 1 + jnp.arange(self.n_att, dtype=jnp.int32)
         rb_ids = 1 + self.n_att + self.n_bp + \
             jnp.arange(self.n_rb, dtype=jnp.int32)
 
-        # proposal batch to all attesters
+        def committee_ids(hh):
+            # Height hh's attester committee: the strided residue class
+            # _my_round selects for that round ((id-1) % att_rounds ==
+            # hh % att_rounds), width = the LARGEST class (att_width);
+            # ids past n_att (short classes / non-divisible counts) are
+            # masked to -1.  att_rounds == 1 yields every attester — the
+            # reference-default configuration.
+            ids_c = (1 + (hh[:, None] % self.att_rounds) +
+                     jnp.arange(A, dtype=jnp.int32)[None, :] *
+                     self.att_rounds)
+            return jnp.where(ids_c <= self.n_att, ids_c, -1)
+
+        # proposal batch to the proposal height's committee
         send_prop = (p.q_prop >= 0) & alive
-        dest = dest.at[:, :self.n_att].set(
-            jnp.where(send_prop[:, None], att_ids[None, :], -1))
-        payload = payload.at[:, :self.n_att, 0].set(
+        prop_h = p.arena.height[jnp.maximum(p.q_prop, 0)]
+        dest = dest.at[:, :A].set(
+            jnp.where(send_prop[:, None], committee_ids(prop_h), -1))
+        payload = payload.at[:, :A, 0].set(
             jnp.where(send_prop[:, None], K_PROPOSAL, 0))
-        payload = payload.at[:, :self.n_att, 1].set(p.q_prop[:, None])
+        payload = payload.at[:, :A, 1].set(p.q_prop[:, None])
         p = p.replace(q_prop=jnp.where(send_prop, -1, p.q_prop))
 
-        # else: one vote batch per tick to all attesters
+        # else: one vote batch per tick to the voted block's committee
         has_v = jnp.any(p.q_vote != 0, axis=1) & ~send_prop & alive
         fw = jnp.argmax(p.q_vote != 0, axis=1).astype(jnp.int32)
         word = jnp.take_along_axis(p.q_vote, fw[:, None], axis=1)[:, 0]
         low = word & (~word + U32(1))
         bpos = 31 - jax.lax.clz(jnp.maximum(low, U32(1)).astype(jnp.int32))
         vblk = jnp.clip(fw * 32 + bpos, 0, self.capacity - 1)
-        dest = dest.at[:, :self.n_att].set(
-            jnp.where(has_v[:, None], att_ids[None, :],
-                      dest[:, :self.n_att]))
-        payload = payload.at[:, :self.n_att, 0].set(
+        vote_h = p.arena.height[vblk]
+        dest = dest.at[:, :A].set(
+            jnp.where(has_v[:, None], committee_ids(vote_h),
+                      dest[:, :A]))
+        payload = payload.at[:, :A, 0].set(
             jnp.where(has_v[:, None], K_VOTE,
-                      payload[:, :self.n_att, 0]))
-        payload = payload.at[:, :self.n_att, 1].set(
+                      payload[:, :A, 0]))
+        payload = payload.at[:, :A, 1].set(
             jnp.where(has_v[:, None], vblk[:, None],
-                      payload[:, :self.n_att, 1]))
+                      payload[:, :A, 1]))
         p = p.replace(q_vote=jnp.where(
             has_v[:, None], p.q_vote & ~bitset.one_bit(vblk, self.aw),
             p.q_vote))

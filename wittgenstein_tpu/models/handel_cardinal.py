@@ -168,7 +168,7 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
                              np.int32)
         k = (self.levels - 1) + fast_path
         self.cfg = EngineConfig(n=node_count, horizon=horizon,
-                                inbox_cap=inbox_cap, payload_words=3,
+                                inbox_cap=inbox_cap, payload_words=2,
                                 out_deg=k, bcast_slots=0)
 
     # ------------------------------------------------------------ primitives
@@ -289,7 +289,7 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
         halfs_arr = jnp.asarray(self.half)
         # The reference throws on size-overflowing aggregates
         # (HLevel.java:188-190); bounded shapes clip instead.
-        cnt = jnp.clip(inbox.data[:, :, 2], 0, halfs_arr[level])
+        cnt = jnp.clip(inbox.data[:, :, 1], 0, halfs_arr[level])
 
         # Filters (Handel.java:755-763): done -> counted; pre-start or
         # blacklisted sender -> silently ignored.
@@ -554,7 +554,6 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
                                     p.added_cycle)
 
             og_complete = og_size >= halfs
-            inc_complete = p.lvl_best >= halfs
             lvl_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
             is_open = ((t >= (lvl_idx - 1) * self.level_wait_time) |
                        og_complete) & (halfs > 0)
@@ -571,12 +570,12 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
             # SendSigs size (bytes): 1 + expected/8 + 96*2 (:255-259).
             sz_l = 1 + halfs // 8 + 192                        # [1, L]
             lvl_dest = jnp.where(send_l, peer, -1)[:, 1:]      # [N, L-1]
-            # Word 1 (levelFinished flag) is wire-format parity with exact
-            # mode only: cardinal receivers ignore it (no finishedPeers
-            # tracking), but message introspection tooling still sees the
-            # same 3-word layout.
+            # 2-word wire format (level, count): cardinal has no
+            # finishedPeers tracking, so exact mode's levelFinished flag
+            # word is dropped entirely — one fewer [H*N*C] mailbox plane
+            # (2.1 GB at 2^20 nodes; the flag carried no information for
+            # cardinal receivers).
             lvl_words = (jnp.broadcast_to(lvl_idx, (n, L))[:, 1:],
-                         inc_complete.astype(jnp.int32)[:, 1:],
                          og_size[:, 1:])
             lvl_sizes = jnp.broadcast_to(sz_l, (n, L))[:, 1:]
         else:
@@ -602,7 +601,6 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
             fast_dest = jnp.where(fsend[:, None], fids, -1)
             fcnt = gather2d(og_size, ids, fl)
             fast_words = (jnp.broadcast_to(fl[:, None], (n, fp)),
-                          jnp.zeros((n, fp), jnp.int32),
                           jnp.broadcast_to(fcnt[:, None], (n, fp)))
             fast_sizes = jnp.broadcast_to((1 + fhalf // 8 + 192)[:, None],
                                           (n, fp))
@@ -618,7 +616,7 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
             fcols = 0 if periodic else 1
             fast_dest = jnp.full((n, fcols), -1, jnp.int32)
             fast_words = tuple(jnp.zeros((n, fcols), jnp.int32)
-                               for _ in range(3))
+                               for _ in range(2))
             fast_sizes = jnp.ones((n, fcols), jnp.int32)
 
         if periodic:
