@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 phase-4 chip queue: the fixed 1M run first, then the
+# remaining items.
+cd "$(dirname "$0")/.."
+while pgrep -f "python bench.py" > /dev/null; do sleep 30; done
+
+echo "[q4] 1M cardinal on the REAL chip (plain per-ms scan — the phased"
+echo "     block's 63% HBM fragmentation was the last OOM)"
+WTPU_CARDINAL_PLATFORM=tpu python tools/cardinal_1m.py 120 \
+    > reports/cardinal_1m_tpu.log 2>&1
+
+echo "[q4] tier-2 exact 32768n, plain per-ms scan + donation"
+WTPU_BENCH_NODES=32768 WTPU_BENCH_SEEDS=1 WTPU_BENCH_MS=2400 \
+    WTPU_BENCH_REPS=1 WTPU_BENCH_EMISSION=hashed WTPU_BENCH_POOL=0 \
+    WTPU_BENCH_QUEUE=7 WTPU_BENCH_BOX_SPLIT=2 WTPU_BENCH_DONATE=big \
+    WTPU_BENCH_SPEC=0 WTPU_BENCH_SUPERSTEP=1 \
+    python bench.py > reports/bench_r4_exact32k.log 2>&1
+
+echo "[q4] dfinity variance (32 seeds x 300 s)"
+python tools/dfinity_variance.py 32 300 > reports/dfinity_variance.log 2>&1
+
+echo "[q4] suite retry: sanfermin + dfinity tracked configs"
+python tools/bench_suite.py sanfermin_32768n dfinity_10k_validators \
+    >> reports/bench_suite_r4.jsonl 2>reports/bench_suite_retry.log
+
+echo "[q4] reference-scale scenario sweeps (2048 x 8)"
+python tools/scenario_sweeps_2048.py > reports/sweeps_2048.log 2>&1
+
+echo "[q4] emission drift 8192 honest x 8 seeds"
+python -m wittgenstein_tpu.scenarios.emission_drift reports 8192 8 \
+    > reports/emission_8192.log 2>&1
+
+echo "[q4] emission drift attacks at 1024 x 8 seeds"
+python - > reports/emission_attacks.log 2>&1 <<'PYEOF'
+from wittgenstein_tpu.scenarios.emission_drift import compare
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="byzantine_suicide", dead_ratio=0.25)
+compare(nodes=1024, seeds=8, max_time=10000, out_dir="reports",
+        attack="hidden_byzantine", dead_ratio=0.25)
+PYEOF
+
+echo "[q4] done"
