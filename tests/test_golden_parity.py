@@ -18,6 +18,14 @@ We run shorter windows (the block process is round-i.i.d., so rates
 transfer) with a different RNG than the JVM's, and assert the RATES /
 MEANS land in a band around the published values — statistical
 equivalence, not bit parity (SURVEY §7.4.3).
+
+The bands are grounded in data (round 4): a 32-seed x 300-s variance
+study per condition (reports/DFINITY_VARIANCE.md) measured bad-network
+rates at 1.149-1.173x the published sample (entirely inside the
+[-15%, +20%] band, matching the r2 structural analysis), the
+perfect-network rate deterministic at one block per round, and the
+partition/base ratio spanning 0.0-1.0 per seed around mean 0.842 vs
+the published 0.821 single sample.
 """
 
 import numpy as np
