@@ -91,16 +91,16 @@ def bench_gsf():
 
 
 def bench_sanfermin():
-    """32k nodes.  inbox_cap 8 dropped 61k messages at this scale (r4
-    first attempt — the optimistic-reply bursts need headroom), so the
-    inbox doubles to 16 and box_split=2 keeps each mailbox sub-plane at
-    512 MB, under the TPU runtime's ~1 GB single-buffer execution limit
-    (BENCH_NOTES.md r3)."""
+    """32k nodes.  The optimistic-reply bursts concentrate hard at this
+    scale: inbox_cap 8 dropped 61,684 messages, 16 still dropped
+    20,005 (r4 attempts) — so 32, with box_split=4 keeping each mailbox
+    sub-plane at 512 MB, under the TPU runtime's ~1 GB single-buffer
+    execution limit (BENCH_NOTES.md r3)."""
     import dataclasses
 
     from wittgenstein_tpu.models.sanfermin import SanFermin
-    proto = SanFermin(node_count=32768, inbox_cap=16)
-    proto.cfg = dataclasses.replace(proto.cfg, box_split=2)
+    proto = SanFermin(node_count=32768, inbox_cap=32)
+    proto.cfg = dataclasses.replace(proto.cfg, box_split=4)
     seeds = None                                # single seed, unbatched
 
     def check(nets, ps):
