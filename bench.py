@@ -71,7 +71,7 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         if os.environ.get("WTPU_BENCH_POOL"):
             kw["snapshot_pool"] = os.environ["WTPU_BENCH_POOL"] == "1"
         if os.environ.get("WTPU_BENCH_QUEUE"):
-            kw["queue_cap"] = int(os.environ["WTPU_BENCH_QUEUE"])
+            kw["queue_cap"] = _int_env("WTPU_BENCH_QUEUE", 16)
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,
@@ -217,11 +217,53 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     }
 
 
-def _backend_up(timeout_s=240):
-    """True iff the accelerator backend initializes within the timeout: a
-    wedged device tunnel makes `jax.devices()` hang forever, which would
-    otherwise hang the benchmark driver instead of reporting an
-    infrastructure condition."""
+def _int_list_env(name, default):
+    """Parse a comma-separated int list from the environment, falling
+    back to `default` on ANY malformed value: a bad override must not
+    crash the bench before it emits a metric line (the null result the
+    fallback machinery exists to prevent)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        vals = [int(x) for x in raw.split(",") if x.strip()]
+    except ValueError:
+        vals = []
+    if not vals or any(v <= 0 for v in vals):
+        # Non-positive values are as unusable as non-numeric ones: a
+        # negative sleep raises, and a negative probe timeout would make
+        # probe_backend's parent-side backstop kill the child mid-init —
+        # the tunnel-wedging action the subprocess design exists to avoid.
+        print(f"bench: ignoring malformed {name}={raw!r}; using "
+              f"{default}", file=sys.stderr)
+        return default
+    return vals
+
+
+def _int_env(name, default):
+    """One tolerant scalar-int env read: a malformed override must not
+    crash the bench before it emits its metric line.  Every WTPU_BENCH_*
+    scalar is a count (nodes, seeds, ms, caps, reps), so non-positive
+    values are rejected along with non-numeric ones."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val <= 0:
+        print(f"bench: ignoring malformed {name}={raw!r}; using "
+              f"{default}", file=sys.stderr)
+        return default
+    return val
+
+
+def _parent_init_bounded(timeout_s):
+    """Bounded backend init in THIS process (the old in-process probe,
+    kept as the parent's watchdog): True iff jax.devices() completes in
+    time.  On timeout the init thread is abandoned — the caller must not
+    keep using this process's backend (it re-execs)."""
     import threading
     done = threading.Event()
     err = []
@@ -236,14 +278,91 @@ def _backend_up(timeout_s=240):
 
     threading.Thread(target=probe, daemon=True).start()
     if not done.wait(timeout_s):
-        print(f"bench: backend did not initialize within {timeout_s}s "
-              "(device tunnel down?)", file=sys.stderr)
+        print(f"bench: parent backend init did not finish within "
+              f"{timeout_s}s", file=sys.stderr)
         return False
     if err:
-        print(f"bench: backend failed to initialize: {err[0]!r}",
+        print(f"bench: parent backend init failed: {err[0]!r}",
               file=sys.stderr)
         return False
     return True
+
+
+def _probe_ladder_or_fallback():
+    """Tunnel-wedge recovery (VERDICT r4 #2): before conceding a CPU
+    fallback, walk a ladder of growing probe timeouts.  Each probe runs
+    in a fresh SUBPROCESS (`utils.platform.probe_backend` — the child
+    exits cleanly on its own timeout; nothing is killed mid-init, which
+    is what wedges the tunnel), so this parent never touches the backend
+    until a probe has succeeded.
+
+    Why a ladder: backend init on the tunnel legitimately takes seconds
+    to 10+ minutes under host CPU contention (BENCH_NOTES.md), so a
+    single short probe misdiagnoses a slow-but-healthy tunnel as down —
+    the round-4 driver capture recorded a CPU fallback for exactly that
+    class of failure.
+
+    Returns only when the backend is up; otherwise re-execs the labeled
+    CPU-fallback config and never returns.
+    """
+    import time
+
+    from wittgenstein_tpu.utils.platform import probe_backend
+    timeouts = _int_list_env("WTPU_BENCH_PROBE_TIMEOUTS", [300, 900, 1500])
+    sleeps = _int_list_env("WTPU_BENCH_PROBE_SLEEPS", [60, 120])
+    for attempt, t in enumerate(timeouts):
+        t0 = time.perf_counter()
+        if probe_backend(t):
+            # The child proved the tunnel up; now bound THIS process's own
+            # backend init too (the tunnel can wedge between the two, and
+            # an unbounded first jax call here would hang the driver with
+            # no metric line).  Full ladder patience, not this rung's: a
+            # healthy init can take 10+ minutes under host contention.
+            # A parent that fails after a successful child probe is
+            # poisoned — skip the rest of the ladder and re-exec the
+            # labeled CPU fallback directly.
+            if _parent_init_bounded(max(timeouts)):
+                return
+            print("bench: parent backend init failed after a successful "
+                  "probe; falling back to the labeled CPU config",
+                  file=sys.stderr)
+            break
+        if attempt + 1 < len(timeouts):
+            # Deliberately NO short-circuit on a fast-raising backend:
+            # the observed down-tunnel signature IS a raise (UNAVAILABLE
+            # after ~25 min, BENCH_NOTES.md) that recovers later, and
+            # fast transient raises exist too — the cause is in the log
+            # (probe child stderr), and retrying a fast failure costs
+            # only the sleep.
+            pause = sleeps[min(attempt, len(sleeps) - 1)]
+            print(f"bench: probe attempt {attempt + 1}/{len(timeouts)} "
+                  f"failed after {time.perf_counter() - t0:.0f}s "
+                  f"(limit {t}s); sleeping {pause}s before the next "
+                  "ladder step", file=sys.stderr)
+            time.sleep(pause)
+    else:
+        print(f"bench: all {len(timeouts)} probe attempts failed",
+              file=sys.stderr)
+    # Unreachable accelerator (ladder exhausted, or a parent init that
+    # failed after a successful probe).  Re-exec into a clean CPU process
+    # and emit an explicitly-labeled small-config CPU number rather than
+    # nothing: perf evidence with provenance beats a null.  TPU-scale
+    # WTPU_BENCH_* overrides must not ride onto the 1-core CPU (65k
+    # nodes there needs ~43 GB and hours — reports/TIER2_CPU.md).
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               WTPU_BENCH_FALLBACK="1",
+               WTPU_BENCH_NODES=str(min(
+                   256, _int_env("WTPU_BENCH_NODES", 256))),
+               WTPU_BENCH_SEEDS=str(min(
+                   2, _int_env("WTPU_BENCH_SEEDS", 2))),
+               WTPU_BENCH_MS=str(min(
+                   1000, _int_env("WTPU_BENCH_MS", 1000))),
+               WTPU_BENCH_HORIZON=str(min(
+                   256, _int_env("WTPU_BENCH_HORIZON", 256))),
+               WTPU_BENCH_INBOX=str(min(
+                   12, _int_env("WTPU_BENCH_INBOX", 12))))
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
 
 
 def main():
@@ -259,44 +378,24 @@ def main():
         # and without it this child would skip the probe and hang in
         # jax.devices() — the exact condition the fallback exists to avoid.
         jax.config.update("jax_platforms", "cpu")
-    if not fallback and not _backend_up():
-        # The accelerator is unreachable.  Re-exec into a clean CPU
-        # process (this one may hold a poisoned half-initialized backend)
-        # and emit an explicitly-labeled small-config CPU number rather
-        # than nothing: perf evidence with provenance beats a null.
-        # Force the small config outright: TPU-scale WTPU_BENCH_* overrides
-        # must not ride onto the 1-core CPU (65k nodes there needs ~43 GB
-        # and hours — reports/TIER2_CPU.md).
-        env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
-                   WTPU_BENCH_FALLBACK="1",
-                   WTPU_BENCH_NODES=str(min(
-                       256, int(os.environ.get("WTPU_BENCH_NODES", 256)))),
-                   WTPU_BENCH_SEEDS=str(min(
-                       2, int(os.environ.get("WTPU_BENCH_SEEDS", 2)))),
-                   WTPU_BENCH_MS=str(min(
-                       1000, int(os.environ.get("WTPU_BENCH_MS", 1000)))),
-                   WTPU_BENCH_HORIZON=str(min(256, int(
-                       os.environ.get("WTPU_BENCH_HORIZON", 256)))),
-                   WTPU_BENCH_INBOX=str(min(12, int(
-                       os.environ.get("WTPU_BENCH_INBOX", 12)))))
-        os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
-    n = int(os.environ.get("WTPU_BENCH_NODES", 2048))
-    seeds = int(os.environ.get("WTPU_BENCH_SEEDS", 16))
-    sim_ms = int(os.environ.get("WTPU_BENCH_MS", 1000))
+    if not fallback:
+        _probe_ladder_or_fallback()
+    n = _int_env("WTPU_BENCH_NODES", 2048)
+    seeds = _int_env("WTPU_BENCH_SEEDS", 16)
+    sim_ms = _int_env("WTPU_BENCH_MS", 1000)
     mode = os.environ.get("WTPU_BENCH_MODE", "exact")
-    horizon = int(os.environ.get("WTPU_BENCH_HORIZON", 256))
+    horizon = _int_env("WTPU_BENCH_HORIZON", 256)
     # inbox 12 measured drop-free at both the 2048-node headline config
     # and the 65536-node cardinal tier-2 config (BENCH_NOTES.md r3).
-    inbox_cap = int(os.environ.get("WTPU_BENCH_INBOX", 12))
-    reps = int(os.environ.get("WTPU_BENCH_REPS", 3))
+    inbox_cap = _int_env("WTPU_BENCH_INBOX", 12)
+    reps = _int_env("WTPU_BENCH_REPS", 3)
     # superstep=2 fuses engine work across ms pairs (core/network.step_2ms,
     # bit-identical — tests/test_superstep.py).
-    superstep = int(os.environ.get("WTPU_BENCH_SUPERSTEP", 2))
+    superstep = _int_env("WTPU_BENCH_SUPERSTEP", 2)
     # Seed counts past the single-chip vmap ceiling run as sequential
     # microbatches (the 256-seed path, RunMultipleTimes.java:41-87).
-    seed_batch = int(os.environ.get("WTPU_BENCH_SEED_BATCH", 16))
-    box_split = int(os.environ.get("WTPU_BENCH_BOX_SPLIT", 1))
+    seed_batch = _int_env("WTPU_BENCH_SEED_BATCH", 16)
+    box_split = _int_env("WTPU_BENCH_BOX_SPLIT", 1)
     try:
         if seeds > seed_batch:
             res = bench_handel_microbatched(

@@ -35,7 +35,13 @@ def p():
 threading.Thread(target=p, daemon=True).start()
 if not done.wait({timeout}):
     sys.exit(3)
-sys.exit(4 if err else 0)
+if err:
+    # The cause must reach the caller's log (exit code 4 alone says
+    # nothing): a deterministic fast-failing backend and a wedged tunnel
+    # need different operator responses.
+    print("backend probe failed:", repr(err[0]), file=sys.stderr)
+    sys.exit(4)
+sys.exit(0)
 """
 
 
