@@ -232,3 +232,36 @@ def test_message_filtering_after_done():
     # Done nodes kept receiving (extraCycle senders) but filtered the
     # messages (onNewSig, Handel.java:755-758).
     assert int(np.asarray(p.msg_filtered).sum()) > 0
+
+
+def test_pallas_merge_path_bit_equal():
+    """The fused Pallas delivery-merge kernel (ops/pallas_merge.py,
+    interpret mode on CPU) leaves the ENTIRE simulation bit-identical:
+    full pytree equality after a run, plain and vmapped-over-seeds."""
+    import jax
+    from wittgenstein_tpu.core.network import scan_chunk
+
+    n, down = 128, 12
+    kw = dict(node_count=n, threshold=int(0.99 * (n - down)),
+              nodes_down=down, pairing_time=4, level_wait_time=50,
+              dissemination_period_ms=20, fast_path=10)
+    ref = Handel(pallas_merge=False, **kw)
+    ker = Handel(pallas_merge=True, **kw)
+
+    outs = []
+    for proto in (ref, ker):
+        net, p = proto.init(3)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 600)
+        outs.append((net, p))
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # vmapped over seeds (the bench's execution shape): the pallas_call
+    # batching rule must compose with vmap bit-identically.
+    vouts = []
+    for proto in (ref, ker):
+        nets, ps = jax.vmap(proto.init)(jnp.arange(2, dtype=jnp.int32))
+        nets, ps = jax.jit(jax.vmap(scan_chunk(proto, 200)))(nets, ps)
+        vouts.append((nets, ps))
+    for a, b in zip(jax.tree.leaves(vouts[0]), jax.tree.leaves(vouts[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

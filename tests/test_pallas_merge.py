@@ -1,0 +1,126 @@
+"""Bit-equality of the fused Pallas delivery-merge kernel
+(ops/pallas_merge.py, interpret mode on CPU) against the reference XLA
+implementation `_levels.merge_bounded_queue` — every output column,
+including the junk lvl/rank/sig values carried by invalid slots.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.models._levels import merge_bounded_queue
+from wittgenstein_tpu.ops.pallas_merge import merge_queue_pallas
+
+
+def _random_case(rng, n, q_cap, s_cap, w, n_ids, dup_rate=0.3,
+                 fill=0.7):
+    """A randomized (queue, inbox) pair with deliberate (sender, level)
+    collisions across inbox slots and against the queue."""
+    q_from = np.where(rng.random((n, q_cap)) < fill,
+                      rng.integers(0, n_ids, (n, q_cap)), -1).astype(
+                          np.int32)
+    q_lvl = rng.integers(0, 8, (n, q_cap)).astype(np.int32)
+    q_rank = rng.integers(0, 2 * n_ids, (n, q_cap)).astype(np.int32)
+    q_bad = rng.random((n, q_cap)) < 0.2
+    q_sig = rng.integers(0, 2 ** 32, (n, q_cap, w), dtype=np.uint32)
+
+    src = rng.integers(0, n_ids, (n, s_cap)).astype(np.int32)
+    level = rng.integers(0, 8, (n, s_cap)).astype(np.int32)
+    # Planted collisions: some inbox slots repeat another slot's
+    # (sender, level); some repeat a queued entry's.
+    for i in range(n):
+        for s in range(s_cap):
+            r = rng.random()
+            if r < dup_rate and s > 0:
+                s2 = rng.integers(0, s)
+                src[i, s] = src[i, s2]
+                level[i, s] = level[i, s2]
+            elif r < 2 * dup_rate:
+                qq = rng.integers(0, q_cap)
+                if q_from[i, qq] >= 0:
+                    src[i, s] = q_from[i, qq]
+                    level[i, s] = q_lvl[i, qq]
+    rank_all = rng.integers(0, 2 * n_ids, (n, s_cap)).astype(np.int32)
+    ok = rng.random((n, s_cap)) < 0.6
+    sig_all = rng.integers(0, 2 ** 32, (n, s_cap, w), dtype=np.uint32)
+    return (jnp.asarray(q_from), jnp.asarray(q_lvl), jnp.asarray(q_rank),
+            jnp.asarray(q_bad), jnp.asarray(q_sig), jnp.asarray(src),
+            jnp.asarray(level), jnp.asarray(rank_all), jnp.asarray(ok),
+            jnp.asarray(sig_all))
+
+
+def _reference(q_from, q_lvl, q_rank, q_bad, q_sig, src, level,
+               rank_all, ok, sig_all, q_cap):
+    sel2, sel3, ev = merge_bounded_queue(
+        q_from, q_lvl, q_rank, src, level, rank_all, ok, q_cap,
+        {"bad": (q_bad, jnp.zeros_like(ok))},
+        {"sig": (q_sig, sig_all)})
+    return (sel2["from"], sel2["lvl"], sel2["rank"], sel2["bad"],
+            sel3["sig"], ev)
+
+
+@pytest.mark.parametrize("q_cap,s_cap,w", [(16, 12, 8), (8, 4, 2),
+                                           (4, 16, 4)])
+def test_merge_kernel_bit_equal(q_cap, s_cap, w):
+    rng = np.random.default_rng(q_cap * 100 + s_cap)
+    args = _random_case(rng, 64, q_cap, s_cap, w, n_ids=256)
+    ref = _reference(*args, q_cap=q_cap)
+    got = merge_queue_pallas(*args, q_cap=q_cap, interpret=True)
+    for name, r, g in zip(("from", "lvl", "rank", "bad", "sig",
+                           "evicted"), ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=name)
+
+
+def test_merge_kernel_empty_and_full():
+    """All-empty queue + all-valid inbox, and full queue + no valid
+    incoming — the two boundary regimes."""
+    rng = np.random.default_rng(7)
+    q_cap, s_cap, w = 8, 8, 4
+    args = list(_random_case(rng, 32, q_cap, s_cap, w, n_ids=128))
+    # empty queue
+    a = list(args)
+    a[0] = jnp.full_like(a[0], -1)
+    a[8] = jnp.ones_like(a[8])                  # all ok
+    ref = _reference(*a, q_cap=q_cap)
+    got = merge_queue_pallas(*a, q_cap=q_cap, interpret=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # full queue, nothing valid incoming
+    b = list(args)
+    b[0] = jnp.abs(b[0])                        # all filled
+    b[8] = jnp.zeros_like(b[8])                 # nothing ok
+    ref = _reference(*b, q_cap=q_cap)
+    got = merge_queue_pallas(*b, q_cap=q_cap, interpret=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_merge_kernel_rank_ties():
+    """Equal ranks across existing and incoming: existing entries must
+    win, then incoming by slot order (the position tie-break)."""
+    q_cap, s_cap, w = 4, 4, 2
+    n = 16
+    q_from = jnp.full((n, q_cap), 5, jnp.int32)
+    q_lvl = jnp.asarray(np.tile(np.arange(q_cap, dtype=np.int32),
+                                (n, 1)))
+    q_rank = jnp.full((n, q_cap), 7, jnp.int32)
+    q_bad = jnp.zeros((n, q_cap), bool)
+    q_sig = jnp.asarray(
+        np.arange(n * q_cap * w, dtype=np.uint32).reshape(n, q_cap, w))
+    src = jnp.full((n, s_cap), 9, jnp.int32)
+    level = jnp.asarray(np.tile(np.arange(s_cap, dtype=np.int32) + 4,
+                                (n, 1)))
+    rank_all = jnp.full((n, s_cap), 7, jnp.int32)
+    ok = jnp.ones((n, s_cap), bool)
+    sig_all = jnp.asarray(
+        (np.arange(n * s_cap * w, dtype=np.uint32) + 999).reshape(
+            n, s_cap, w))
+    args = (q_from, q_lvl, q_rank, q_bad, q_sig, src, level, rank_all,
+            ok, sig_all)
+    ref = _reference(*args, q_cap=q_cap)
+    got = merge_queue_pallas(*args, q_cap=q_cap, interpret=True)
+    for name, r, g in zip(("from", "lvl", "rank", "bad", "sig", "ev"),
+                          ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=name)

@@ -144,7 +144,8 @@ class Handel(LevelMixin, StaticScheduleMixin):
                  window_max=128, queue_cap=16, inbox_cap=16, horizon=512,
                  emission_lookahead=8, byzantine_suicide=False,
                  hidden_byzantine=False, emission_mode=None,
-                 snapshot_pool=None, prefix_pc=None, mode="exact"):
+                 snapshot_pool=None, prefix_pc=None, pallas_merge=None,
+                 mode="exact"):
         # `mode` is consumed by __new__ ("cardinal" dispatches to
         # HandelCardinal before this body runs); it reaches here only as
         # "exact".
@@ -177,6 +178,19 @@ class Handel(LevelMixin, StaticScheduleMixin):
                              "emission_mode='hashed' past 32768 nodes")
         self.emission_mode = emission_mode
         self.snapshot_pool = snapshot_pool
+        # Fused Pallas delivery-merge kernel (ops/pallas_merge.py) —
+        # bit-identical to the XLA merge (tests/test_pallas_merge.py,
+        # test_handel.py::test_pallas_merge_path_bit_equal).  None =
+        # auto: on for TPU backends when WTPU_PALLAS != "0" (flip the
+        # default once chip-validated); CPU runs with pallas_merge=True
+        # go through the Pallas interpreter.  Resolved HERE, once — the
+        # instance is inspectable and the decision cannot flip between
+        # retraces (same policy as prefix_pc above).
+        if pallas_merge is None:
+            import os
+            pallas_merge = (os.environ.get("WTPU_PALLAS", "0") != "0"
+                            and jax.default_backend() == "tpu")
+        self.pallas_merge = pallas_merge
         # Past ~16k nodes the [N, W, L] word->level one-hot for the MXU
         # popcount contraction is gigabytes; the prefix-sum path computes
         # the SAME values (tested bit-equal) in O(N * W).
@@ -430,14 +444,23 @@ class Handel(LevelMixin, StaticScheduleMixin):
         # shared bounded-queue policy (_levels.merge_bounded_queue): one
         # entry per (sender, level) — newest wins — keep the Q best
         # (lowest-reception-rank) candidates.
-        sel2, sel3, ev = merge_bounded_queue(
-            p.q_from, p.q_lvl, p.q_rank, src, level, rank_all, ok, Q,
-            {"bad": (p.q_bad, jnp.zeros_like(ok))},
-            {"sig": (p.q_sig, sig_all)})
+        if self.pallas_merge:
+            from ..ops.pallas_merge import merge_queue_pallas
+            q_f, q_l, q_r, q_b, q_s, ev = merge_queue_pallas(
+                p.q_from, p.q_lvl, p.q_rank, p.q_bad, p.q_sig,
+                src, level, rank_all, ok, sig_all, q_cap=Q,
+                interpret=jax.default_backend() != "tpu")
+        else:
+            sel2, sel3, ev = merge_bounded_queue(
+                p.q_from, p.q_lvl, p.q_rank, src, level, rank_all, ok, Q,
+                {"bad": (p.q_bad, jnp.zeros_like(ok))},
+                {"sig": (p.q_sig, sig_all)})
+            q_f, q_l, q_r, q_b, q_s = (sel2["from"], sel2["lvl"],
+                                       sel2["rank"], sel2["bad"],
+                                       sel3["sig"])
 
-        return p.replace(q_from=sel2["from"], q_lvl=sel2["lvl"],
-                         q_rank=sel2["rank"], q_bad=sel2["bad"],
-                         q_sig=sel3["sig"], finished_peers=finished,
+        return p.replace(q_from=q_f, q_lvl=q_l, q_rank=q_r, q_bad=q_b,
+                         q_sig=q_s, finished_peers=finished,
                          msg_filtered=p.msg_filtered + filtered,
                          evicted=p.evicted + ev)
 

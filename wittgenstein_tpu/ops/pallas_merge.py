@@ -1,0 +1,202 @@
+"""Fused Pallas delivery-merge kernel — the Handel-family receive path's
+bounded-queue merge (`models/_levels.merge_bounded_queue`) as ONE TPU
+kernel instead of ~20 XLA ops.
+
+Why (reports/PROFILE_r4.md): the XLA form materializes the
+[M, Q+S, W] concatenation of (existing queue ∪ incoming candidates),
+top_k's the keys, then gathers every queue column through the order —
+the queue merge + bit-row gathers were ~30% of on-chip step time at
+the 2048n x 16 headline config.  The kernel streams each node block
+through VMEM once: dup/supersede masks, the key build, the Q-round
+selection and ALL column gathers happen in-register, and the new sig
+plane is written straight back over the old one
+(`input_output_aliases` — no carry copy of the [M, Q, W] plane, the
+largest exact-mode scan-carry leaf).
+
+Semantics are copied from `merge_bounded_queue` EXACTLY (bit-equality
+is tested on every column including the junk lvl/rank/sig values of
+invalid slots — tests/test_pallas_merge.py):
+
+  * one entry per (sender, level): a LATER inbox slot with the same key
+    wins over an earlier one (dup mask), and any surviving incoming
+    entry supersedes a queued entry with the same (sender, level);
+  * keep the q_cap best candidates by ascending
+    ``rank * (Q + S + 1) + position`` — existing entries (positions
+    0..Q-1) win rank ties, then incoming by inbox-slot order;
+  * invalid candidates sort last, by ascending position (lax.top_k's
+    documented lower-index tie rule — made explicit here by giving
+    each invalid entry the unique key ``BIG0 + position``);
+  * evicted_delta counts existing entries displaced by better incoming
+    candidates (rejected incoming messages don't count).
+
+Reference behavior being modeled: Handel.java:753-786 (onNewSig's
+unbounded per-level queues, bounded by the documented queue policy,
+SURVEY.md §7.4.6).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+# Valid keys are rank * (C + 1) + pos with rank < 2N (enforced by the
+# callers' __init__ guards); BIG0 sits far above any valid key and
+# leaves C units of headroom for the per-position invalid keys, and
+# EXCLUDED sits above those.  Every key in play is therefore UNIQUE
+# within its row — the selection loop's exactly-one-hot invariant.
+BIG0 = 0x7FFFFF00          # python ints: jnp constants would be
+EXCLUDED = 0x7FFFFFFF      # captured consts, which pallas_call rejects
+
+
+def _merge_kernel(exf_ref, exl_ref, exr_ref, exb_ref, exs_ref,
+                  isrc_ref, ilvl_ref, irnk_ref, iok_ref, isig_ref,
+                  of_ref, ol_ref, or_ref, ob_ref, os_ref, oev_ref,
+                  *, q_cap, s_cap):
+    """One node block.  All intermediates are 2-D [blk, C]-shaped (or
+    3-D with the W lane axis) — Mosaic vectorizes those directly."""
+    blk = exf_ref.shape[0]
+    c_tot = q_cap + s_cap
+
+    exf = exf_ref[...]                                     # [blk, Q]
+    exl = exl_ref[...]
+    exr = exr_ref[...]
+    exb = exb_ref[...]
+    isrc = isrc_ref[...]                                   # [blk, S]
+    ilvl = ilvl_ref[...]
+    irnk = irnk_ref[...]
+    iok = iok_ref[...] != 0
+
+    # dup: a LATER inbox slot with the same (sender, level) wins.
+    s_idx = jax.lax.broadcasted_iota(I32, (blk, s_cap), 1)
+    dup = jnp.zeros((blk, s_cap), bool)
+    for s2 in range(1, s_cap):
+        dup = dup | ((isrc == isrc[:, s2:s2 + 1]) &
+                     (ilvl == ilvl[:, s2:s2 + 1]) &
+                     iok[:, s2:s2 + 1] & (s_idx < s2))
+    inc_ok = iok & ~dup                                    # [blk, S]
+
+    # superseded: a queued entry displaced by a surviving incoming one.
+    sup = jnp.zeros((blk, q_cap), bool)
+    for s in range(s_cap):
+        sup = sup | ((exf == isrc[:, s:s + 1]) &
+                     (exl == ilvl[:, s:s + 1]) & inc_ok[:, s:s + 1])
+    ex_keep = (exf >= 0) & ~sup                            # [blk, Q]
+
+    # Candidate columns c = 0..C-1 (existing then incoming), unique keys.
+    u_from = jnp.concatenate(
+        [jnp.where(ex_keep, exf, -1), jnp.where(inc_ok, isrc, -1)], axis=1)
+    u_lvl = jnp.concatenate([exl, ilvl], axis=1)
+    u_rank = jnp.concatenate([exr, irnk], axis=1)
+    u_bad = jnp.concatenate([exb, jnp.zeros((blk, s_cap), I32)], axis=1)
+    c_idx = jax.lax.broadcasted_iota(I32, (blk, c_tot), 1)
+    keys = jnp.where(u_from >= 0, u_rank * (c_tot + 1) + c_idx,
+                     BIG0 + c_idx)                         # [blk, C]
+
+    # Q selection rounds: per-row argmin over unique keys == the top_k
+    # ascending order.  Exactly one hit per row per round, so a masked
+    # sum IS the gather.
+    sel_f, sel_l, sel_r, sel_b, sel_sig = [], [], [], [], []
+    kept_existing = jnp.zeros((blk, 1), I32)
+    for _ in range(q_cap):
+        kmin = jnp.min(keys, axis=1, keepdims=True)        # [blk, 1]
+        hit = keys == kmin                                 # [blk, C]
+        hit_i = hit.astype(I32)
+        sel_f.append(jnp.sum(jnp.where(hit, u_from, 0), axis=1,
+                             keepdims=True))
+        sel_l.append(jnp.sum(jnp.where(hit, u_lvl, 0), axis=1,
+                             keepdims=True))
+        sel_r.append(jnp.sum(jnp.where(hit, u_rank, 0), axis=1,
+                             keepdims=True))
+        sel_b.append(jnp.sum(jnp.where(hit, u_bad, 0), axis=1,
+                             keepdims=True))
+        sig = jnp.zeros((blk, exs_ref.shape[2]), U32)      # [blk, W]
+        for c in range(c_tot):
+            sig_c = (exs_ref[:, c, :] if c < q_cap
+                     else isig_ref[:, c - q_cap, :])
+            sig = jnp.where(hit[:, c:c + 1], sig_c, sig)
+        sel_sig.append(sig)
+        kept_existing = kept_existing + jnp.sum(
+            jnp.where(hit & (c_idx < q_cap) & (u_from >= 0), 1, 0),
+            axis=1, keepdims=True)
+        keys = jnp.where(hit, EXCLUDED, keys)
+
+    of_ref[...] = jnp.concatenate(sel_f, axis=1)           # [blk, Q]
+    ol_ref[...] = jnp.concatenate(sel_l, axis=1)
+    or_ref[...] = jnp.concatenate(sel_r, axis=1)
+    ob_ref[...] = jnp.concatenate(sel_b, axis=1)
+    os_ref[...] = jnp.stack(sel_sig, axis=1)               # [blk, Q, W]
+    n_keep = jnp.sum(ex_keep.astype(I32), axis=1, keepdims=True)
+    oev_ref[...] = n_keep - kept_existing
+
+
+def _pick_block(m):
+    """Largest power-of-two block <= 256 dividing the row count."""
+    for blk in (256, 128, 64, 32, 16, 8, 4, 2):
+        if m % blk == 0:
+            return blk
+    return 1
+
+
+@functools.partial(jax.jit, static_argnames=("q_cap", "interpret"))
+def merge_queue_pallas(q_from, q_lvl, q_rank, q_bad, q_sig,
+                      src, level, rank_all, ok, sig_all,
+                      q_cap: int, interpret: bool = False):
+    """Fused bounded-queue merge.  Shapes: queue columns [M, Q], q_sig
+    [M, Q, W]; incoming columns [M, S], sig_all [M, S, W].  Returns
+    (q_from', q_lvl', q_rank', q_bad', q_sig', evicted_delta_scalar) —
+    bit-identical to `_levels.merge_bounded_queue` with
+    cols2d={"bad"}, cols3d={"sig"} (the Handel receive configuration).
+
+    `q_bad`/`ok` are bool at the caller; cast at this boundary (Mosaic
+    prefers i32 lanes).  The q_sig output aliases the input buffer —
+    under jit the [M, Q, W] plane is updated in place.
+    """
+    from jax.experimental import pallas as pl
+
+    m, q = q_from.shape
+    s = src.shape[1]
+    w = q_sig.shape[2]
+    assert q == q_cap and q_sig.shape == (m, q, w) and \
+        sig_all.shape == (m, s, w), (q_from.shape, q_sig.shape,
+                                     sig_all.shape)
+    if q + s > 256:
+        # The invalid-candidate keys are BIG0 + position; BIG0 leaves
+        # exactly 256 units of headroom below EXCLUDED, so a wider
+        # candidate row would wrap int32 and sort invalid slots FIRST.
+        raise ValueError(
+            f"merge_queue_pallas supports q_cap + s_cap <= 256 "
+            f"(got {q} + {s}); use the XLA merge for wider rows")
+    blk = _pick_block(m)
+    grid = (m // blk,)
+
+    def col(k):
+        return pl.BlockSpec((blk, k), lambda g: (g, 0))
+
+    def rows(k):
+        return pl.BlockSpec((blk, k, w), lambda g: (g, 0, 0))
+
+    kernel = functools.partial(_merge_kernel, q_cap=q, s_cap=s)
+    out_shape = (
+        jax.ShapeDtypeStruct((m, q), I32),      # from
+        jax.ShapeDtypeStruct((m, q), I32),      # lvl
+        jax.ShapeDtypeStruct((m, q), I32),      # rank
+        jax.ShapeDtypeStruct((m, q), I32),      # bad
+        jax.ShapeDtypeStruct((m, q, w), U32),   # sig
+        jax.ShapeDtypeStruct((m, 1), I32),      # evicted per row
+    )
+    o_f, o_l, o_r, o_b, o_s, o_ev = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[col(q), col(q), col(q), col(q), rows(q),
+                  col(s), col(s), col(s), col(s), rows(s)],
+        out_specs=[col(q), col(q), col(q), col(q), rows(q), col(1)],
+        out_shape=out_shape,
+        input_output_aliases={4: 4},            # q_sig updated in place
+        interpret=interpret,
+    )(q_from, q_lvl, q_rank, q_bad.astype(I32), q_sig,
+      src, level, rank_all, ok.astype(I32), sig_all)
+    return o_f, o_l, o_r, o_b != 0, o_s, jnp.sum(o_ev).astype(I32)
