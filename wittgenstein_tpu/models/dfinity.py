@@ -54,7 +54,8 @@ class DfinityState:
     head: jnp.ndarray          # int32 [N]
     last_beacon: jnp.ndarray   # int32 [N]
     # attesters
-    votes: jnp.ndarray         # u32 [N, A, Vw] — voter sets per block
+    votes: jnp.ndarray         # u32 [N, A, Cw] — committee-position voter
+    #                            sets per block (Cw = words(att_width))
     vote_for_h: jnp.ndarray    # int32 [N] (-1 = none)
     buffered: jnp.ndarray      # u32 [N, Aw] — future proposals
     maj_height: jnp.ndarray    # u32 [N, Hw] — committeeMajorityHeight
@@ -100,7 +101,6 @@ class Dfinity:
         self.node_count = 1 + self.n_att + self.n_bp + self.n_rb
         self.capacity = block_capacity
         self.aw = bc.n_words(block_capacity)
-        self.vw = bitset.n_words(self.node_count)
         self.hw = bc.n_words(block_capacity)      # heights bounded by blocks
         self.builder = builders.get_by_name(node_builder_name)
         from .ethpow import _TickScaled
@@ -120,6 +120,16 @@ class Dfinity:
         # 10-member rounds -> att_rounds 1, class size 15) — size the
         # fan-out for the largest class, masking overshoot ids at send.
         self.att_width = -(-self.n_att // self.att_rounds)
+        # Voter sets are COMMITTEE-POSITION bitsets, not validator-id
+        # bitsets: only height h's committee (the rotating residue class)
+        # votes on h's blocks, and a member's position within its class
+        # is (id - 1) // att_rounds < att_width.  [N, capacity, cw]
+        # with cw = words(att_width) replaces the r4 [N, capacity,
+        # words(N)] layout that made 10k validators uncompilable
+        # (words(10111) = 316 -> 6.5 GB; words(100) = 4 -> 83 MB).
+        # Same majority counts: vote assembly in the reference is also
+        # per-committee (Dfinity.java:265-351).
+        self.cw = bitset.n_words(self.att_width)
         k = max(self.att_width, self.n_rb)        # one fan-out batch per tick
         self.cfg = EngineConfig(
             n=self.node_count, horizon=horizon,
@@ -164,7 +174,7 @@ class Dfinity:
             recv_blk=bitset.one_bit(jnp.zeros((n,), jnp.int32), self.aw),
             head=jnp.zeros((n,), jnp.int32),
             last_beacon=jnp.zeros((n,), jnp.int32),
-            votes=jnp.zeros((n, a, self.vw), U32),
+            votes=jnp.zeros((n, a, self.cw), U32),
             vote_for_h=jnp.full((n,), -1, jnp.int32),
             buffered=jnp.zeros((n, self.aw), U32),
             maj_height=jnp.zeros((n, self.hw), U32),
@@ -311,11 +321,15 @@ class Dfinity:
         # -- PROPOSAL (:295-316) --
         is_prop = ok & att[:, None] & (kind == K_PROPOSAL)
         live_vote = is_prop & (p.vote_for_h[:, None] == bh_all)
-        ownvote = bitset.one_bit(ids, self.vw)                # [N, Vw]
-        vbase = (ids[:, None] * self.capacity + val) * self.vw
-        widx = vbase[..., None] + jnp.arange(self.vw)[None, None, :]
+        # Own committee position (valid whenever live_vote holds — the
+        # node was selected for this height's committee by _on_beacon).
+        own_pos = jnp.clip((ids - 1) // self.att_rounds, 0,
+                           self.att_width - 1)
+        ownvote = bitset.one_bit(own_pos, self.cw)            # [N, Cw]
+        vbase = (ids[:, None] * self.capacity + val) * self.cw
+        widx = vbase[..., None] + jnp.arange(self.cw)[None, None, :]
         widx = jnp.where(live_vote[..., None], widx,
-                         n * self.capacity * self.vw)
+                         n * self.capacity * self.cw)
         # own-vote bits are distinct per (node, block): accumulate via add
         vote_add = jnp.zeros_like(p.votes).reshape(-1).at[
             widx.reshape(-1)].add(
@@ -331,13 +345,21 @@ class Dfinity:
             U32(0), jax.lax.bitwise_or, (1,))
         p = p.replace(q_vote=q_vote, buffered=buffered)
 
-        # -- VOTE (:276-283): scatter sender bits (distinct per tick) --
-        is_vote = ok & att[:, None] & (kind == K_VOTE)
-        sbit_v = bitset.one_bit(src, self.vw)                 # [N, S, Vw]
-        vidx = ((ids[:, None] * self.capacity + val) * self.vw)[
-            ..., None] + jnp.arange(self.vw)[None, None, :]
+        # -- VOTE (:276-283): scatter sender committee-position bits
+        # (distinct per tick WITHIN a committee — the validity mask
+        # restricts to the voted block's own rotating residue class, so
+        # two senders can never share a position bit for one block) --
+        is_vote = (ok & att[:, None] & (kind == K_VOTE) &
+                   (src >= 1) & (src <= self.n_att) &
+                   ((src - 1) % self.att_rounds ==
+                    bh_all % self.att_rounds))
+        src_pos = jnp.clip((src - 1) // self.att_rounds, 0,
+                           self.att_width - 1)
+        sbit_v = bitset.one_bit(src_pos, self.cw)             # [N, S, Cw]
+        vidx = ((ids[:, None] * self.capacity + val) * self.cw)[
+            ..., None] + jnp.arange(self.cw)[None, None, :]
         vidx = jnp.where(is_vote[..., None], vidx,
-                         n * self.capacity * self.vw)
+                         n * self.capacity * self.cw)
         vote_add = vote_add.reshape(-1).at[vidx.reshape(-1)].add(
             sbit_v.reshape(-1), mode="drop").reshape(p.votes.shape)
 

@@ -104,7 +104,6 @@ def _merge_kernel(exf_ref, exl_ref, exr_ref, exb_ref, exs_ref,
     for _ in range(q_cap):
         kmin = jnp.min(keys, axis=1, keepdims=True)        # [blk, 1]
         hit = keys == kmin                                 # [blk, C]
-        hit_i = hit.astype(I32)
         sel_f.append(jnp.sum(jnp.where(hit, u_from, 0), axis=1,
                              keepdims=True))
         sel_l.append(jnp.sum(jnp.where(hit, u_lvl, 0), axis=1,
@@ -163,12 +162,14 @@ def merge_queue_pallas(q_from, q_lvl, q_rank, q_bad, q_sig,
     assert q == q_cap and q_sig.shape == (m, q, w) and \
         sig_all.shape == (m, s, w), (q_from.shape, q_sig.shape,
                                      sig_all.shape)
-    if q + s > 256:
-        # The invalid-candidate keys are BIG0 + position; BIG0 leaves
-        # exactly 256 units of headroom below EXCLUDED, so a wider
-        # candidate row would wrap int32 and sort invalid slots FIRST.
+    if q + s > 255:
+        # The invalid-candidate keys are BIG0 + position with only 255
+        # units of headroom below the EXCLUDED sentinel: position 255
+        # would collide with EXCLUDED (breaking the unique-key
+        # invariant), and wider rows would wrap int32 and sort invalid
+        # slots FIRST.
         raise ValueError(
-            f"merge_queue_pallas supports q_cap + s_cap <= 256 "
+            f"merge_queue_pallas supports q_cap + s_cap <= 255 "
             f"(got {q} + {s}); use the XLA merge for wider rows")
     blk = _pick_block(m)
     grid = (m // blk,)
