@@ -72,6 +72,13 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
             kw["snapshot_pool"] = os.environ["WTPU_BENCH_POOL"] == "1"
         if os.environ.get("WTPU_BENCH_QUEUE"):
             kw["queue_cap"] = _int_env("WTPU_BENCH_QUEUE", 16)
+        if os.environ.get("WTPU_BENCH_STATE_SPLIT"):
+            # q_sig node-range pieces (HandelState.q_sig): the 32k-exact
+            # tier needs >= 2 to keep every queue buffer and delivery
+            # transient under the runtime's ~1 GB single-buffer limit.
+            kw["state_split"] = _int_env("WTPU_BENCH_STATE_SPLIT", 1)
+        if os.environ.get("WTPU_BENCH_PALLAS"):
+            kw["pallas_merge"] = os.environ["WTPU_BENCH_PALLAS"] == "1"
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,

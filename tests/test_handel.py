@@ -265,3 +265,28 @@ def test_pallas_merge_path_bit_equal():
         vouts.append((nets, ps))
     for a, b in zip(jax.tree.leaves(vouts[0]), jax.tree.leaves(vouts[1])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_state_split_bit_equal():
+    """q_sig node-range pieces (state_split, HandelState.q_sig): any P
+    gives bit-identical simulations — same treatment as the engine's
+    box_split, tested the same way."""
+    import jax
+    n, down = 128, 12
+    kw = dict(node_count=n, threshold=int(0.99 * (n - down)),
+              nodes_down=down, pairing_time=4, level_wait_time=50,
+              dissemination_period_ms=20, fast_path=10)
+    outs = []
+    for split in (1, 4):
+        proto = Handel(state_split=split, **kw)
+        net, p = proto.init(5)
+        net, p = Runner(proto, donate=False).run_ms(net, p, 600)
+        outs.append((net, p))
+    (na, pa), (nb, pb) = outs
+    qa = np.concatenate([np.asarray(x) for x in pa.q_sig], axis=0)
+    qb = np.concatenate([np.asarray(x) for x in pb.q_sig], axis=0)
+    np.testing.assert_array_equal(qa, qb)
+    la = [x for x in jax.tree.leaves((na, pa.replace(q_sig=())))]
+    lb = [x for x in jax.tree.leaves((nb, pb.replace(q_sig=())))]
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -94,7 +94,17 @@ class HandelState:
     q_lvl: jnp.ndarray         # int32 [N, Q]
     q_rank: jnp.ndarray        # int32 [N, Q]
     q_bad: jnp.ndarray         # bool [N, Q]
-    q_sig: jnp.ndarray         # u32 [N, Q, W] — only the entry's level bits
+    # The queued sig rows, stored as `state_split` node-range PIECES of
+    # [N/P, Q, W] each (P == 1 -> a 1-tuple; layouts identical,
+    # bit-equal for any P).  Same motivation as EngineConfig.box_split:
+    # the TPU runtime faults on executions touching any single buffer
+    # past ~1 GB, and [32768, Q, 1024] u32 pads to 1.07 GB under (8,
+    # 128) tiling for ANY Q <= 8 (BENCH_NOTES.md r4) — splitting by
+    # node range is what lets exact mode reach 32k on one chip.  The
+    # receive merge and verification scoring also compute their
+    # [*, Q|S, W] transients per piece, bounding peak memory the same
+    # way.
+    q_sig: tuple               # P x u32 [N/P, Q, W] — entry's level bits
     pool: jnp.ndarray          # u32 [N, R, W] — outgoing snapshots per round
     emission: jnp.ndarray      # int32 [N, N] — per-level sorted receiver ids
     pos: jnp.ndarray           # int32 [N, L] — posInLevel round-robin pointer
@@ -145,7 +155,7 @@ class Handel(LevelMixin, StaticScheduleMixin):
                  emission_lookahead=8, byzantine_suicide=False,
                  hidden_byzantine=False, emission_mode=None,
                  snapshot_pool=None, prefix_pc=None, pallas_merge=None,
-                 mode="exact"):
+                 state_split=1, mode="exact"):
         # `mode` is consumed by __new__ ("cardinal" dispatches to
         # HandelCardinal before this body runs); it reaches here only as
         # "exact".
@@ -221,6 +231,18 @@ class Handel(LevelMixin, StaticScheduleMixin):
                              "(the attacker controls the down nodes)")
         self.byzantine_suicide = byzantine_suicide
         self.hidden_byzantine = hidden_byzantine
+        # state_split: q_sig node-range pieces (see HandelState.q_sig).
+        if node_count % state_split:
+            raise ValueError(f"state_split {state_split} must divide "
+                             f"node_count {node_count}")
+        if state_split > 1 and (byzantine_suicide or hidden_byzantine):
+            # The attack paths are O(N^2) sweeps only run at small N,
+            # where splitting is never needed; keeping them unsplit
+            # avoids blocking the queue-insert scatter.
+            raise ValueError("state_split > 1 is for tier-2 scale runs; "
+                             "byzantine attack modes require "
+                             "state_split == 1")
+        self.state_split = state_split
         self.builder = builders.get_by_name(node_builder_name)
         self.latency = latency_mod.get_by_name(network_latency_name)
 
@@ -232,15 +254,17 @@ class Handel(LevelMixin, StaticScheduleMixin):
                 "queue-merge sort key would overflow int32: "
                 f"2*{node_count}*({queue_cap}+{inbox_cap}+1) >= 2**31; "
                 "reduce queue_cap/inbox_cap or node_count")
-        # q_sig's flat gathers index N*Q*W int32 cells (ops/flat.py);
-        # found the hard way at 65536 nodes x queue_cap 16 (exactly 2^31).
+        # q_sig's flat gathers index Ns*Q*W int32 cells PER PIECE
+        # (ops/flat.py); found the hard way at 65536 nodes x queue_cap
+        # 16 (exactly 2^31).  state_split raises the ceiling
+        # proportionally.
         _w = (node_count + 31) // 32
-        if node_count * queue_cap * _w >= 2 ** 31:
+        _ns = node_count // state_split
+        if _ns * queue_cap * _w >= 2 ** 31:
             raise ValueError(
                 f"verification-queue flat index would overflow int32: "
-                f"{node_count}*{queue_cap}*{_w} >= 2**31; the >=65536-node "
-                "tier needs queue_cap <= "
-                f"{(2 ** 31 - 1) // (node_count * _w)} (SCALE.md tier 2)")
+                f"{_ns}*{queue_cap}*{_w} >= 2**31 per q_sig piece; "
+                "reduce queue_cap or raise state_split (SCALE.md tier 2)")
         self.bits = max(1, int(math.log2(node_count)))
         self.levels = self.bits + 1            # levels 0..bits
         self.w = bitset.n_words(node_count)
@@ -364,7 +388,8 @@ class Handel(LevelMixin, StaticScheduleMixin):
             q_lvl=jnp.zeros((n, Q), jnp.int32),
             q_rank=jnp.zeros((n, Q), jnp.int32),
             q_bad=jnp.zeros((n, Q), bool),
-            q_sig=jnp.zeros((n, Q, w), U32),
+            q_sig=tuple(jnp.zeros((n // self.state_split, Q, w), U32)
+                        for _ in range(self.state_split)),
             pool=(jnp.zeros((n, self.rounds, w), U32) if self.snapshot_pool
                   else jnp.zeros((1, 1, 1), U32)),
             emission=emission, pos=jnp.zeros((n, L), jnp.int32),
@@ -405,6 +430,8 @@ class Handel(LevelMixin, StaticScheduleMixin):
 
     def _receive(self, p: HandelState, nodes, inbox, t):
         n, w, L, Q = self.node_count, self.w, self.levels, self.queue_cap
+        P = self.state_split
+        ns = n // P
         ids = jnp.arange(n, dtype=jnp.int32)
         done = nodes.done_at > 0
 
@@ -419,48 +446,70 @@ class Handel(LevelMixin, StaticScheduleMixin):
         blk = _get_bit_rows(p.blacklist, src)
         ok = valid & ~done[:, None] & (t >= p.start_at)[:, None] & ~blk
         filtered = jnp.sum(valid & done[:, None], axis=1).astype(jnp.int32)
-
-        # levelFinished -> finishedPeers (Handel.java:770-772).
         fin = ok & ((flags & 1) != 0)
-        fin_bits = jnp.where(fin[..., None], bitset.one_bit(src, w), U32(0))
-        finished = p.finished_peers | jax.lax.reduce(
-            fin_bits, U32(0), jax.lax.bitwise_or, (1,))
-
-        # Reconstruct sigs from the senders' snapshot pool (one flat
-        # gather); pool-free mode reads the sender's CURRENT aggregate
-        # instead (see __init__).
-        if self.snapshot_pool:
-            sig_all = gather_rows(p.pool, src, rslot) & \
-                self._sender_block_mask(src, level)
-        else:
-            sig_all = (p.last_agg | p.ver_ind)[src] & \
-                self._sender_block_mask(src, level)
         rank_all = self._rank(p.seed, ids[:, None], src) + \
             jnp.where(_get_bit_rows(p.demoted, src), n, 0)
 
-        # Queue merge, vectorized across ALL slots at once.  The reference
-        # queues every incoming aggregate in an unbounded per-level list
-        # (onNewSig :753-786); this implementation bounds memory with the
-        # shared bounded-queue policy (_levels.merge_bounded_queue): one
-        # entry per (sender, level) — newest wins — keep the Q best
+        # Queue merge, vectorized across ALL slots at once, per q_sig
+        # node-range piece (bounds the [ns, S|Q, W] transients — see
+        # HandelState.q_sig).  The reference queues every incoming
+        # aggregate in an unbounded per-level list (onNewSig :753-786);
+        # this implementation bounds memory with the shared
+        # bounded-queue policy (_levels.merge_bounded_queue): one entry
+        # per (sender, level) — newest wins — keep the Q best
         # (lowest-reception-rank) candidates.
-        if self.pallas_merge:
-            from ..ops.pallas_merge import merge_queue_pallas
-            q_f, q_l, q_r, q_b, q_s, ev = merge_queue_pallas(
-                p.q_from, p.q_lvl, p.q_rank, p.q_bad, p.q_sig,
-                src, level, rank_all, ok, sig_all, q_cap=Q,
-                interpret=jax.default_backend() != "tpu")
-        else:
-            sel2, sel3, ev = merge_bounded_queue(
-                p.q_from, p.q_lvl, p.q_rank, src, level, rank_all, ok, Q,
-                {"bad": (p.q_bad, jnp.zeros_like(ok))},
-                {"sig": (p.q_sig, sig_all)})
-            q_f, q_l, q_r, q_b, q_s = (sel2["from"], sel2["lvl"],
-                                       sel2["rank"], sel2["bad"],
-                                       sel3["sig"])
+        parts = {k: [] for k in ("from", "lvl", "rank", "bad")}
+        pieces, fin_parts = [], []
+        ev = jnp.asarray(0, jnp.int32)
+        for j in range(P):
+            sl = slice(j * ns, (j + 1) * ns)
+            src_j, level_j, ok_j = src[sl], level[sl], ok[sl]
+            # levelFinished -> finishedPeers (Handel.java:770-772).
+            fin_bits = jnp.where(fin[sl][..., None],
+                                 bitset.one_bit(src_j, w), U32(0))
+            fin_parts.append(jax.lax.reduce(
+                fin_bits, U32(0), jax.lax.bitwise_or, (1,)))
+            # Reconstruct sigs from the senders' snapshot pool (one flat
+            # gather); pool-free mode reads the sender's CURRENT
+            # aggregate instead (see __init__).
+            if self.snapshot_pool:
+                sig_all = gather_rows(p.pool, src_j, rslot[sl]) & \
+                    self._sender_block_mask(src_j, level_j)
+            else:
+                sig_all = (p.last_agg | p.ver_ind)[src_j] & \
+                    self._sender_block_mask(src_j, level_j)
+            if self.pallas_merge:
+                from ..ops.pallas_merge import merge_queue_pallas
+                q_f, q_l, q_r, q_b, q_s, ev_j = merge_queue_pallas(
+                    p.q_from[sl], p.q_lvl[sl], p.q_rank[sl],
+                    p.q_bad[sl], p.q_sig[j], src_j, level_j,
+                    rank_all[sl], ok_j, sig_all, q_cap=Q,
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                sel2, sel3, ev_j = merge_bounded_queue(
+                    p.q_from[sl], p.q_lvl[sl], p.q_rank[sl], src_j,
+                    level_j, rank_all[sl], ok_j, Q,
+                    {"bad": (p.q_bad[sl], jnp.zeros_like(ok_j))},
+                    {"sig": (p.q_sig[j], sig_all)})
+                q_f, q_l, q_r, q_b, q_s = (sel2["from"], sel2["lvl"],
+                                           sel2["rank"], sel2["bad"],
+                                           sel3["sig"])
+            parts["from"].append(q_f)
+            parts["lvl"].append(q_l)
+            parts["rank"].append(q_r)
+            parts["bad"].append(q_b)
+            pieces.append(q_s)
+            ev = ev + ev_j
 
-        return p.replace(q_from=q_f, q_lvl=q_l, q_rank=q_r, q_bad=q_b,
-                         q_sig=q_s, finished_peers=finished,
+        def cat(xs):
+            return xs[0] if P == 1 else jnp.concatenate(xs, axis=0)
+
+        finished = p.finished_peers | cat(fin_parts)
+        return p.replace(q_from=cat(parts["from"]),
+                         q_lvl=cat(parts["lvl"]),
+                         q_rank=cat(parts["rank"]),
+                         q_bad=cat(parts["bad"]),
+                         q_sig=tuple(pieces), finished_peers=finished,
                          msg_filtered=p.msg_filtered + filtered,
                          evicted=p.evicted + ev)
 
@@ -551,18 +600,37 @@ class Handel(LevelMixin, StaticScheduleMixin):
         rows = ids[:, None]
         filled = p.q_from >= 0                                 # [N, Q]
         elvl = p.q_lvl
-        emask = self._range_mask_dyn(rows, elvl)               # [N, Q, W]
-        sig = p.q_sig                                          # [N, Q, W]
-        inc_e = total_inc[:, None, :] & emask
-        ver_e = p.ver_ind[:, None, :] & emask
-        agg_e = p.last_agg[:, None, :] & emask
         cur_size = gather2d(inc_pc, rows, elvl)                # [N, Q]
         blk = _get_bit_rows(p.blacklist, jnp.maximum(p.q_from, 0))
 
-        # sizeIfIncluded (:545-552).
-        disj = ~bitset.intersects(sig, inc_e)
-        merged = jnp.where(disj[..., None], sig | inc_e, sig)
-        s_inc = bitset.popcount(merged | ver_e)
+        # The W-wide queue work — sizeIfIncluded (:545-552) and the
+        # score popcounts (:651-664) — runs per q_sig node-range piece
+        # (bounds the [ns, Q, W] transients; see HandelState.q_sig),
+        # emitting only [ns, Q] summaries.
+        P = self.state_split
+        ns = n // P
+        s_inc_p, pc_sig_p, pc_sv_p, inter_agg_p = [], [], [], []
+        for j in range(P):
+            sl = slice(j * ns, (j + 1) * ns)
+            sig = p.q_sig[j]                                  # [ns, Q, W]
+            emask = self._range_mask_dyn(ids[sl][:, None], elvl[sl])
+            inc_e = total_inc[sl][:, None, :] & emask
+            ver_e = p.ver_ind[sl][:, None, :] & emask
+            agg_e = p.last_agg[sl][:, None, :] & emask
+            disj = ~bitset.intersects(sig, inc_e)
+            merged = jnp.where(disj[..., None], sig | inc_e, sig)
+            s_inc_p.append(bitset.popcount(merged | ver_e))
+            pc_sig_p.append(bitset.popcount(sig))
+            pc_sv_p.append(bitset.popcount(sig | ver_e))
+            inter_agg_p.append(bitset.intersects(sig, agg_e))
+
+        def cat(xs):
+            return xs[0] if P == 1 else jnp.concatenate(xs, axis=0)
+
+        s_inc = cat(s_inc_p)
+        pc_sig = cat(pc_sig_p)
+        pc_sig_ver = cat(pc_sv_p)
+        inter_agg = cat(inter_agg_p)
         improving = filled & ~blk & (s_inc > cur_size)
         keep = improving | ~filled          # curation (:597-614)
 
@@ -576,13 +644,13 @@ class Handel(LevelMixin, StaticScheduleMixin):
         inside = improving & (p.q_rank <= win_lo_e +
                               p.curr_window[:, None])
 
-        # score (:651-664).
+        # score (:651-664) — from the per-piece popcount summaries.
         halfs_arr = jnp.asarray(self.half)
         agg_card_e = gather2d(agg_pc, rows, elvl)
         half_e = halfs_arr[elvl]
-        sc_disj = agg_card_e + bitset.popcount(sig)
-        sc_join = jnp.maximum(0, bitset.popcount(sig | ver_e) - agg_card_e)
-        score = jnp.where(bitset.intersects(sig, agg_e), sc_join, sc_disj)
+        sc_disj = agg_card_e + pc_sig
+        sc_join = jnp.maximum(0, pc_sig_ver - agg_card_e)
+        score = jnp.where(inter_agg, sc_join, sc_disj)
         score = jnp.where(agg_card_e >= half_e, 0, score)
         score_in = jnp.where(inside, score, -1)
 
@@ -621,7 +689,10 @@ class Handel(LevelMixin, StaticScheduleMixin):
         slot = gather2d(best_slot, ids, pick_level)
         vfrom = gather2d(p.q_from, ids, slot)
         vbad = gather2d(p.q_bad, ids, slot)
-        vsig = gather_rows(p.q_sig, ids, slot)
+        vsig = cat([gather_rows(p.q_sig[j],
+                                jnp.arange(ns, dtype=jnp.int32),
+                                slot[j * ns:(j + 1) * ns])
+                    for j in range(P)])
         # keep_entry: the picked QUEUE slot survives (an adversarial sig was
         # verified instead; the honest entry stays queued, :577-583,:905-913).
         keep_entry = jnp.zeros_like(do)
@@ -699,7 +770,8 @@ class Handel(LevelMixin, StaticScheduleMixin):
             q_lvl = set2d(q_lvl, ids, islot, pick_level, ok=ins)
             q_rank = set2d(q_rank, ids, islot, h_rank, ok=ins)
             q_bad = set2d(q_bad, ids, islot, False, ok=ins)
-            q_sig = set_rows(q_sig, ids, islot, h_sig, ok=ins)
+            # state_split == 1 enforced for attack modes (__init__).
+            q_sig = (set_rows(q_sig[0], ids, islot, h_sig, ok=ins),)
 
         return p.replace(
             q_from=q_from, q_lvl=q_lvl, q_rank=q_rank, q_bad=q_bad,
