@@ -30,11 +30,24 @@ def test_geometry():
 
 
 def test_pick_order():
-    # partner offset first, then remaining offsets in index order
-    # (SanFerminHelper.pickNextNodes).
+    # Mirror (partner offset) first, then the remaining offsets in the
+    # per-node ROTATION (partner + j) mod half — pick j is a bijection
+    # between requesters and candidates, which is what keeps same-tick
+    # fan-in at candidate_count + 1 instead of half-block (see
+    # _pick_offset; the reference's index-order walk relies on
+    # unbounded queues to absorb the difference).
+    half = jnp.asarray([4])
     po = jnp.asarray([2])
-    picks = [int(_pick_offset(jnp.asarray([j]), po)[0]) for j in range(4)]
-    assert picks == [2, 0, 1, 3]
+    picks = [int(_pick_offset(jnp.asarray([j]), po, half)[0])
+             for j in range(4)]
+    assert picks == [2, 3, 0, 1]
+    assert sorted(picks) == [0, 1, 2, 3]        # full walk, no repeats
+    # Bijection across requesters at every pick index j: distinct
+    # partners map to distinct candidates.
+    for j in range(4):
+        offs = [int(_pick_offset(jnp.asarray([j]), jnp.asarray([p]),
+                                 half)[0]) for p in range(4)]
+        assert sorted(offs) == [0, 1, 2, 3]
 
 
 @pytest.mark.slow

@@ -91,16 +91,20 @@ def bench_gsf():
 
 
 def bench_sanfermin():
-    """32k nodes.  The optimistic-reply bursts concentrate hard at this
-    scale: inbox_cap 8 dropped 61,684 messages, 16 still dropped
-    20,005 (r4 attempts) — so 32, with box_split=4 keeping each mailbox
-    sub-plane at 512 MB, under the TPU runtime's ~1 GB single-buffer
-    execution limit (BENCH_NOTES.md r3)."""
+    """32k nodes.  The r4 attempts drowned in request fan-in (inbox 8
+    dropped 61,684, 16 still 20,005, and 32's 8.6 GB ring hit
+    RESOURCE_EXHAUSTED): the index-order candidate walk aims every
+    block's stragglers at the sibling block's first ids.  The rotated
+    pick order (models/sanfermin._pick_offset) makes every pick index a
+    requester<->candidate bijection — measured ZERO drops at 4096 nodes
+    with inbox 12 (r5) — so 16 now carries margin, and box_split=2
+    keeps each mailbox sub-plane at 537 MB, under the TPU runtime's
+    ~1 GB single-buffer execution limit (BENCH_NOTES.md r3)."""
     import dataclasses
 
     from wittgenstein_tpu.models.sanfermin import SanFermin
-    proto = SanFermin(node_count=32768, inbox_cap=32)
-    proto.cfg = dataclasses.replace(proto.cfg, box_split=4)
+    proto = SanFermin(node_count=32768, inbox_cap=16)
+    proto.cfg = dataclasses.replace(proto.cfg, box_split=2)
     seeds = None                                # single seed, unbatched
 
     def check(nets, ps):

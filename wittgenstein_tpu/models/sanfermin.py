@@ -66,18 +66,31 @@ def _cand_base(ids, half):
     return _own_base(ids, half) ^ half
 
 
-def _pick_offset(j, partner_off):
-    """The j-th pick in a level's candidate order: mirror node first, then
-    the remaining offsets in index order (pickNextNodes,
-    SanFerminHelper.java:123-158)."""
-    rest = jnp.where(j - 1 < partner_off, j - 1, j)
-    return jnp.where(j == 0, partner_off, rest)
+def _pick_offset(j, partner_off, half):
+    """The j-th pick in a level's candidate order: mirror node first,
+    then the remaining offsets in a PER-NODE ROTATION
+    ``(partner_off + j) mod half``.
+
+    The reference walks the candidates in plain index order after the
+    mirror (pickNextNodes, SanFerminHelper.java:123-158) — which means
+    every straggler in a block hammers the sibling block's FIRST few
+    ids: at 32k nodes the top level put ~16k same-wave requests on one
+    node, which the reference absorbs with unbounded queues
+    (bench_suite_r4: 61,684 inbox drops here).  Rotating each walk by
+    the node's own in-block offset keeps pick j a BIJECTION between
+    requesters and candidates — worst-case same-tick fan-in drops from
+    half-block to candidate_count + 1 — while every node still walks
+    its full candidate set exactly once per level in a deterministic
+    order (same sets, same counts; WHICH stranger you try next is
+    protocol-irrelevant — a documented statistical-equivalence
+    coarsening, SURVEY §7.4.3)."""
+    return (partner_off + j) % jnp.maximum(half, 1)
 
 
-def _expected(off, partner_off, used):
+def _expected(off, partner_off, used, half):
     """Was candidate-offset `off` among our first `used` picks?"""
-    rank = 1 + off - (off > partner_off)
-    return (off == partner_off) | (rank < used)
+    rank = (off - partner_off) % jnp.maximum(half, 1)
+    return rank < used
 
 
 class _SanFerminBase:
@@ -130,7 +143,7 @@ class _SanFerminBase:
         first = used == 0
         width = count + 1
         j = used[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
-        off = _pick_offset(j, partner[:, None])
+        off = _pick_offset(j, partner[:, None], half[:, None])
         ok = (j < half[:, None]) & \
             (first[:, None] | (jnp.arange(width)[None, :] < count))
         dest = jnp.where(ok, base[:, None] + off, -1)
@@ -298,7 +311,8 @@ class SanFermin(_SanFerminBase):
             is_rep = ok_s & ((kind == OK) | (kind == NO)) & ~p.done & \
                 (lvl == p.cpl) & ~swapping
             off = src - _cand_base(ids, half)
-            expected = _expected(off, self._partner_off(ids, p.cpl), p.used)
+            expected = _expected(off, self._partner_off(ids, p.cpl),
+                                 p.used, _half(self.bits, p.cpl))
             acc2 = is_rep & (kind == OK) & is_cand
             swapping = swapping | acc2
             pend_val = jnp.where(acc2, val, pend_val)
