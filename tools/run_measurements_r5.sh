@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 on-chip measurement session — run when .tpu_up appears.
+# ORDER IS THE POINT (VERDICT r4 #2): the official bench number is
+# captured FIRST, then A/Bs and tracked configs, and the risky frontier
+# probes (2^19+, 8192 emission rows) are NOT here — they run only after
+# everything else landed, from a separate shell, late in the round.
+#
+# Usage: nohup bash tools/run_measurements_r5.sh > reports/r5_onchip.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+R=reports
+mkdir -p "$R"
+stamp() { date -u +%H:%M:%S; }
+
+echo "=== r5 on-chip session start $(stamp)"
+
+# 1. OFFICIAL bench, batched default, reps=3 — the BENCH_r05 config.
+echo "--- [1/7] official 2048x16 $(stamp)"
+timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_official.log"
+
+# 2. Pallas delivery-merge A/B at the official config (same process
+#    protocol as the bench; WTPU_PALLAS=1 enables the kernel on TPU).
+echo "--- [2/7] pallas merge A/B $(stamp)"
+WTPU_PALLAS=1 timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_pallas.log"
+
+# 3. Seed scaling on the batched engine (the folded scatter removed the
+#    suspected 32-seed crash mechanism): 32 then 64 seeds, box_split
+#    keeping every folded plane under the ~1 GB buffer limit.
+echo "--- [3/7] seeds=32 $(stamp)"
+WTPU_BENCH_SEEDS=32 WTPU_BENCH_SEED_BATCH=32 WTPU_BENCH_BOX_SPLIT=2 \
+  timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_seeds32.log"
+echo "--- [3b/7] seeds=64 $(stamp)"
+WTPU_BENCH_SEEDS=64 WTPU_BENCH_SEED_BATCH=64 WTPU_BENCH_BOX_SPLIT=4 \
+  timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_seeds64.log"
+
+# 4. Exact-mode 32k (tracked): q_sig state_split keeps every queue
+#    buffer under the limit; pool-free hashed tier-2 config.
+echo "--- [4/7] exact 32k $(stamp)"
+WTPU_BENCH_NODES=32768 WTPU_BENCH_SEEDS=1 WTPU_BENCH_MS=2000 \
+  WTPU_BENCH_MODE=exact WTPU_BENCH_EMISSION=hashed WTPU_BENCH_POOL=0 \
+  WTPU_BENCH_QUEUE=8 WTPU_BENCH_STATE_SPLIT=4 WTPU_BENCH_BOX_SPLIT=2 \
+  WTPU_BENCH_DONATE=big WTPU_BENCH_REPS=1 \
+  timeout 5400 python bench.py 2>&1 | tee "$R/bench_r5_exact32k.log"
+
+# 5. Tracked suite configs (Dfinity 10k NEW committee-width state,
+#    SanFermin 32k NEW rotated pick order, GSF, PingPong).
+echo "--- [5/7] bench_suite $(stamp)"
+timeout 14400 python tools/bench_suite.py dfinity_10k_validators \
+  sanfermin_32768n gsf_4096n pingpong_1000n 2>&1 \
+  | tee "$R/bench_suite_r5_run.log"
+
+# 6. Fresh op-level profile of the BATCHED engine (the r4 profile was
+#    the vmapped build) — feeds the next perf decisions.
+echo "--- [6/7] profile $(stamp)"
+timeout 3600 python tools/tpu_profile.py "$R/PROFILE_r5.md" 2>&1 \
+  | tee "$R/profile_r5.log"
+
+# 7. Scenario sweeps remaining points (reference-scale 2048x8).
+echo "--- [7/7] scenario sweeps $(stamp)"
+timeout 14400 python tools/scenario_sweeps_2048.py 2>&1 \
+  | tee "$R/scenario_sweeps_r5.log"
+
+echo "=== r5 on-chip session done $(stamp)"

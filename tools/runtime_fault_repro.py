@@ -1,0 +1,179 @@
+"""Minimized repro + factor isolation of the >= 2^19 cardinal worker
+crash (VERDICT r4 #8).
+
+Round-4 facts (BENCH_NOTES.md): cardinal Handel runs CLEAN single-chip
+at N = 2^18 = 262,144 (200 sim-ms, zero drops), but the TPU worker
+process crashes outright ("kernel fault") executing the first chunk at
+N = 2^19 — at BOTH 805 MB and forced-402 MB ring sub-planes, so it is
+not the known ~1 GB single-buffer limit.  2^20 compiles (7.25 GB
+resident) and crashes the same way.
+
+An N-bisection is impossible: the level-tree protocols only support
+power-of-two node counts and there is no power of two strictly between
+2^18 and 2^19.  Instead this tool ISOLATES THE FACTOR with a matched
+grid (each probe in a fresh subprocess — the fault poisons a process):
+
+  A  N=2^18, horizon 96   — r4 known-good baseline
+  B  N=2^19, horizon 96   — r4 known-bad baseline
+  C  N=2^18, horizon 192  — same TOTAL ring bytes as B at half the N
+  D  N=2^19, horizon 48   — same TOTAL ring bytes as A at twice the N
+
+C fail + D ok   -> total-allocation fault (bytes, not node count).
+C ok  + D fail  -> N-specific fault (scatter index space, buffer
+                   count, or program shape — actionable for runtime
+                   owners as "not memory pressure").
+Results land in reports/RUNTIME_FAULT_REPRO.md; the `repro` mode is
+the one-file standalone handover.
+
+RUN THIS LAST in a round: the crash probes have historically wedged the
+tunnel for hours (r4 end-of-round note) — never before the official
+bench capture.
+
+Usage:
+  python tools/runtime_fault_repro.py repro <N> [sim_ms] [horizon]
+  python tools/runtime_fault_repro.py grid
+Env: WTPU_REPRO_SPLIT (box_split override; default sized to keep every
+     ring sub-plane under 512 MB).
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+REPORT = REPO / "reports" / "RUNTIME_FAULT_REPRO.md"
+SIM_MS = 20
+HORIZON = 96                     # the r4 1M diet config (cardinal_1m)
+INBOX = 12
+
+
+def default_split(n, horizon):
+    """Smallest power-of-two box_split keeping each ring sub-plane
+    (horizon * n/P * INBOX int32) under 512 MB — half the known ~1 GB
+    limit, so every probe exercises ONLY the unexplained fault."""
+    p = 1
+    while horizon * (n // p) * INBOX * 4 > 512 * 2 ** 20:
+        p *= 2
+    return p
+
+
+def repro(n, sim_ms=SIM_MS, horizon=HORIZON):
+    """The minimal faulting program (run in a fresh process)."""
+    import jax
+
+    from wittgenstein_tpu.core.network import scan_chunk
+    from wittgenstein_tpu.models.handel import Handel
+
+    split = int(os.environ.get("WTPU_REPRO_SPLIT",
+                               default_split(n, horizon)))
+    print(f"repro: N={n} split={split} horizon={horizon} inbox={INBOX} "
+          f"platform={jax.default_backend()}", flush=True)
+    proto = Handel(node_count=n, threshold=int(0.9 * n), mode="cardinal",
+                   queue_cap=8, inbox_cap=INBOX, horizon=horizon)
+    import dataclasses
+    proto.cfg = dataclasses.replace(proto.cfg, box_split=split)
+    t0 = time.perf_counter()
+    net, ps = proto.init(0)
+    print(f"repro: init done {time.perf_counter() - t0:.1f}s", flush=True)
+    step = jax.jit(scan_chunk(proto, sim_ms))
+    net, ps = step(net, ps)
+    t = int(jax.device_get(net.time))          # materialize = execute
+    print(f"repro: OK — t={t}, wall {time.perf_counter() - t0:.1f}s",
+          flush=True)
+    assert t == sim_ms
+
+
+GRID = [
+    ("A (r4 known-good)", 1 << 18, HORIZON),
+    ("B (r4 known-bad)", 1 << 19, HORIZON),
+    ("C (2^18, B's total ring bytes)", 1 << 18, 2 * HORIZON),
+    ("D (2^19, A's total ring bytes)", 1 << 19, HORIZON // 2),
+]
+
+
+def grid():
+    rows = []
+    results = {}
+    for label, n, horizon in GRID:
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "repro", str(n),
+                 str(SIM_MS), str(horizon)],
+                capture_output=True, text=True, timeout=7200)
+            ok = r.returncode == 0
+            res = "OK" if ok else f"FAIL rc={r.returncode}"
+            tail = (r.stdout + r.stderr).strip().splitlines()
+            tail = tail[-1][:120] if tail else ""
+        except subprocess.TimeoutExpired:
+            # A wedged probe must not lose the completed rows: record
+            # it and KEEP GOING (later probes will fail fast against
+            # the wedged tunnel and the table will say so honestly).
+            ok, res, tail = False, "TIMEOUT 7200s (tunnel wedge?)", ""
+        wall = time.perf_counter() - t0
+        results[label[0]] = ok
+        rows.append((label, n, horizon, res, f"{wall:.0f}", tail))
+        print(f"grid: {label}: {res} ({wall:.0f}s)", flush=True)
+        write_report(rows, results)      # persist after EVERY probe
+
+
+def write_report(rows, results):
+    lines = [
+        "# Runtime-fault repro: cardinal worker crash at >= 2^19 nodes",
+        "",
+        "Standalone repro: `python tools/runtime_fault_repro.py repro "
+        "<N> [sim_ms] [horizon]` — init + one 20-ms cardinal chunk + "
+        "materialize, fresh process, ring sub-planes capped at 512 MB "
+        "(half the known ~1 GB single-buffer limit, so only the "
+        "unexplained fault is in play).  r4 facts: 2^18 clean, "
+        "2^19/2^20 worker crash ('kernel fault') at any sub-plane "
+        "sizing (BENCH_NOTES.md).  No power of two exists strictly "
+        "between them, so instead of a bisection the grid below "
+        "matches TOTAL ring bytes across the N boundary.",
+        "",
+        "| probe | N | horizon | result | wall s | last line |",
+        "|---|---|---|---|---|---|",
+    ]
+    for label, n, horizon, res, wall, tail in rows:
+        lines.append(f"| {label} | {n:,} | {horizon} | {res} | {wall} "
+                     f"| `{tail}` |")
+    lines.append("")
+    if {"A", "B", "C", "D"} <= set(results):
+        if results["A"] and not results["B"]:
+            if results["C"] and not results["D"]:
+                lines.append(
+                    "**Verdict: N-SPECIFIC fault** — 2^18 stays clean "
+                    "even at 2^19's total ring bytes (C ok) and 2^19 "
+                    "fails even at 2^18's (D fail): node count, not "
+                    "allocation size, triggers it (scatter index "
+                    "space / buffer count / program shape).")
+            elif not results["C"] and results["D"]:
+                lines.append(
+                    "**Verdict: TOTAL-ALLOCATION fault** — the byte "
+                    "total, not the node count, reproduces it (C "
+                    "fail, D ok).")
+            else:
+                lines.append(
+                    f"**Mixed outcome (C ok={results['C']}, D "
+                    f"ok={results['D']})** — both factors contribute; "
+                    "see the table.")
+        else:
+            lines.append("**Endpoints did not match the r4 facts** "
+                         "(A clean / B crash) — the runtime changed; "
+                         "see the table.")
+    REPORT.write_text("\n".join(lines) + "\n")
+    print(f"wrote {REPORT}", flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "grid"
+    if mode == "repro":
+        repro(int(sys.argv[2]),
+              int(sys.argv[3]) if len(sys.argv) > 3 else SIM_MS,
+              int(sys.argv[4]) if len(sys.argv) > 4 else HORIZON)
+    else:
+        grid()
