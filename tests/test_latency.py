@@ -1,8 +1,11 @@
 """Latency-model tests — the analogue of core NetworkLatencyTest.java:
 city matrix lookups, AWS values, throughput numbers, estimator round-trip."""
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.core import builders, geo
 from wittgenstein_tpu.core.latency import (
@@ -127,3 +130,58 @@ def test_estimate_p2p_latency():
     tab = np.asarray(est.table)
     assert tab.shape == (100,)
     assert np.all(np.diff(tab) >= 0) and tab[0] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.isdir(
+    "/root/reference/core/src/main/resources/Data"),
+    reason="reference measurement CSVs not present")
+def test_city_set_matches_reference_pruning():
+    """The vendored citydata.npz city set equals the reference's own
+    post-pruning set: CSVLatencyReader removes cities missing a
+    measurement in BOTH directions vs any other city
+    (CSVLatencyReader.java:331-347, applied once at :285-286), keeping
+    219 of the 242 measured cities — verified here that one pass
+    already yields a COMPLETE matrix (so the vendoring's
+    prune-to-fixpoint form is equivalent) — and NodeBuilderWithCity
+    additionally needs geo coordinates, which drops 'Westpoort'
+    (absent from cities.csv), leaving the npz's 218."""
+    import csv
+
+    res = "/root/reference/core/src/main/resources"
+    data_dir = os.path.join(res, "Data")
+    cities = sorted(os.listdir(data_dir))
+    by_space = [(c, c.replace("+", " ")) for c in cities]
+    lat = {c: set() for c in cities}
+    for c in cities:
+        with open(os.path.join(data_dir, c, c + "Ping.csv"), newline="",
+                  encoding="utf-8") as f:
+            rd = csv.reader(f)
+            next(rd)
+            for row in rd:
+                best = None
+                for name, spaced in by_space:
+                    if spaced in row[0] and (best is None or
+                                             len(name) > len(best)):
+                        best = name
+                if best is not None:
+                    lat[c].add(best)      # membership is all the
+                    #                       pruning rule reads
+        lat[c].add(c)
+    bad = {a for a in lat for b in lat
+           if b not in lat[a] and a not in lat[b]}
+    kept = sorted(set(lat) - bad)
+    assert len(kept) == 219
+    # One pass leaves a complete matrix (every pair measured some way).
+    assert not [(a, b) for a in kept for b in kept
+                if b not in lat[a] and a not in lat[b]]
+    geo_names = set()
+    with open(os.path.join(res, "cities.csv"), newline="",
+              encoding="utf-8") as f:
+        rd = csv.reader(f)
+        next(rd)
+        for row in rd:
+            geo_names.add(row[0].replace(" ", "+"))
+    expected = sorted(c for c in kept if c in geo_names)
+    names = sorted(geo.load().names)
+    assert names == expected and len(names) == 218
