@@ -75,3 +75,18 @@ def test_batched_rejects_broadcast_protocols():
     from wittgenstein_tpu.models.pingpong import PingPong
     with pytest.raises(ValueError, match="broadcast-free"):
         scan_chunk_batched(PingPong(node_count=64), 40)
+
+
+def test_batched_with_pallas_merge():
+    """The batched engine composed with the fused Pallas delivery-merge
+    kernel — the exact combination the on-chip bench session runs
+    (WTPU_PALLAS=1 with the batched default) — stays bit-identical to
+    the batched XLA-merge path."""
+    kw = dict(node_count=64, threshold=56, nodes_down=6,
+              pairing_time=4, dissemination_period_ms=20,
+              level_wait_time=50, fast_path=10)
+    ref_x, bat_x = _run_both(Handel(pallas_merge=False, **kw), 80)
+    ref_p, bat_p = _run_both(Handel(pallas_merge=True, **kw), 80)
+    _trees_equal(bat_x, bat_p)          # batched: kernel == XLA merge
+    _trees_equal(ref_x, bat_p)          # == the vmapped XLA reference
+    _trees_equal(ref_p, bat_p)          # == the vmapped kernel path
