@@ -29,9 +29,16 @@ WTPU_PALLAS=1 timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_pallas.log"
 echo "--- [3/7] seeds=32 $(stamp)"
 WTPU_BENCH_SEEDS=32 WTPU_BENCH_SEED_BATCH=32 WTPU_BENCH_BOX_SPLIT=2 \
   timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_seeds32.log"
-echo "--- [3b/7] seeds=64 $(stamp)"
+echo "--- [3b/7] seeds=48 $(stamp)"
+# 48, not 64: the stored emission matrix [R, N, N] int32 is 805 MB at
+# R=48 and 1.07 GB at R=64 — the latter breaches the runtime's ~1 GB
+# single-buffer limit (box_split only divides the RING planes).
+WTPU_BENCH_SEEDS=48 WTPU_BENCH_SEED_BATCH=48 WTPU_BENCH_BOX_SPLIT=4 \
+  timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_seeds48.log"
+echo "--- [3c/7] seeds=64 hashed-emission (labeled variant) $(stamp)"
 WTPU_BENCH_SEEDS=64 WTPU_BENCH_SEED_BATCH=64 WTPU_BENCH_BOX_SPLIT=4 \
-  timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_seeds64.log"
+  WTPU_BENCH_EMISSION=hashed timeout 3600 python bench.py 2>&1 \
+  | tee "$R/bench_r5_seeds64_hashed.log"
 
 # 4. Exact-mode 32k (tracked): q_sig state_split keeps every queue
 #    buffer under the limit; pool-free hashed tier-2 config.
