@@ -2,8 +2,29 @@
 
 Force an 8-device virtual CPU mesh so sharding paths are exercised without
 TPU hardware (the driver separately dry-runs the multi-chip path); see
-wittgenstein_tpu/utils/platform.py for why this beats the env var."""
+wittgenstein_tpu/utils/platform.py for why this beats the env var.
+
+Also enable JAX's persistent compilation cache (repo-local, gitignored):
+the suite's wall time is dominated by XLA compiles on the 1-core
+sandbox, and the cache cuts the compile-heavy tests ~4x on every run
+after the first (measured: 112 s -> 26.5 s for the phase-hint equality
+test).  JAX_COMPILATION_CACHE_DIR in the environment overrides the
+location; set it to "" to disable.
+"""
+
+import os
+import pathlib
 
 from wittgenstein_tpu.utils.platform import force_virtual_cpu
 
 force_virtual_cpu(8)
+
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import jax
+
+    cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+    jax.config.update("jax_compilation_cache_dir", str(cache))
+    # Cache every program the suite compiles (the defaults skip
+    # fast-compiling ones, which is most of a 64-node test suite).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -45,6 +45,7 @@ def test_batched_matches_vmapped_plain():
     assert int(np.asarray(ps.sigs_checked).sum()) > 0
 
 
+@pytest.mark.slow
 def test_batched_matches_vmapped_phase_specialized():
     proto = Handel(node_count=64, threshold=56, nodes_down=6,
                    pairing_time=4, dissemination_period_ms=20,
@@ -53,6 +54,7 @@ def test_batched_matches_vmapped_phase_specialized():
     _trees_equal(a, b)
 
 
+@pytest.mark.slow
 def test_batched_matches_vmapped_cardinal():
     proto = Handel(node_count=64, threshold=56, nodes_down=6,
                    pairing_time=4, dissemination_period_ms=20,

@@ -193,6 +193,7 @@ def test_hidden_byzantine():
     assert np.array_equal(outs[0], outs[1])
 
 
+@pytest.mark.slow
 def test_hidden_byzantine_small_queue_eviction_mode():
     """VERDICT r1 weak #3 / #10: the bounded verification queue diverges
     from the reference's unbounded toVerifyAgg (Handel.java:830-834)

@@ -71,6 +71,7 @@ def test_cardinal_determinism():
                               np.asarray(net3.nodes.done_at))
 
 
+@pytest.mark.slow
 def test_cardinal_drift_vs_exact_small():
     """The count-based accounting is the same per-level math as exact mode
     (updateVerifiedSignatures, Handel.java:686-750); dropped optimizations
@@ -91,6 +92,7 @@ def test_cardinal_drift_vs_exact_small():
     assert abs(drift) < 0.25, means
 
 
+@pytest.mark.slow
 def test_cardinal_byzantine_suicide():
     p = _cardinal(n=256, down=64, threshold=150, byzantine_suicide=True)
     net, ps = _run(p, 2500)
@@ -101,6 +103,7 @@ def test_cardinal_byzantine_suicide():
     assert int(np.asarray(ps.blacklist).astype(np.uint64).sum()) > 0
 
 
+@pytest.mark.slow
 def test_cardinal_hidden_byzantine_slows_completion():
     base = _cardinal(n=256, down=64, threshold=150)
     att = _cardinal(n=256, down=64, threshold=150, hidden_byzantine=True)
@@ -115,6 +118,7 @@ def test_cardinal_hidden_byzantine_slows_completion():
     assert m["att"] >= m["base"], m
 
 
+@pytest.mark.slow
 def test_cardinal_vmap_seeds():
     import jax
     from wittgenstein_tpu.core.network import scan_chunk

@@ -98,6 +98,7 @@ def test_desynchronized_start_never_specializes():
     assert int(net2.time) == 40
 
 
+@pytest.mark.slow
 def test_specialized_scan_non_multiple_length():
     # A non-lcm-multiple chunk misaligns on REUSE, so it must be an
     # explicit one-shot opt-in (allow_unaligned); the schedule is then
