@@ -19,12 +19,13 @@ from wittgenstein_tpu.utils.platform import force_virtual_cpu
 
 force_virtual_cpu(8)
 
-if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    import jax
+import jax
 
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
     cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
     jax.config.update("jax_compilation_cache_dir", str(cache))
-    # Cache every program the suite compiles (the defaults skip
-    # fast-compiling ones, which is most of a 64-node test suite).
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# Cache every program the suite compiles (the defaults skip
+# fast-compiling ones, which is most of a 64-node test suite) — applied
+# for an env-var-relocated cache too, not just the repo-local default.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
