@@ -1,0 +1,61 @@
+"""Bit-equality of the fused verification-scoring kernel
+(ops/pallas_score.py, interpret mode on CPU) against the XLA block in
+`models/handel._pick_verification`."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.models.handel import Handel
+from wittgenstein_tpu.ops import bitset
+from wittgenstein_tpu.ops.pallas_score import score_queue_pallas
+
+
+def _xla_block(proto, sig, elvl, ids, total_inc, ver_ind, last_agg):
+    emask = proto._range_mask_dyn(ids[:, None], elvl)
+    inc_e = total_inc[:, None, :] & emask
+    ver_e = ver_ind[:, None, :] & emask
+    agg_e = last_agg[:, None, :] & emask
+    disj = ~bitset.intersects(sig, inc_e)
+    merged = jnp.where(disj[..., None], sig | inc_e, sig)
+    return (bitset.popcount(merged | ver_e), bitset.popcount(sig),
+            bitset.popcount(sig | ver_e), bitset.intersects(sig, agg_e))
+
+
+def test_score_kernel_bit_equal():
+    n, q = 256, 8
+    proto = Handel(node_count=n, threshold=250, queue_cap=q)
+    w = proto.w
+    rng = np.random.default_rng(11)
+    sig = jnp.asarray(rng.integers(0, 2 ** 32, (n, q, w),
+                                   dtype=np.uint32))
+    # Levels 0..L-1 including empty level 0 and the top level.
+    elvl = jnp.asarray(rng.integers(0, proto.levels, (n, q)).astype(
+        np.int32))
+    ids = jnp.arange(n, dtype=jnp.int32)
+    ti = jnp.asarray(rng.integers(0, 2 ** 32, (n, w), dtype=np.uint32))
+    vi = jnp.asarray(rng.integers(0, 2 ** 32, (n, w), dtype=np.uint32))
+    la = jnp.asarray(rng.integers(0, 2 ** 32, (n, w), dtype=np.uint32))
+    ref = _xla_block(proto, sig, elvl, ids, ti, vi, la)
+    got = score_queue_pallas(sig, elvl, ids, ti, vi, la, interpret=True)
+    for name, r, g in zip(("s_inc", "pc_sig", "pc_sv", "inter_agg"),
+                          ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=name)
+
+
+def test_score_kernel_zero_and_full_rows():
+    """All-zero sigs (empty queue slots) and all-ones bitsets — the
+    boundary word masks (level 0 empty range, top level full range)."""
+    n, q = 64, 4
+    proto = Handel(node_count=n, threshold=60, queue_cap=q)
+    w = proto.w
+    ids = jnp.arange(n, dtype=jnp.int32)
+    elvl = jnp.asarray(
+        np.tile(np.array([0, 1, proto.levels - 1, 3], np.int32), (n, 1)))
+    zeros = jnp.zeros((n, q, w), jnp.uint32)
+    ones = jnp.full((n, w), 0xFFFFFFFF, jnp.uint32)
+    ref = _xla_block(proto, zeros, elvl, ids, ones, ones, ones)
+    got = score_queue_pallas(zeros, elvl, ids, ones, ones, ones,
+                             interpret=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))

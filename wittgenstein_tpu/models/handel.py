@@ -613,6 +613,20 @@ class Handel(LevelMixin, StaticScheduleMixin):
         for j in range(P):
             sl = slice(j * ns, (j + 1) * ns)
             sig = p.q_sig[j]                                  # [ns, Q, W]
+            if self.pallas_merge:
+                # Same switch as the delivery-merge kernel: one fused
+                # pass instead of ~6 HBM round-trips over the sig plane
+                # (ops/pallas_score.py, bit-equal by test).
+                from ..ops.pallas_score import score_queue_pallas
+                si, ps, pv, ia = score_queue_pallas(
+                    sig, elvl[sl], ids[sl], total_inc[sl], p.ver_ind[sl],
+                    p.last_agg[sl],
+                    interpret=jax.default_backend() != "tpu")
+                s_inc_p.append(si)
+                pc_sig_p.append(ps)
+                pc_sv_p.append(pv)
+                inter_agg_p.append(ia)
+                continue
             emask = self._range_mask_dyn(ids[sl][:, None], elvl[sl])
             inc_e = total_inc[sl][:, None, :] & emask
             ver_e = p.ver_ind[sl][:, None, :] & emask

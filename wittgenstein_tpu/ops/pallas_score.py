@@ -1,0 +1,126 @@
+"""Fused Pallas verification-scoring kernel — the W-wide per-queue-entry
+work of Handel's `bestToVerify` tick (`models/handel._pick_verification`:
+sizeIfIncluded Handel.java:545-552 + the score Handel.java:651-664) in
+one pass.
+
+The XLA form materializes four [M, Q, W] intermediates per verify tick
+(level range mask, and the masked total/verified/aggregate views) plus
+the merged candidates — ~6 full passes over the queue's sig plane in
+HBM.  The kernel reads each node block's sig rows and three bitset rows
+once, builds the level mask in-register from (id, level) arithmetic
+(`_levels.sibling_base` + `ops.bitset.range_mask` semantics), and emits
+only the four [M, Q] summaries the rest of the tick consumes:
+
+  s_inc     = popcount(merged | ver_e)   (merged = sig|inc_e if disjoint
+                                          from inc_e else sig)
+  pc_sig    = popcount(sig)
+  pc_sv     = popcount(sig | ver_e)
+  inter_agg = intersects(sig, agg_e)
+
+Bit-equality with the XLA path is tested in tests/test_pallas_score.py
+and end-to-end via the pallas_merge=True Handel runs (both kernels ride
+the same switch).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _popcount_u32(v):
+    """Bit-trick popcount (some Mosaic versions lack
+    lax.population_count — tools/pallas_probe.py validates this form
+    on the real toolchain)."""
+    v = v - ((v >> 1) & U32(0x55555555))
+    v = (v & U32(0x33333333)) + ((v >> 2) & U32(0x33333333))
+    return ((((v + (v >> 4)) & U32(0x0F0F0F0F)) * U32(0x01010101))
+            >> 24).astype(I32)
+
+
+def _score_kernel(sig_ref, lvl_ref, ids_ref, inc_ref, ver_ref, agg_ref,
+                  sinc_ref, psig_ref, psv_ref, iagg_ref, *, q_cap, w):
+    blk = lvl_ref.shape[0]
+    ids = ids_ref[...]                                  # [blk, 1]
+    inc = inc_ref[...]                                  # [blk, W]
+    ver = ver_ref[...]
+    agg = agg_ref[...]
+    wlo = jax.lax.broadcasted_iota(I32, (blk, w), 1) * 32
+
+    s_inc, p_sig, p_sv, i_agg = [], [], [], []
+    for q in range(q_cap):
+        lvl = lvl_ref[:, q:q + 1]                       # [blk, 1]
+        # emask: the entry's level range (sibling half of the node's
+        # 2^lvl-aligned block), empty at level 0 — the same arithmetic
+        # as _levels.sibling_base + bitset.range_mask.
+        half = jnp.where(lvl > 0,
+                         jnp.int32(1) << jnp.clip(lvl - 1, 0, 30), 0)
+        half_nz = jnp.maximum(half, 1)
+        mine = ids & ~(2 * half_nz - 1)
+        base = mine + jnp.where((ids & half_nz) != 0, 0, half_nz)
+        base = jnp.where(half > 0, base, 0)
+        lo = jnp.clip(base - wlo, 0, 32)
+        hi = jnp.clip(base + half - wlo, 0, 32)
+        full = U32(0xFFFFFFFF)
+        m_hi = jnp.where(hi >= 32, full,
+                         (U32(1) << hi.astype(U32)) - U32(1))
+        m_lo = jnp.where(lo >= 32, full,
+                         (U32(1) << lo.astype(U32)) - U32(1))
+        emask = m_hi & ~m_lo                            # [blk, W]
+
+        sig = sig_ref[:, q, :]                          # [blk, W]
+        inc_e = inc & emask
+        ver_e = ver & emask
+        agg_e = agg & emask
+        disj = jnp.sum(jnp.where((sig & inc_e) != 0, 1, 0), axis=1,
+                       keepdims=True) == 0              # [blk, 1]
+        merged = jnp.where(disj, sig | inc_e, sig)
+        s_inc.append(jnp.sum(_popcount_u32(merged | ver_e), axis=1,
+                             keepdims=True))
+        p_sig.append(jnp.sum(_popcount_u32(sig), axis=1, keepdims=True))
+        p_sv.append(jnp.sum(_popcount_u32(sig | ver_e), axis=1,
+                            keepdims=True))
+        i_agg.append(jnp.sum(jnp.where((sig & agg_e) != 0, 1, 0),
+                             axis=1, keepdims=True))
+    sinc_ref[...] = jnp.concatenate(s_inc, axis=1)
+    psig_ref[...] = jnp.concatenate(p_sig, axis=1)
+    psv_ref[...] = jnp.concatenate(p_sv, axis=1)
+    iagg_ref[...] = jnp.concatenate(i_agg, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def score_queue_pallas(q_sig, q_lvl, ids, total_inc, ver_ind, last_agg,
+                       interpret: bool = False):
+    """Per-entry verification scores.  Shapes: q_sig [M, Q, W], q_lvl
+    [M, Q], ids [M] (global node ids), bitsets [M, W].  Returns
+    (s_inc, pc_sig, pc_sig_ver [M, Q] i32, inter_agg [M, Q] bool) —
+    bit-identical to the `_pick_verification` per-piece XLA block.
+    """
+    from jax.experimental import pallas as pl
+
+    from .pallas_merge import _pick_block
+
+    m, q, w = q_sig.shape
+    blk = _pick_block(m)
+    grid = (m // blk,)
+
+    def spec(shape):
+        return pl.BlockSpec((blk,) + shape, lambda g: (g,) + (0,) * len(shape))
+
+    kernel = functools.partial(_score_kernel, q_cap=q, w=w)
+    out_shape = tuple(jax.ShapeDtypeStruct((m, q), I32) for _ in range(4))
+    s_inc, pc_sig, pc_sv, i_agg = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec((q, w)), spec((q,)), spec((1,)), spec((w,)),
+                  spec((w,)), spec((w,))],
+        out_specs=[spec((q,))] * 4,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q_sig, q_lvl, ids.reshape(m, 1), total_inc, ver_ind, last_agg)
+    return s_inc, pc_sig, pc_sv, i_agg != 0
