@@ -78,3 +78,25 @@ def test_harness_multirun():
     res = run_multiple_times(p, run_count=2, max_time=3000, chunk=250,
                              cont_if=cont_if_gsf)
     assert np.all(np.asarray(res.stopped_at) > 0)
+
+
+def test_gsf_pallas_merge_bit_equal():
+    """The fused GSF queue-merge kernel (ops/pallas_gsf_merge.py,
+    interpret mode on CPU) leaves the ENTIRE simulation bit-identical:
+    full pytree equality after a run exercising aggregates, individuals
+    and evictions (small queue forces displacement)."""
+    kw = dict(node_count=128, threshold=115, nodes_down=12,
+              queue_cap=4, inbox_cap=8,
+              network_latency_name="NetworkLatencyByDistanceWJitter")
+    outs = []
+    for pallas in (False, True):
+        p = GSFSignature(pallas_merge=pallas, **kw)
+        net, ps = p.init(7)
+        net, ps = Runner(p, donate=False).run_ms(net, ps, 600)
+        outs.append((net, ps))
+    for (pa, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(outs[0]),
+            jax.tree.leaves(outs[1])):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=jax.tree_util.keystr(pa))

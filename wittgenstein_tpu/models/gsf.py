@@ -92,7 +92,20 @@ class GSFSignature(LevelMixin):
                  timeout_per_level_ms=50, period_duration_ms=10,
                  accelerated_calls_count=10, nodes_down=0,
                  node_builder_name=None, network_latency_name=None,
-                 queue_cap=16, inbox_cap=16, horizon=512):
+                 queue_cap=16, inbox_cap=16, horizon=512,
+                 pallas_merge=None):
+        # Fused Pallas queue merge (ops/pallas_gsf_merge.py) —
+        # bit-identical to the XLA merge (tests/test_gsf.py); shared
+        # auto-default policy with Handel.
+        from ..ops.pallas_merge import resolve_pallas_default
+        self.pallas_merge = resolve_pallas_default(pallas_merge)
+        if self.pallas_merge and queue_cap + 2 * inbox_cap > 255:
+            # The kernel's unique-key headroom (BIG0 + position); fail
+            # at construction, not after a 10-minute backend init.
+            raise ValueError(
+                f"pallas_merge supports queue_cap + 2*inbox_cap <= 255 "
+                f"(got {queue_cap} + 2*{inbox_cap}); pass "
+                "pallas_merge=False for wider rows")
         if node_count & (node_count - 1):
             raise ValueError("power-of-two node counts only (the reference "
                              "rounds to pow2, MoreMath.roundPow2)")
@@ -255,6 +268,21 @@ class GSFSignature(LevelMixin):
         dup_ind = jnp.any((src[:, :, None] == src[:, None, :]) &
                           valid[:, None, :] & earlier, axis=2)
         ind_ok = valid & ~dup_ind & ~_get_bit_rows(p.got_indiv, src)
+
+        if self.pallas_merge:
+            from ..ops.pallas_gsf_merge import gsf_merge_pallas
+            q_from, q_lvl, q_indiv, q_sig, got_add, kept_ex_agg = \
+                gsf_merge_pallas(
+                    p.q_from, p.q_lvl, p.q_indiv, ex_keep, p.q_sig,
+                    src, level, agg_ok, ind_ok, sig_all, levels=L,
+                    interpret=jax.default_backend() != "tpu")
+            got_indiv = p.got_indiv | got_add
+            evicted = p.evicted + jnp.sum(
+                jnp.sum(ex_keep & ~p.q_indiv, axis=1) -
+                kept_ex_agg).astype(jnp.int32)
+            return p.replace(q_from=q_from, q_lvl=q_lvl,
+                             q_indiv=q_indiv, q_sig=q_sig,
+                             got_indiv=got_indiv, evicted=evicted)
 
         u_from = jnp.concatenate(
             [jnp.where(ex_keep, p.q_from, -1),

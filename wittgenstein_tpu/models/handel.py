@@ -188,19 +188,21 @@ class Handel(LevelMixin, StaticScheduleMixin):
                              "emission_mode='hashed' past 32768 nodes")
         self.emission_mode = emission_mode
         self.snapshot_pool = snapshot_pool
-        # Fused Pallas delivery-merge kernel (ops/pallas_merge.py) —
-        # bit-identical to the XLA merge (tests/test_pallas_merge.py,
-        # test_handel.py::test_pallas_merge_path_bit_equal).  None =
-        # auto: on for TPU backends when WTPU_PALLAS != "0" (flip the
-        # default once chip-validated); CPU runs with pallas_merge=True
-        # go through the Pallas interpreter.  Resolved HERE, once — the
-        # instance is inspectable and the decision cannot flip between
-        # retraces (same policy as prefix_pc above).
-        if pallas_merge is None:
-            import os
-            pallas_merge = (os.environ.get("WTPU_PALLAS", "0") != "0"
-                            and jax.default_backend() == "tpu")
-        self.pallas_merge = pallas_merge
+        # Fused Pallas delivery-merge + verification-scoring kernels
+        # (ops/pallas_merge.py, ops/pallas_score.py) — bit-identical to
+        # the XLA paths (tests/test_pallas_merge.py, test_pallas_score
+        # .py, test_handel.py::test_pallas_merge_path_bit_equal); CPU
+        # runs with pallas_merge=True go through the interpreter.
+        # Shared auto-default policy (resolve_pallas_default).
+        from ..ops.pallas_merge import resolve_pallas_default
+        self.pallas_merge = resolve_pallas_default(pallas_merge)
+        if self.pallas_merge and queue_cap + inbox_cap > 255:
+            # The kernel's unique-key headroom (BIG0 + position); fail
+            # at construction, not after a 10-minute backend init.
+            raise ValueError(
+                f"pallas_merge supports queue_cap + inbox_cap <= 255 "
+                f"(got {queue_cap} + {inbox_cap}); pass "
+                "pallas_merge=False for wider rows")
         # Past ~16k nodes the [N, W, L] word->level one-hot for the MXU
         # popcount contraction is gigabytes; the prefix-sum path computes
         # the SAME values (tested bit-equal) in O(N * W).

@@ -1,0 +1,195 @@
+"""Fused Pallas GSF queue merge — the three-tier bounded-queue merge of
+`models/gsf._receive` (existing entries ∪ incoming aggregates ∪ incoming
+individuals, GSFSignature.java:539-553 under the documented bounded
+policy) as one kernel.
+
+Same motivation and structure as `ops/pallas_merge.py` (the Handel
+delivery kernel): the XLA form materializes the [M, Q+2S, W]
+candidate-sig concatenation, top_k's the tiered keys and gathers every
+column through the order.  Here the candidate columns are synthesized
+in-register (existing sig rows, pool-reconstructed aggregate rows, and
+the individuals' one-bit rows built from the sender id), the Q-round
+selection and gathers run in VMEM, the queue sig plane is updated in
+place, and the `got_indiv` delta (the per-node OR of newly-admitted
+individuals' bits) comes out of the same pass.
+
+Key layout (must match `models/gsf._receive` exactly):
+  tier = 2 for incoming individuals, else 0 if the entry is an
+  individual else 1; key = (tier*(L+1) + (lvl if tier==1 else 0))*C + c
+  for valid candidates (unique via the position term), BIG0 + c for
+  invalid ones (lax.top_k's ascending-index tie rule, made explicit).
+
+Bit-equality with the XLA path: tests/test_gsf.py::
+test_gsf_pallas_merge_bit_equal (end-to-end full-pytree over a run).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+BIG0 = 0x7FFFFF00
+EXCLUDED = 0x7FFFFFFF
+
+
+def _gsf_kernel(exf_ref, exl_ref, exi_ref, exk_ref, exs_ref,
+                src_ref, lvl_ref, aok_ref, iok_ref, isig_ref,
+                of_ref, ol_ref, oi_ref, os_ref, ogot_ref, okept_ref,
+                *, q_cap, s_cap, levels):
+    blk = exf_ref.shape[0]
+    w = exs_ref.shape[2]
+    c_tot = q_cap + 2 * s_cap
+
+    exf = exf_ref[...]                                 # [blk, Q]
+    exl = exl_ref[...]
+    exi = exi_ref[...]                                 # 1 = individual
+    ex_keep = exk_ref[...] != 0
+    src = src_ref[...]                                 # [blk, S]
+    lvl = lvl_ref[...]
+    aok = aok_ref[...] != 0
+    iok = iok_ref[...] != 0
+
+    word_idx = jax.lax.broadcasted_iota(I32, (blk, w), 1)
+
+    # Candidate columns: from/lvl/indiv/key as [blk] column lists; sig
+    # rows fetched per column inside the selection loop.
+    u_from, u_lvl, u_ind, keys = [], [], [], []
+    for c in range(c_tot):
+        if c < q_cap:
+            f = jnp.where(ex_keep[:, c], exf[:, c], -1)
+            lv = exl[:, c]
+            ind = exi[:, c]
+            tier = jnp.where(ind != 0, 0, 1)
+        elif c < q_cap + s_cap:
+            s = c - q_cap
+            f = jnp.where(aok[:, s], src[:, s], -1)
+            lv = lvl[:, s]
+            ind = jnp.zeros((blk,), I32)
+            tier = jnp.ones((blk,), I32)
+        else:
+            s = c - q_cap - s_cap
+            f = jnp.where(iok[:, s], src[:, s], -1)
+            lv = lvl[:, s]
+            ind = jnp.ones((blk,), I32)
+            tier = jnp.full((blk,), 2, I32)
+        lvl_term = jnp.where(tier == 1, lv, 0)
+        k = (tier * (levels + 1) + lvl_term) * c_tot + c
+        keys.append(jnp.where(f >= 0, k, BIG0 + c))
+        u_from.append(f)
+        u_lvl.append(lv)
+        u_ind.append(ind)
+    key_mat = jnp.stack(keys, axis=1)                  # [blk, C]
+
+    def cand_sig(c):
+        if c < q_cap:
+            return exs_ref[:, c, :]
+        if c < q_cap + s_cap:
+            return isig_ref[:, c - q_cap, :]
+        # Individuals: ind_ok ? one_bit(src) : 0 — the exact junk
+        # semantics of the XLA concatenation.
+        s = c - q_cap - s_cap
+        sid = src[:, s:s + 1]
+        bit = jnp.where(word_idx == sid // 32,
+                        U32(1) << (sid % 32).astype(U32), U32(0))
+        return jnp.where(iok[:, s:s + 1], bit, U32(0))
+
+    sel_f, sel_l, sel_i, sel_sig = [], [], [], []
+    got_add = jnp.zeros((blk, w), U32)
+    kept_ex_agg = jnp.zeros((blk, 1), I32)
+    for _ in range(q_cap):
+        kmin = jnp.min(key_mat, axis=1, keepdims=True)
+        hit = key_mat == kmin                          # [blk, C]
+        f = jnp.zeros((blk,), I32)
+        lv = jnp.zeros((blk,), I32)
+        ind = jnp.zeros((blk,), I32)
+        sig = jnp.zeros((blk, w), U32)
+        new_ind = jnp.zeros((blk,), bool)
+        for c in range(c_tot):
+            h = hit[:, c]
+            f = jnp.where(h, u_from[c], f)
+            lv = jnp.where(h, u_lvl[c], lv)
+            ind = jnp.where(h, u_ind[c], ind)
+            sig = jnp.where(h[:, None], cand_sig(c), sig)
+            if c < q_cap:
+                kept_ex_agg = kept_ex_agg + jnp.where(
+                    (h & (u_from[c] >= 0) & (u_ind[c] == 0))[:, None],
+                    1, 0)
+            elif c >= q_cap + s_cap:
+                new_ind = new_ind | h
+        sel_f.append(f[:, None])
+        sel_l.append(lv[:, None])
+        sel_i.append(ind[:, None])
+        sel_sig.append(sig)
+        # got_indiv delta: newly admitted individuals' sender bits.
+        fid = jnp.maximum(f, 0)[:, None]
+        fbit = jnp.where(word_idx == fid // 32,
+                         U32(1) << (fid % 32).astype(U32), U32(0))
+        got_add = got_add | jnp.where((new_ind & (f >= 0))[:, None],
+                                      fbit, U32(0))
+        key_mat = jnp.where(hit, EXCLUDED, key_mat)
+
+    of_ref[...] = jnp.concatenate(sel_f, axis=1)
+    ol_ref[...] = jnp.concatenate(sel_l, axis=1)
+    oi_ref[...] = jnp.concatenate(sel_i, axis=1)
+    os_ref[...] = jnp.stack(sel_sig, axis=1)
+    ogot_ref[...] = got_add
+    okept_ref[...] = kept_ex_agg
+
+
+@functools.partial(jax.jit, static_argnames=("levels", "interpret"))
+def gsf_merge_pallas(q_from, q_lvl, q_indiv, ex_keep, q_sig,
+                     src, level, agg_ok, ind_ok, sig_all,
+                     levels: int, interpret: bool = False):
+    """Fused GSF three-tier queue merge.  Returns (q_from', q_lvl',
+    q_indiv' (bool), q_sig', got_add [M, W], kept_ex_agg [M]) —
+    bit-identical to the `select_queue` tail of `models/gsf._receive`
+    (dup/supersede/got_indiv masks are computed by the caller; `ex_keep`
+    and `agg_ok`/`ind_ok` carry them in).
+    """
+    from jax.experimental import pallas as pl
+
+    from .pallas_merge import _pick_block
+
+    m, q = q_from.shape
+    s = src.shape[1]
+    w = q_sig.shape[2]
+    assert sig_all.shape == (m, s, w), (q_sig.shape, sig_all.shape)
+    c_tot = q + 2 * s
+    if c_tot > 255:
+        raise ValueError(f"gsf_merge_pallas supports q + 2s <= 255 "
+                         f"(got {q} + 2*{s})")
+    blk = _pick_block(m)
+    grid = (m // blk,)
+
+    def spec(shape):
+        return pl.BlockSpec((blk,) + shape,
+                            lambda g: (g,) + (0,) * len(shape))
+
+    kernel = functools.partial(_gsf_kernel, q_cap=q, s_cap=s,
+                               levels=levels)
+    out_shape = (
+        jax.ShapeDtypeStruct((m, q), I32),
+        jax.ShapeDtypeStruct((m, q), I32),
+        jax.ShapeDtypeStruct((m, q), I32),
+        jax.ShapeDtypeStruct((m, q, w), U32),
+        jax.ShapeDtypeStruct((m, w), U32),
+        jax.ShapeDtypeStruct((m, 1), I32),
+    )
+    o_f, o_l, o_i, o_s, o_got, o_kept = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec((q,)), spec((q,)), spec((q,)), spec((q,)),
+                  spec((q, w)), spec((s,)), spec((s,)), spec((s,)),
+                  spec((s,)), spec((s, w))],
+        out_specs=[spec((q,)), spec((q,)), spec((q,)), spec((q, w)),
+                   spec((w,)), spec((1,))],
+        out_shape=out_shape,
+        input_output_aliases={4: 3},            # q_sig updated in place
+        interpret=interpret,
+    )(q_from, q_lvl, q_indiv.astype(I32), ex_keep.astype(I32), q_sig,
+      src, level, agg_ok.astype(I32), ind_ok.astype(I32), sig_all)
+    return o_f, o_l, o_i != 0, o_s, o_got, o_kept[:, 0]

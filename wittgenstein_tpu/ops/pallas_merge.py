@@ -132,6 +132,19 @@ def _merge_kernel(exf_ref, exl_ref, exr_ref, exb_ref, exs_ref,
     oev_ref[...] = n_keep - kept_existing
 
 
+def resolve_pallas_default(explicit):
+    """The ONE resolution policy for a protocol's `pallas_merge=None`
+    auto default: on for TPU backends when WTPU_PALLAS != "0" (flip the
+    default here once chip-validated).  Resolved once at protocol
+    construction — the instance is inspectable and the decision cannot
+    flip between retraces.  Shared by Handel and GSFSignature."""
+    if explicit is not None:
+        return explicit
+    import os
+    return (os.environ.get("WTPU_PALLAS", "0") != "0"
+            and jax.default_backend() == "tpu")
+
+
 def _pick_block(m):
     """Largest power-of-two block <= 256 dividing the row count."""
     for blk in (256, 128, 64, 32, 16, 8, 4, 2):
