@@ -21,13 +21,19 @@ def main():
     try:
         from jax.experimental import pallas as pl
 
+        # Real Mosaic lowering on TPU (the probe's purpose); CPU falls
+        # back to the interpreter so the probe's own logic stays
+        # self-testable off-chip.
+        interp = jax.default_backend() == "cpu"
+
         def add_kernel(x_ref, y_ref, o_ref):
             o_ref[...] = x_ref[...] + y_ref[...]
 
         x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
         out = pl.pallas_call(
             add_kernel,
-            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x, x)
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interp)(x, x)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(2 * x))
 
         # Row-local compute at the delivery-merge shape class: [rows, W]
@@ -52,12 +58,91 @@ def main():
         u, s = pl.pallas_call(
             popmerge_kernel,
             out_shape=(jax.ShapeDtypeStruct((rows, w), jnp.uint32),
-                       jax.ShapeDtypeStruct((rows, 1), jnp.int32)))(a, b)
+                       jax.ShapeDtypeStruct((rows, 1), jnp.int32)),
+            interpret=interp)(a, b)
         ref_u = np.asarray(a) | np.asarray(b)
         np.testing.assert_array_equal(np.asarray(u), ref_u)
         ref_s = np.unpackbits(
             ref_u.view(np.uint8), axis=1).sum(axis=1, dtype=np.int32)
         np.testing.assert_array_equal(np.asarray(s)[:, 0], ref_s)
+
+        # The exact construct mix of the round-5 fused kernels
+        # (ops/pallas_merge.py selection loop): 2-D broadcasted_iota,
+        # keepdims-min + one-hot masked-sum gather, per-column
+        # [blk, 1] concatenate, [blk, Q, W] stack, grid blocking and
+        # input_output_aliases — a fast fail here diagnoses a stage-2
+        # bench failure in seconds instead of an hour.
+        def select_kernel(key_ref, val_ref, ok_ref, oc_ref, os_ref):
+            blk, c = key_ref.shape
+            w2 = val_ref.shape[2]
+            keys = jnp.where(ok_ref[...] != 0, key_ref[...],
+                             0x7FFFFF00 +
+                             jax.lax.broadcasted_iota(jnp.int32,
+                                                      (blk, c), 1))
+            cols, sigs = [], []
+            for _ in range(2):                  # top-2 rounds
+                kmin = jnp.min(keys, axis=1, keepdims=True)
+                hit = keys == kmin
+                cols.append(jnp.sum(jnp.where(hit, key_ref[...], 0),
+                                    axis=1, keepdims=True))
+                sg = jnp.zeros((blk, w2), jnp.uint32)
+                for cc in range(c):
+                    sg = jnp.where(hit[:, cc:cc + 1],
+                                   val_ref[:, cc, :], sg)
+                sigs.append(sg)
+                keys = jnp.where(hit, 0x7FFFFFFF, keys)
+            oc_ref[...] = jnp.concatenate(cols, axis=1)
+            os_ref[...] = jnp.stack(sigs, axis=1)
+
+        m, c, w2 = 512, 6, 128
+        rng = np.random.default_rng(3)
+        key = jnp.asarray(rng.permutation(m * c).reshape(m, c)
+                          .astype(np.int32))
+        val = jnp.asarray(rng.integers(0, 2 ** 32, (m, c, w2),
+                                       dtype=np.uint32))
+        okm = jnp.asarray((rng.random((m, c)) < 0.7).astype(np.int32))
+        blk = 128
+        oc, osig = pl.pallas_call(
+            select_kernel,
+            grid=(m // blk,),
+            in_specs=[pl.BlockSpec((blk, c), lambda g: (g, 0)),
+                      pl.BlockSpec((blk, c, w2), lambda g: (g, 0, 0)),
+                      pl.BlockSpec((blk, c), lambda g: (g, 0))],
+            out_specs=[pl.BlockSpec((blk, 2), lambda g: (g, 0)),
+                       pl.BlockSpec((blk, 2, w2), lambda g: (g, 0, 0))],
+            out_shape=(jax.ShapeDtypeStruct((m, 2), jnp.int32),
+                       jax.ShapeDtypeStruct((m, 2, w2), jnp.uint32)),
+            interpret=interp,
+        )(key, val, okm)
+        kn, vn, on = (np.asarray(key), np.asarray(val), np.asarray(okm))
+        big = 0x7FFFFF00 + np.arange(c)[None, :]
+        keff = np.where(on != 0, kn, big)
+        order = np.argsort(keff, axis=1)[:, :2]
+        ref_c = np.take_along_axis(kn, order, axis=1)
+        ref_s = np.take_along_axis(vn, order[:, :, None], axis=1)
+        np.testing.assert_array_equal(np.asarray(oc), ref_c)
+        np.testing.assert_array_equal(np.asarray(osig), ref_s)
+        print("PALLAS_SELECT_OK")
+
+        # input_output_aliases on a gridded [M, Q, W] u32 operand — the
+        # in-place q_sig update the merge kernels rely on.
+        def inplace_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] | jnp.uint32(1)
+
+        x3 = jnp.asarray(rng.integers(0, 2 ** 32, (m, 4, w2),
+                                      dtype=np.uint32))
+        y3 = pl.pallas_call(
+            inplace_kernel,
+            grid=(m // blk,),
+            in_specs=[pl.BlockSpec((blk, 4, w2), lambda g: (g, 0, 0))],
+            out_specs=pl.BlockSpec((blk, 4, w2), lambda g: (g, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, 4, w2), jnp.uint32),
+            input_output_aliases={0: 0},
+            interpret=interp,
+        )(x3)
+        np.testing.assert_array_equal(np.asarray(y3),
+                                      np.asarray(x3) | 1)
+        print("PALLAS_ALIAS_OK")
         print(f"PALLAS_OK platform={jax.default_backend()}")
     except Exception as e:  # noqa: BLE001 — probe reports, caller decides
         print(f"PALLAS_FAIL {type(e).__name__}: {e!s:.500}")

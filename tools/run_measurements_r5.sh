@@ -18,9 +18,14 @@ echo "=== r5 on-chip session start $(stamp)"
 echo "--- [1/7] official 2048x16 $(stamp)"
 timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_official.log"
 
-# 2. Pallas delivery-merge A/B at the official config (same process
-#    protocol as the bench; WTPU_PALLAS=1 enables the kernel on TPU).
-echo "--- [2/7] pallas merge A/B $(stamp)"
+# 2. Pallas kernels A/B at the official config (same process protocol
+#    as the bench; WTPU_PALLAS=1 enables all three kernels on TPU).
+#    The probe first: it exercises the kernels' exact construct mix
+#    through real Mosaic, so a toolchain incompatibility fails in
+#    seconds with a named construct instead of burning the bench hour.
+echo "--- [2/7] pallas probe + A/B $(stamp)"
+timeout 1200 python tools/pallas_probe.py 2>&1 \
+  | tee "$R/pallas_probe_r5.log"
 WTPU_PALLAS=1 timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_pallas.log"
 
 # 3. Seed scaling on the batched engine (the folded scatter removed the
