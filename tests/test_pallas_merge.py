@@ -124,3 +124,19 @@ def test_merge_kernel_rank_ties():
                           ref, got):
         np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
                                       err_msg=name)
+
+
+def test_resolve_pallas_default(monkeypatch):
+    """The shared auto-default policy: explicit wins; None resolves
+    from WTPU_PALLAS + backend (off on CPU regardless of the env)."""
+    import jax
+
+    from wittgenstein_tpu.ops.pallas_merge import resolve_pallas_default
+    assert resolve_pallas_default(True) is True
+    assert resolve_pallas_default(False) is False
+    monkeypatch.setenv("WTPU_PALLAS", "1")
+    # These tests run on the CPU backend: auto must stay off.
+    assert jax.default_backend() == "cpu"
+    assert resolve_pallas_default(None) is False
+    monkeypatch.delenv("WTPU_PALLAS", raising=False)
+    assert resolve_pallas_default(None) is False
