@@ -132,9 +132,19 @@ def test_sanfermin_example_outcome_vs_published():
     aggregates) and msgReceived=272 (retry/optimistic chatter).  The
     reference also strands nodes whose candidate set is exhausted
     (sendToNodes "is OUT", :330-340 — no retry is ever scheduled again).
-    So the assertions are: seconds-scale completion with a straggler tail,
-    tens-to-hundreds of messages with chatty hubs, near-full aggregates
-    with partial ones allowed, and at most a small stranded fraction."""
+
+    DELIBERATE divergence (r5): the reference's msgReceived=272 hub is
+    an artifact of its index-order candidate walk concentrating every
+    block's stragglers on the sibling block's first ids — the same
+    mechanism that produced 61k inbox drops at 32k nodes.  The rotated
+    pick order (models/sanfermin._pick_offset) spreads that load to a
+    near-uniform per-node count (measured 1024n seed 0: mean 29.6,
+    max 38) and, with replies no longer queueing behind hubs, completes
+    FASTER (mean done 836 ms vs the example's 4860).  So the regime
+    pinned here is: seconds-scale completion with a straggler tail,
+    tens of messages per node with a FLAT distribution (no hubs),
+    near-full aggregates with partial ones allowed, and at most a
+    small stranded fraction."""
     proto = SanFermin(node_count=1024)
     r = Runner(proto, donate=False)
     net, ps = proto.init(0)
@@ -153,5 +163,8 @@ def test_sanfermin_example_outcome_vs_published():
     msgs = np.asarray(net.nodes.msg_received)[live]
     aggs = np.asarray(ps.agg)[live]
     assert 10 <= msgs.mean() <= 400, msgs.mean()
-    assert msgs.max() >= 100, msgs.max()      # chatty hubs, like the example
+    # Flat load by design (the rotated pick order): no node receives
+    # more than a few times the mean — the hubs the reference's walk
+    # produces cannot form.
+    assert msgs.max() <= 4 * msgs.mean(), (msgs.max(), msgs.mean())
     assert aggs.mean() >= 0.85 * proto.node_count, aggs.mean()
