@@ -431,24 +431,35 @@ class GSFSignature(LevelMixin):
         filled = p.q_from >= 0                                 # [N, Q]
         rows = ids[:, None]
         elvl = p.q_lvl
-        emask = self._range_mask_dyn(rows, elvl)               # [N, Q, W]
         sig = p.q_sig
         exp = halfs[elvl]                                      # [N, Q]
-        ver_l = p.verified[:, None, :] & emask
-        ver_l_card = bitset.popcount(ver_l)
-        indiv_l = p.ver_indiv[:, None, :] & emask
+        if self.pallas_merge:
+            # Same switch as the merge kernel: one fused pass instead
+            # of ~5 HBM round-trips over the sig plane
+            # (ops/pallas_score.gsf_score_pallas, bit-equal by test).
+            from ..ops.pallas_score import gsf_score_pallas
+            (ver_l_card, card_sig, inter, pc_wi, pc_wv,
+             inter_ind) = gsf_score_pallas(
+                sig, elvl, ids, p.verified, p.ver_indiv,
+                interpret=jax.default_backend() != "tpu")
+        else:
+            emask = self._range_mask_dyn(rows, elvl)           # [N, Q, W]
+            ver_l = p.verified[:, None, :] & emask
+            ver_l_card = bitset.popcount(ver_l)
+            indiv_l = p.ver_indiv[:, None, :] & emask
+            with_indiv = indiv_l | sig
+            card_sig = bitset.popcount(sig)
+            inter = bitset.intersects(sig, ver_l)
+            pc_wi = bitset.popcount(with_indiv)
+            pc_wv = bitset.popcount(with_indiv | ver_l)
+            inter_ind = bitset.intersects(sig, indiv_l)
 
-        with_indiv = indiv_l | sig
-        card_sig = bitset.popcount(sig)
-        inter = bitset.intersects(sig, ver_l)
         new_total = jnp.where(
             ver_l_card == 0, card_sig,
-            jnp.where(inter, bitset.popcount(with_indiv),
-                      bitset.popcount(with_indiv | ver_l)))
+            jnp.where(inter, pc_wi, pc_wv))
         added = jnp.where(ver_l_card == 0, new_total,
                           new_total - ver_l_card)
-        indiv_bonus = ((card_sig == 1) &
-                       ~bitset.intersects(sig, indiv_l)).astype(jnp.int32)
+        indiv_bonus = ((card_sig == 1) & ~inter_ind).astype(jnp.int32)
         score = jnp.where(
             added <= 0, indiv_bonus,
             jnp.where(new_total == exp, 1_000_000 - elvl * 10,

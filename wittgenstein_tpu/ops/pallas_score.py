@@ -54,25 +54,7 @@ def _score_kernel(sig_ref, lvl_ref, ids_ref, inc_ref, ver_ref, agg_ref,
 
     s_inc, p_sig, p_sv, i_agg = [], [], [], []
     for q in range(q_cap):
-        lvl = lvl_ref[:, q:q + 1]                       # [blk, 1]
-        # emask: the entry's level range (sibling half of the node's
-        # 2^lvl-aligned block), empty at level 0 — the same arithmetic
-        # as _levels.sibling_base + bitset.range_mask.
-        half = jnp.where(lvl > 0,
-                         jnp.int32(1) << jnp.clip(lvl - 1, 0, 30), 0)
-        half_nz = jnp.maximum(half, 1)
-        mine = ids & ~(2 * half_nz - 1)
-        base = mine + jnp.where((ids & half_nz) != 0, 0, half_nz)
-        base = jnp.where(half > 0, base, 0)
-        lo = jnp.clip(base - wlo, 0, 32)
-        hi = jnp.clip(base + half - wlo, 0, 32)
-        full = U32(0xFFFFFFFF)
-        m_hi = jnp.where(hi >= 32, full,
-                         (U32(1) << hi.astype(U32)) - U32(1))
-        m_lo = jnp.where(lo >= 32, full,
-                         (U32(1) << lo.astype(U32)) - U32(1))
-        emask = m_hi & ~m_lo                            # [blk, W]
-
+        emask = _emask_for(ids, lvl_ref[:, q:q + 1], wlo)   # [blk, W]
         sig = sig_ref[:, q, :]                          # [blk, W]
         inc_e = inc & emask
         ver_e = ver & emask
@@ -91,6 +73,88 @@ def _score_kernel(sig_ref, lvl_ref, ids_ref, inc_ref, ver_ref, agg_ref,
     psig_ref[...] = jnp.concatenate(p_sig, axis=1)
     psv_ref[...] = jnp.concatenate(p_sv, axis=1)
     iagg_ref[...] = jnp.concatenate(i_agg, axis=1)
+
+
+def _emask_for(ids, lvl, wlo):
+    """In-register level range mask — shared by both scoring kernels
+    (the `_levels.sibling_base` + `ops.bitset.range_mask` arithmetic)."""
+    half = jnp.where(lvl > 0, jnp.int32(1) << jnp.clip(lvl - 1, 0, 30), 0)
+    half_nz = jnp.maximum(half, 1)
+    mine = ids & ~(2 * half_nz - 1)
+    base = mine + jnp.where((ids & half_nz) != 0, 0, half_nz)
+    base = jnp.where(half > 0, base, 0)
+    lo = jnp.clip(base - wlo, 0, 32)
+    hi = jnp.clip(base + half - wlo, 0, 32)
+    full = U32(0xFFFFFFFF)
+    m_hi = jnp.where(hi >= 32, full, (U32(1) << hi.astype(U32)) - U32(1))
+    m_lo = jnp.where(lo >= 32, full, (U32(1) << lo.astype(U32)) - U32(1))
+    return m_hi & ~m_lo
+
+
+def _gsf_score_kernel(sig_ref, lvl_ref, ids_ref, ver_ref, ind_ref,
+                      vlc_ref, cs_ref, iv_ref, pwi_ref, pwv_ref, ii_ref,
+                      *, q_cap, w):
+    """GSF evaluateSig summaries (GSFSignature.java:482-580): per queue
+    entry, the popcounts/intersections its score formula consumes."""
+    blk = lvl_ref.shape[0]
+    ids = ids_ref[...]
+    ver = ver_ref[...]
+    ind = ind_ref[...]
+    wlo = jax.lax.broadcasted_iota(I32, (blk, w), 1) * 32
+
+    vlc, cs, iv, pwi, pwv, ii = [], [], [], [], [], []
+    for q in range(q_cap):
+        emask = _emask_for(ids, lvl_ref[:, q:q + 1], wlo)
+        sig = sig_ref[:, q, :]
+        ver_l = ver & emask
+        indiv_l = ind & emask
+        with_indiv = indiv_l | sig
+        vlc.append(jnp.sum(_popcount_u32(ver_l), axis=1, keepdims=True))
+        cs.append(jnp.sum(_popcount_u32(sig), axis=1, keepdims=True))
+        iv.append(jnp.sum(jnp.where((sig & ver_l) != 0, 1, 0), axis=1,
+                          keepdims=True))
+        pwi.append(jnp.sum(_popcount_u32(with_indiv), axis=1,
+                           keepdims=True))
+        pwv.append(jnp.sum(_popcount_u32(with_indiv | ver_l), axis=1,
+                           keepdims=True))
+        ii.append(jnp.sum(jnp.where((sig & indiv_l) != 0, 1, 0), axis=1,
+                          keepdims=True))
+    for ref, parts in ((vlc_ref, vlc), (cs_ref, cs), (iv_ref, iv),
+                       (pwi_ref, pwi), (pwv_ref, pwv), (ii_ref, ii)):
+        ref[...] = jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gsf_score_pallas(q_sig, q_lvl, ids, verified, ver_indiv,
+                     interpret: bool = False):
+    """GSF per-entry score inputs.  Returns (ver_l_card, card_sig,
+    inter_verl (bool), pc_with_indiv, pc_with_indiv_or_verl,
+    inter_indivl (bool)), each [M, Q] — bit-identical to the XLA block
+    in `models/gsf._pick_verification`."""
+    from jax.experimental import pallas as pl
+
+    from .pallas_merge import _pick_block
+
+    m, q, w = q_sig.shape
+    blk = _pick_block(m)
+    grid = (m // blk,)
+
+    def spec(shape):
+        return pl.BlockSpec((blk,) + shape,
+                            lambda g: (g,) + (0,) * len(shape))
+
+    kernel = functools.partial(_gsf_score_kernel, q_cap=q, w=w)
+    out_shape = tuple(jax.ShapeDtypeStruct((m, q), I32) for _ in range(6))
+    vlc, cs, iv, pwi, pwv, ii = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec((q, w)), spec((q,)), spec((1,)), spec((w,)),
+                  spec((w,))],
+        out_specs=[spec((q,))] * 6,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q_sig, q_lvl, ids.reshape(m, 1), verified, ver_indiv)
+    return vlc, cs, iv != 0, pwi, pwv, ii != 0
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
