@@ -59,3 +59,37 @@ def test_score_kernel_zero_and_full_rows():
                              interpret=True)
     for r, g in zip(ref, got):
         np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_gsf_score_kernel_bit_equal():
+    """Direct randomized bit-equality of gsf_score_pallas against GSF's
+    XLA scoring block (not just the end-to-end run): levels across the
+    full range including 0 and the top, random dense bitsets."""
+    from wittgenstein_tpu.models.gsf import GSFSignature
+    from wittgenstein_tpu.ops.pallas_score import gsf_score_pallas
+
+    n, q = 256, 8
+    proto = GSFSignature(node_count=n, queue_cap=q)
+    w = proto.w
+    rng = np.random.default_rng(23)
+    sig = jnp.asarray(rng.integers(0, 2 ** 32, (n, q, w),
+                                   dtype=np.uint32))
+    elvl = jnp.asarray(rng.integers(0, proto.levels, (n, q)).astype(
+        np.int32))
+    ids = jnp.arange(n, dtype=jnp.int32)
+    ver = jnp.asarray(rng.integers(0, 2 ** 32, (n, w), dtype=np.uint32))
+    ind = jnp.asarray(rng.integers(0, 2 ** 32, (n, w), dtype=np.uint32))
+
+    emask = proto._range_mask_dyn(ids[:, None], elvl)
+    ver_l = ver[:, None, :] & emask
+    indiv_l = ind[:, None, :] & emask
+    with_indiv = indiv_l | sig
+    ref = (bitset.popcount(ver_l), bitset.popcount(sig),
+           bitset.intersects(sig, ver_l), bitset.popcount(with_indiv),
+           bitset.popcount(with_indiv | ver_l),
+           bitset.intersects(sig, indiv_l))
+    got = gsf_score_pallas(sig, elvl, ids, ver, ind, interpret=True)
+    for name, r, g in zip(("ver_l_card", "card_sig", "inter", "pc_wi",
+                           "pc_wv", "inter_ind"), ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=name)

@@ -124,6 +124,37 @@ def _gsf_score_kernel(sig_ref, lvl_ref, ids_ref, ver_ref, ind_ref,
         ref[...] = jnp.concatenate(parts, axis=1)
 
 
+def _launch_scoring(kernel_fn, n_bitsets, n_outputs, q_sig, q_lvl, ids,
+                    *bitsets, interpret):
+    """Shared pallas_call scaffolding for the per-entry scoring kernels:
+    node-block grid over [M, ...] operands (q_sig [M, Q, W], q_lvl
+    [M, Q], ids [M, 1], then `n_bitsets` [M, W] rows), `n_outputs`
+    [M, Q] i32 outputs."""
+    from jax.experimental import pallas as pl
+
+    from .pallas_merge import _pick_block
+
+    m, q, w = q_sig.shape
+    assert len(bitsets) == n_bitsets
+    blk = _pick_block(m)
+
+    def spec(shape):
+        return pl.BlockSpec((blk,) + shape,
+                            lambda g: (g,) + (0,) * len(shape))
+
+    kernel = functools.partial(kernel_fn, q_cap=q, w=w)
+    return pl.pallas_call(
+        kernel,
+        grid=(m // blk,),
+        in_specs=[spec((q, w)), spec((q,)), spec((1,))] +
+                 [spec((w,))] * n_bitsets,
+        out_specs=[spec((q,))] * n_outputs,
+        out_shape=tuple(jax.ShapeDtypeStruct((m, q), I32)
+                        for _ in range(n_outputs)),
+        interpret=interpret,
+    )(q_sig, q_lvl, ids.reshape(m, 1), *bitsets)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def gsf_score_pallas(q_sig, q_lvl, ids, verified, ver_indiv,
                      interpret: bool = False):
@@ -131,29 +162,9 @@ def gsf_score_pallas(q_sig, q_lvl, ids, verified, ver_indiv,
     inter_verl (bool), pc_with_indiv, pc_with_indiv_or_verl,
     inter_indivl (bool)), each [M, Q] — bit-identical to the XLA block
     in `models/gsf._pick_verification`."""
-    from jax.experimental import pallas as pl
-
-    from .pallas_merge import _pick_block
-
-    m, q, w = q_sig.shape
-    blk = _pick_block(m)
-    grid = (m // blk,)
-
-    def spec(shape):
-        return pl.BlockSpec((blk,) + shape,
-                            lambda g: (g,) + (0,) * len(shape))
-
-    kernel = functools.partial(_gsf_score_kernel, q_cap=q, w=w)
-    out_shape = tuple(jax.ShapeDtypeStruct((m, q), I32) for _ in range(6))
-    vlc, cs, iv, pwi, pwv, ii = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[spec((q, w)), spec((q,)), spec((1,)), spec((w,)),
-                  spec((w,))],
-        out_specs=[spec((q,))] * 6,
-        out_shape=out_shape,
-        interpret=interpret,
-    )(q_sig, q_lvl, ids.reshape(m, 1), verified, ver_indiv)
+    vlc, cs, iv, pwi, pwv, ii = _launch_scoring(
+        _gsf_score_kernel, 2, 6, q_sig, q_lvl, ids, verified, ver_indiv,
+        interpret=interpret)
     return vlc, cs, iv != 0, pwi, pwv, ii != 0
 
 
@@ -165,26 +176,7 @@ def score_queue_pallas(q_sig, q_lvl, ids, total_inc, ver_ind, last_agg,
     (s_inc, pc_sig, pc_sig_ver [M, Q] i32, inter_agg [M, Q] bool) —
     bit-identical to the `_pick_verification` per-piece XLA block.
     """
-    from jax.experimental import pallas as pl
-
-    from .pallas_merge import _pick_block
-
-    m, q, w = q_sig.shape
-    blk = _pick_block(m)
-    grid = (m // blk,)
-
-    def spec(shape):
-        return pl.BlockSpec((blk,) + shape, lambda g: (g,) + (0,) * len(shape))
-
-    kernel = functools.partial(_score_kernel, q_cap=q, w=w)
-    out_shape = tuple(jax.ShapeDtypeStruct((m, q), I32) for _ in range(4))
-    s_inc, pc_sig, pc_sv, i_agg = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[spec((q, w)), spec((q,)), spec((1,)), spec((w,)),
-                  spec((w,)), spec((w,))],
-        out_specs=[spec((q,))] * 4,
-        out_shape=out_shape,
-        interpret=interpret,
-    )(q_sig, q_lvl, ids.reshape(m, 1), total_inc, ver_ind, last_agg)
+    s_inc, pc_sig, pc_sv, i_agg = _launch_scoring(
+        _score_kernel, 3, 4, q_sig, q_lvl, ids, total_inc, ver_ind,
+        last_agg, interpret=interpret)
     return s_inc, pc_sig, pc_sv, i_agg != 0
