@@ -124,18 +124,17 @@ def _gsf_score_kernel(sig_ref, lvl_ref, ids_ref, ver_ref, ind_ref,
         ref[...] = jnp.concatenate(parts, axis=1)
 
 
-def _launch_scoring(kernel_fn, n_bitsets, n_outputs, q_sig, q_lvl, ids,
+def _launch_scoring(kernel_fn, n_outputs, q_sig, q_lvl, ids,
                     *bitsets, interpret):
     """Shared pallas_call scaffolding for the per-entry scoring kernels:
     node-block grid over [M, ...] operands (q_sig [M, Q, W], q_lvl
-    [M, Q], ids [M, 1], then `n_bitsets` [M, W] rows), `n_outputs`
+    [M, Q], ids [M, 1], then the [M, W] bitset rows), `n_outputs`
     [M, Q] i32 outputs."""
     from jax.experimental import pallas as pl
 
     from .pallas_merge import _pick_block
 
     m, q, w = q_sig.shape
-    assert len(bitsets) == n_bitsets
     blk = _pick_block(m)
 
     def spec(shape):
@@ -147,7 +146,7 @@ def _launch_scoring(kernel_fn, n_bitsets, n_outputs, q_sig, q_lvl, ids,
         kernel,
         grid=(m // blk,),
         in_specs=[spec((q, w)), spec((q,)), spec((1,))] +
-                 [spec((w,))] * n_bitsets,
+                 [spec((w,))] * len(bitsets),
         out_specs=[spec((q,))] * n_outputs,
         out_shape=tuple(jax.ShapeDtypeStruct((m, q), I32)
                         for _ in range(n_outputs)),
@@ -163,7 +162,7 @@ def gsf_score_pallas(q_sig, q_lvl, ids, verified, ver_indiv,
     inter_indivl (bool)), each [M, Q] — bit-identical to the XLA block
     in `models/gsf._pick_verification`."""
     vlc, cs, iv, pwi, pwv, ii = _launch_scoring(
-        _gsf_score_kernel, 2, 6, q_sig, q_lvl, ids, verified, ver_indiv,
+        _gsf_score_kernel, 6, q_sig, q_lvl, ids, verified, ver_indiv,
         interpret=interpret)
     return vlc, cs, iv != 0, pwi, pwv, ii != 0
 
@@ -177,6 +176,6 @@ def score_queue_pallas(q_sig, q_lvl, ids, total_inc, ver_ind, last_agg,
     bit-identical to the `_pick_verification` per-piece XLA block.
     """
     s_inc, pc_sig, pc_sv, i_agg = _launch_scoring(
-        _score_kernel, 3, 4, q_sig, q_lvl, ids, total_inc, ver_ind,
+        _score_kernel, 4, q_sig, q_lvl, ids, total_inc, ver_ind,
         last_agg, interpret=interpret)
     return s_inc, pc_sig, pc_sv, i_agg != 0
