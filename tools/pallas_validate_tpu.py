@@ -1,0 +1,134 @@
+"""On-chip bit-equality validation of the four fused Pallas kernels
+(real Mosaic lowering — the pytest suite forces the CPU backend, where
+only the interpreter runs, so this is the script that turns
+"bit-equal in interpret mode" into "bit-equal on the chip").
+
+Runs each kernel on randomized small-but-representative shapes against
+its XLA reference and prints one OK/FAIL line per kernel.  Run BEFORE
+flipping the WTPU_PALLAS default or trusting a kernel A/B number.
+
+Usage: python tools/pallas_validate_tpu.py
+"""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from wittgenstein_tpu.utils.platform import probe_backend  # noqa: E402
+
+if not probe_backend(timeout_s=300):
+    print("PALLAS_VALIDATE_SKIP backend down", flush=True)
+    sys.exit(1)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+INTERP = jax.default_backend() == "cpu"   # self-test mode off-chip
+
+
+def check(name, ref, got):
+    try:
+        for i, (r, g) in enumerate(zip(ref, got)):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                          err_msg=f"{name}[{i}]")
+        print(f"PALLAS_VALIDATE_OK {name}", flush=True)
+        return True
+    except Exception as e:                  # noqa: BLE001 — report, continue
+        print(f"PALLAS_VALIDATE_FAIL {name}: {type(e).__name__}: "
+              f"{e!s:.300}", flush=True)
+        return False
+
+
+def main():
+    rng = np.random.default_rng(42)
+    ok = True
+
+    # 1. Handel delivery merge vs merge_bounded_queue.
+    from wittgenstein_tpu.models._levels import merge_bounded_queue
+    from wittgenstein_tpu.ops.pallas_merge import merge_queue_pallas
+    n, q, s, w = 256, 16, 12, 64
+    q_from = jnp.asarray(np.where(rng.random((n, q)) < 0.7,
+                                  rng.integers(0, 2048, (n, q)),
+                                  -1).astype(np.int32))
+    q_lvl = jnp.asarray(rng.integers(0, 11, (n, q)).astype(np.int32))
+    q_rank = jnp.asarray(rng.integers(0, 4096, (n, q)).astype(np.int32))
+    q_bad = jnp.asarray(rng.random((n, q)) < 0.2)
+    q_sig = jnp.asarray(rng.integers(0, 2 ** 32, (n, q, w),
+                                     dtype=np.uint32))
+    src = jnp.asarray(rng.integers(0, 2048, (n, s)).astype(np.int32))
+    level = jnp.asarray(rng.integers(0, 11, (n, s)).astype(np.int32))
+    rank_all = jnp.asarray(rng.integers(0, 4096, (n, s)).astype(np.int32))
+    okm = jnp.asarray(rng.random((n, s)) < 0.6)
+    sig_all = jnp.asarray(rng.integers(0, 2 ** 32, (n, s, w),
+                                       dtype=np.uint32))
+    sel2, sel3, ev = merge_bounded_queue(
+        q_from, q_lvl, q_rank, src, level, rank_all, okm, q,
+        {"bad": (q_bad, jnp.zeros_like(okm))}, {"sig": (q_sig, sig_all)})
+    ref = (sel2["from"], sel2["lvl"], sel2["rank"], sel2["bad"],
+           sel3["sig"], ev)
+    got = merge_queue_pallas(q_from, q_lvl, q_rank, q_bad, q_sig, src,
+                             level, rank_all, okm, sig_all, q_cap=q,
+                             interpret=INTERP)
+    ok &= check("handel_merge", ref, got)
+
+    # 2. Handel verification scoring.
+    from wittgenstein_tpu.models.handel import Handel
+    from wittgenstein_tpu.ops import bitset
+    from wittgenstein_tpu.ops.pallas_score import score_queue_pallas
+    proto = Handel(node_count=2048, threshold=2000, queue_cap=q,
+                   pallas_merge=False)
+    n2, w2 = 2048, proto.w
+    sig2 = jnp.asarray(rng.integers(0, 2 ** 32, (n2, q, w2),
+                                    dtype=np.uint32))
+    elvl = jnp.asarray(rng.integers(0, proto.levels, (n2, q)).astype(
+        np.int32))
+    ids2 = jnp.arange(n2, dtype=jnp.int32)
+    ti, vi, la = (jnp.asarray(rng.integers(0, 2 ** 32, (n2, w2),
+                                           dtype=np.uint32))
+                  for _ in range(3))
+    emask = proto._range_mask_dyn(ids2[:, None], elvl)
+    inc_e, ver_e, agg_e = (ti[:, None, :] & emask, vi[:, None, :] & emask,
+                           la[:, None, :] & emask)
+    disj = ~bitset.intersects(sig2, inc_e)
+    merged = jnp.where(disj[..., None], sig2 | inc_e, sig2)
+    ref = (bitset.popcount(merged | ver_e), bitset.popcount(sig2),
+           bitset.popcount(sig2 | ver_e), bitset.intersects(sig2, agg_e))
+    got = score_queue_pallas(sig2, elvl, ids2, ti, vi, la,
+                             interpret=INTERP)
+    ok &= check("handel_score", ref, got)
+
+    # 3. GSF scoring.
+    from wittgenstein_tpu.ops.pallas_score import gsf_score_pallas
+    ver_l = vi[:, None, :] & emask
+    indiv_l = la[:, None, :] & emask
+    with_indiv = indiv_l | sig2
+    ref = (bitset.popcount(ver_l), bitset.popcount(sig2),
+           bitset.intersects(sig2, ver_l), bitset.popcount(with_indiv),
+           bitset.popcount(with_indiv | ver_l),
+           bitset.intersects(sig2, indiv_l))
+    got = gsf_score_pallas(sig2, elvl, ids2, vi, la, interpret=INTERP)
+    ok &= check("gsf_score", ref, got)
+
+    # 4. GSF three-tier merge — end-to-end window (its XLA reference
+    # needs the full receive context, so compare two short GSF runs).
+    from wittgenstein_tpu.core.network import Runner
+    from wittgenstein_tpu.models.gsf import GSFSignature
+    outs = []
+    for pallas in (False, True):
+        p = GSFSignature(node_count=128, threshold=115, nodes_down=12,
+                         queue_cap=4, inbox_cap=8, pallas_merge=pallas)
+        net, ps = p.init(7)
+        net, ps = Runner(p, donate=False).run_ms(net, ps, 300)
+        outs.append(jax.tree.leaves((net, ps)))
+    ok &= check("gsf_merge_e2e", outs[0], outs[1])
+
+    print("PALLAS_VALIDATE_ALL_OK" if ok else "PALLAS_VALIDATE_HAD_FAIL",
+          flush=True)
+    sys.exit(0 if ok else 2)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,9 +23,14 @@ timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_official.log"
 #    The probe first: it exercises the kernels' exact construct mix
 #    through real Mosaic, so a toolchain incompatibility fails in
 #    seconds with a named construct instead of burning the bench hour.
-echo "--- [2/7] pallas probe + A/B $(stamp)"
+echo "--- [2/7] pallas probe + validation + A/B $(stamp)"
 timeout 1200 python tools/pallas_probe.py 2>&1 \
   | tee "$R/pallas_probe_r5.log"
+# Full-kernel bit-equality with REAL Mosaic lowering (the suite's CPU
+# runs only prove the interpreter); must print PALLAS_VALIDATE_ALL_OK
+# before any WTPU_PALLAS=1 number is trusted.
+timeout 2400 python tools/pallas_validate_tpu.py 2>&1 \
+  | tee "$R/pallas_validate_r5.log"
 WTPU_PALLAS=1 timeout 3600 python bench.py 2>&1 | tee "$R/bench_r5_pallas.log"
 
 # 3. Seed scaling on the batched engine (the folded scatter removed the
