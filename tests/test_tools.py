@@ -53,6 +53,21 @@ def test_node_drawer_gif(tmp_path):
     assert os.path.getsize(path) > 1000
 
 
+def test_node_drawer_world_map_background():
+    """NodeDrawer.java:20-24 parity: the bundled world-map-2000px.png is
+    the frame background (vendored asset, attributed like citydata.npz)."""
+    from wittgenstein_tpu.tools.node_drawer import _MAP_PATH, _background
+
+    assert os.path.exists(_MAP_PATH)
+    img = _background()
+    from wittgenstein_tpu.core.state import MAX_X, MAX_Y
+    assert img.size == (MAX_X, MAX_Y)
+    # A real map is not the flat synthesized graticule (exactly 2
+    # colors): the anti-aliased landmass has a broader palette.
+    arr = np.asarray(img)
+    assert len(np.unique(arr.reshape(-1, 3), axis=0)) > 8
+
+
 def test_city_population_weighting():
     """CityPopulationTest parity (core CityPopulationTest.java): the
     'cities' builder samples cities proportionally to population via the

@@ -2,20 +2,36 @@
 
 Draws every node at its map position colored red -> green by a value in
 [vmin, vmax] (NodeDrawer.java:215-240); frames accumulate into an animated
-GIF (GifSequenceWriter parity).  The reference blits its bundled
-world-map-2000px.png; we synthesize a graticule background so the package
-stays self-contained.
+GIF (GifSequenceWriter parity).  The background is the same bundled
+world-map-2000px.png the reference blits (NodeDrawer.java:20-24) —
+vendored map DATA (provenance: data/README.md, alongside citydata.npz) —
+with a synthesized graticule as fallback if the asset is ever absent.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
 from ..core.state import MAX_X, MAX_Y
 
+_MAP_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                         "data", "world-map-2000px.png")
+_MAP_CACHE = None
+
 
 def _background():
     from PIL import Image, ImageDraw
+    global _MAP_CACHE
+    if _MAP_CACHE is not None:
+        return _MAP_CACHE.copy()    # ImageDraw mutates the frame
+    if os.path.exists(_MAP_PATH):
+        img = Image.open(_MAP_PATH).convert("RGB")
+        if img.size != (MAX_X, MAX_Y):
+            img = img.resize((MAX_X, MAX_Y))
+        _MAP_CACHE = img
+        return img.copy()
     img = Image.new("RGB", (MAX_X, MAX_Y), (12, 18, 32))
     d = ImageDraw.Draw(img)
     for x in range(0, MAX_X, 125):
