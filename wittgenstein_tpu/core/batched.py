@@ -157,6 +157,21 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
     inbox1, nodes = _batched_inbox(cfg, net, t + 1)
     net = net.replace(nodes=nodes)
 
+    # Order every later plane WRITE after both inbox READS by threading
+    # the planes through one optimization_barrier with the inbox values.
+    # Without this, XLA's copy-insertion cannot prove the scatters run
+    # after the slices whenever a phase-hinted step's outbox is
+    # structurally independent of its inbox, and it inserts a FULL COPY
+    # of every ring plane per superstep — measured 40 -> 2 plane copies
+    # in the compiled while body (tools/carry_audit.py), the "scan carry
+    # DUS churn" item of reports/PROFILE_r4.md.  The barrier is pure
+    # ordering: no data is copied and results are bit-identical
+    # (tests/test_batched.py).
+    (inbox0, inbox1, bd, bs, bz, bc) = jax.lax.optimization_barrier(
+        (inbox0, inbox1, net.box_data, net.box_src, net.box_size,
+         net.box_count))
+    net = net.replace(box_data=bd, box_src=bs, box_size=bz, box_count=bc)
+
     def pstep(ps, nodes_r, inbox_r, seed, tt, hints):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), tt)
         if hints is None:

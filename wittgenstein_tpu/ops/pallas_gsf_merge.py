@@ -152,7 +152,7 @@ def gsf_merge_pallas(q_from, q_lvl, q_indiv, ex_keep, q_sig,
     """
     from jax.experimental import pallas as pl
 
-    from .pallas_merge import _pick_block
+    from .pallas_merge import _pad_lanes, _pick_block
 
     m, q = q_from.shape
     s = src.shape[1]
@@ -162,7 +162,10 @@ def gsf_merge_pallas(q_from, q_lvl, q_indiv, ex_keep, q_sig,
     if c_tot > 255:
         raise ValueError(f"gsf_merge_pallas supports q + 2s <= 255 "
                          f"(got {q} + 2*{s})")
-    blk = _pick_block(m)
+    # Per-row VMEM: q_cap unrolled selection rounds over c_tot candidate
+    # columns with [blk, W]-lane sig temporaries (same model as
+    # merge_queue_pallas, validated there on chip).
+    blk = _pick_block(m, q * c_tot * _pad_lanes(w) * 4)
     grid = (m // blk,)
 
     def spec(shape):

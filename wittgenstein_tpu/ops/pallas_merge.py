@@ -145,12 +145,32 @@ def resolve_pallas_default(explicit):
             and jax.default_backend() == "tpu")
 
 
-def _pick_block(m):
-    """Largest power-of-two block <= 256 dividing the row count."""
-    for blk in (256, 128, 64, 32, 16, 8, 4, 2):
-        if m % blk == 0:
-            return blk
-    return 1
+_VMEM_BUDGET = 6 << 20      # bytes of the ~16 MB scoped-vmem limit we use
+
+
+def _pad_lanes(w):
+    """Mosaic pads the minor (lane) axis to 128."""
+    return -(-w // 128) * 128
+
+
+def _pick_block(m, row_bytes=0):
+    """Largest power-of-two block <= 256 dividing the row count whose
+    VMEM footprint stays within budget.
+
+    `row_bytes` is the launcher's per-row VMEM estimate for its kernel's
+    live intermediates.  The estimate matters: the first on-chip compile
+    of the merge kernel at blk=256 requested a 56.26 MB scoped-vmem
+    stack against the 16 MB limit (reports/pallas_validate_r5.log) —
+    219.8 KB/row, matching the rounds x candidate-columns x padded-lane
+    model the launchers pass — so an unbudgeted block is a compile
+    error, not a perf tradeoff.  The interpreter never models VMEM,
+    which is why only the on-chip validate can see this."""
+    blk = 256
+    while row_bytes and blk > 1 and blk * row_bytes > _VMEM_BUDGET:
+        blk //= 2
+    while blk > 1 and m % blk:
+        blk //= 2
+    return blk
 
 
 @functools.partial(jax.jit, static_argnames=("q_cap", "interpret"))
@@ -184,7 +204,11 @@ def merge_queue_pallas(q_from, q_lvl, q_rank, q_bad, q_sig,
         raise ValueError(
             f"merge_queue_pallas supports q_cap + s_cap <= 255 "
             f"(got {q} + {s}); use the XLA merge for wider rows")
-    blk = _pick_block(m)
+    # Per-row VMEM model: the q_cap unrolled selection rounds keep
+    # [blk, C]-wide and [blk, W]-lane temporaries live simultaneously —
+    # rounds x columns x padded lanes x 4 B (validated against the
+    # observed 219.8 KB/row at q16/s12/w64, see _pick_block).
+    blk = _pick_block(m, q * (q + s) * _pad_lanes(w) * 4)
     grid = (m // blk,)
 
     def col(k):

@@ -132,10 +132,12 @@ def _launch_scoring(kernel_fn, n_outputs, q_sig, q_lvl, ids,
     [M, Q] i32 outputs."""
     from jax.experimental import pallas as pl
 
-    from .pallas_merge import _pick_block
+    from .pallas_merge import _pad_lanes, _pick_block
 
     m, q, w = q_sig.shape
-    blk = _pick_block(m)
+    # Per-row VMEM: q unrolled rounds x ~12 live [blk, W]-lane
+    # temporaries (masks, masked views, popcount intermediates) x 4 B.
+    blk = _pick_block(m, q * 12 * _pad_lanes(w) * 4)
 
     def spec(shape):
         return pl.BlockSpec((blk,) + shape,
