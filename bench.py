@@ -114,7 +114,11 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
         # bit-identical (tests/test_batched.py).
         from wittgenstein_tpu.core.batched import scan_chunk_batched
-        base = scan_chunk_batched(proto, chunk, t0_mod=t0)
+        base = scan_chunk_batched(
+            proto, chunk, t0_mod=t0,
+            # Same-process A/B knob for the plane-ordering barrier
+            # (bit-identical either way; tools/ab_plane_barrier.py).
+            plane_barrier=os.environ.get("WTPU_PLANE_BARRIER", "1") != "0")
         step = jax.jit(base)
     else:
         base = jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,

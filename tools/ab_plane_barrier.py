@@ -1,0 +1,81 @@
+"""Same-process A/B of the batched engine's plane-ordering barrier.
+
+BENCH_NOTES.md round-4 rule: chip/tunnel throughput varies wildly
+BETWEEN processes (identical programs measured 0-86 ms/sim-ms minutes
+apart), so every perf comparison must interleave both variants within
+one process.  This tool builds the headline Handel config twice —
+barrier on (scatters update the mailbox planes in place) and barrier
+off (XLA copy-insertion copies every plane per superstep,
+tools/carry_audit.py) — and alternates timed reps A/B/A/B....
+
+Results are bit-identical between variants (asserted on the first rep
+pair: same final time/done_at checksums).
+
+Usage: python tools/ab_plane_barrier.py [n] [seeds] [sim_ms] [reps]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    seeds = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    sim_ms = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
+    reps = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+    chunk = 200
+
+    import bench
+
+    def build(barrier: bool):
+        os.environ["WTPU_PLANE_BARRIER"] = "1" if barrier else "0"
+        return bench._handel_setup(n, seeds, sim_ms, chunk, "exact",
+                                   256, 12, superstep=2)
+
+    step_on, init, steps, check = build(True)
+    step_off, _, _, _ = build(False)
+    os.environ.pop("WTPU_PLANE_BARRIER", None)
+
+    def one_rep(step):
+        nets, ps = init()
+        np.asarray(nets.time)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            nets, ps = step(nets, ps)
+        check(nets, ps)                      # materialize inside window
+        wall = time.perf_counter() - t0
+        return wall, nets, ps
+
+    # Warm both executables, and prove bit-equality of the variants.
+    w_on, nets_a, ps_a = one_rep(step_on)
+    w_off, nets_b, ps_b = one_rep(step_off)
+    assert np.array_equal(np.asarray(nets_a.time), np.asarray(nets_b.time))
+    assert np.array_equal(np.asarray(nets_a.nodes.done_at),
+                          np.asarray(nets_b.nodes.done_at)), \
+        "barrier changed results — it must be ordering-only"
+    print(f"bit-equality: OK (warm walls on={w_on:.1f}s off={w_off:.1f}s)")
+
+    walls_on, walls_off = [], []
+    for i in range(reps):
+        walls_on.append(one_rep(step_on)[0])
+        walls_off.append(one_rep(step_off)[0])
+        print(f"rep {i}: barrier_on {walls_on[-1]:.2f}s  "
+              f"barrier_off {walls_off[-1]:.2f}s", flush=True)
+
+    total = seeds * sim_ms
+    r_on = total / float(np.median(walls_on))
+    r_off = total / float(np.median(walls_off))
+    print(f"AB_RESULT n={n} seeds={seeds} sim_ms={sim_ms} "
+          f"barrier_on={r_on:.1f} barrier_off={r_off:.1f} "
+          f"speedup={r_on / r_off:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

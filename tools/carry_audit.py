@@ -42,7 +42,11 @@ def build(n=256, seeds=2, chunk=40):
                    horizon=64, inbox_cap=12)
     lcm = getattr(proto, "schedule_lcm", None)
     t0 = 0 if (lcm and chunk % lcm == 0) else None
-    base = scan_chunk_batched(proto, chunk, t0_mod=t0)
+    # Same knob bench.py honors: WTPU_PLANE_BARRIER=0 audits the
+    # pre-fix build (reproduces the 40-copies-per-body baseline).
+    base = scan_chunk_batched(
+        proto, chunk, t0_mod=t0,
+        plane_barrier=os.environ.get("WTPU_PLANE_BARRIER", "1") != "0")
 
     def init(seed0=0):
         return jax.vmap(proto.init)(

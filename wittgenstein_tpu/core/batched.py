@@ -143,10 +143,15 @@ def _batched_inbox(cfg: EngineConfig, net: NetState, t):
     return Inbox(data=uc_data, src=uc_src, valid=uc_valid), nodes
 
 
-def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
+def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
+                     plane_barrier=True):
     """Batched twin of network.step_2ms (seed-folded mailbox machinery;
     vmapped protocol steps and routing).  Preconditions: spill_cap == 0,
-    bcast_slots == 0, per-seed times all equal and even."""
+    bcast_slots == 0, per-seed times all equal and even.
+
+    `plane_barrier=False` disables the read-write ordering barrier (the
+    same-process A/B knob — results are bit-identical either way; the
+    barrier only changes whether XLA can update the planes in place)."""
     cfg, model = protocol.cfg, protocol.latency
     assert cfg.spill_cap == 0 and cfg.bcast_slots == 0
     r = net.box_count.shape[0]
@@ -167,10 +172,12 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
     # DUS churn" item of reports/PROFILE_r4.md.  The barrier is pure
     # ordering: no data is copied and results are bit-identical
     # (tests/test_batched.py).
-    (inbox0, inbox1, bd, bs, bz, bc) = jax.lax.optimization_barrier(
-        (inbox0, inbox1, net.box_data, net.box_src, net.box_size,
-         net.box_count))
-    net = net.replace(box_data=bd, box_src=bs, box_size=bz, box_count=bc)
+    if plane_barrier:
+        (inbox0, inbox1, bd, bs, bz, bc) = jax.lax.optimization_barrier(
+            (inbox0, inbox1, net.box_data, net.box_src, net.box_size,
+             net.box_count))
+        net = net.replace(box_data=bd, box_src=bs, box_size=bz,
+                          box_count=bc)
 
     def pstep(ps, nodes_r, inbox_r, seed, tt, hints):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), tt)
@@ -215,11 +222,11 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None)):
     return net, pstate
 
 
-def scan_chunk_batched(protocol, ms: int, t0_mod=None):
+def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True):
     """Batched twin of scan_chunk(superstep=2) for vmap-batched state
     (leaves [R, ...]).  Same phase-specialization contract; chunk must
     be even and a multiple of the (even-adjusted) schedule lcm when
-    t0_mod is given."""
+    t0_mod is given.  `plane_barrier` — see `step_2ms_batched`."""
     if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
             or not superstep_ok(protocol)):
         raise ValueError("scan_chunk_batched needs an even chunk and a "
@@ -246,7 +253,8 @@ def scan_chunk_batched(protocol, ms: int, t0_mod=None):
                 net, ps = carry
                 for i in range(0, len(hints), 2):
                     net, ps = step_2ms_batched(
-                        protocol, net, ps, hints2=(hints[i], hints[i + 1]))
+                        protocol, net, ps, hints2=(hints[i], hints[i + 1]),
+                        plane_barrier=plane_barrier)
                 return (net, ps), ()
             (net, pstate), _ = jax.lax.scan(body, (net, pstate),
                                             length=blocks)
@@ -256,7 +264,8 @@ def scan_chunk_batched(protocol, ms: int, t0_mod=None):
 
     def run(net, pstate):
         def body(carry, _):
-            return step_2ms_batched(protocol, *carry), ()
+            return step_2ms_batched(protocol, *carry,
+                                    plane_barrier=plane_barrier), ()
         (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms // 2)
         return net2, p2
 
