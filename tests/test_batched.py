@@ -23,10 +23,11 @@ def _trees_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
-def _run_both(proto, ms, seeds=3, t0_mod=None):
+def _run_both(proto, ms, seeds=3, t0_mod=None, plane_barrier=True):
     ref = jax.jit(jax.vmap(scan_chunk(proto, ms, t0_mod=t0_mod,
                                       superstep=2)))
-    bat = jax.jit(scan_chunk_batched(proto, ms, t0_mod=t0_mod))
+    bat = jax.jit(scan_chunk_batched(proto, ms, t0_mod=t0_mod,
+                                     plane_barrier=plane_barrier))
     sd = jnp.arange(seeds, dtype=jnp.int32)
     nets, ps = jax.vmap(proto.init)(sd)
     out_ref = ref(nets, ps)
@@ -70,6 +71,23 @@ def test_batched_box_split():
                    fast_path=10)
     proto.cfg = dataclasses.replace(proto.cfg, box_split=2)
     a, b = _run_both(proto, 80)
+    _trees_equal(a, b)
+
+
+@pytest.mark.parametrize("plane_barrier", [True, False])
+def test_plane_barrier_bit_identity(plane_barrier):
+    """The plane-ordering barrier in step_2ms_batched is ordering-only:
+    results are bit-identical to the vmapped per-seed reference with the
+    barrier on OR off (the barrier only changes whether XLA can update
+    the ring planes in place).  This is the CPU evidence the
+    core/batched.py docstring cites — plane_barrier=False was previously
+    only exercised inside the TPU-only tools/ab_plane_barrier.py
+    (ADVICE.md r5 item 1)."""
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+    # ms=8: a few step_2ms_batched iterations
+    a, b = _run_both(proto, 8, plane_barrier=plane_barrier)
     _trees_equal(a, b)
 
 

@@ -32,6 +32,13 @@ def main():
     reps = int(sys.argv[4]) if len(sys.argv) > 4 else 3
     chunk = 200
 
+    # The A/B only measures the barrier when _handel_setup takes the
+    # BATCHED path: an ambient WTPU_BENCH_BATCHED=0 would silently
+    # compile the vmapped engine twice (which ignores plane_barrier)
+    # and report a meaningless A/B of two identical programs
+    # (ADVICE.md r5 item 3).  Force the batched path for both builds.
+    os.environ["WTPU_BENCH_BATCHED"] = "1"
+
     import bench
 
     def build(barrier: bool):
@@ -42,6 +49,29 @@ def main():
     step_on, init, steps, check = build(True)
     step_off, _, _, _ = build(False)
     os.environ.pop("WTPU_PLANE_BARRIER", None)
+
+    # Prove the knob reached the compiler: the on/off builds must be
+    # DISTINCT executables (the barrier is an ordering op in the
+    # program; identical HLO means the A/B collapsed into A/A).  The
+    # AOT-compiled executables then REPLACE the jit wrappers for the
+    # timed reps — one compile per variant total, not two.  Under
+    # WTPU_BENCH_DONATE=big the steps are split-donation closures with
+    # no .lower; the identity check is skipped (the A/B itself still
+    # runs as before).
+    if hasattr(step_on, "lower"):
+        args0 = init()
+        step_on = step_on.lower(*args0).compile()
+        step_off = step_off.lower(*args0).compile()
+        hlo_on = step_on.as_text()
+        hlo_off = step_off.as_text()
+        assert hlo_on != hlo_off, \
+            "barrier on/off compiled to IDENTICAL executables — the A/B " \
+            "is not exercising the plane barrier (batched path not taken?)"
+        print("distinct executables: OK "
+              f"(on {len(hlo_on)} B, off {len(hlo_off)} B of HLO text)")
+    else:
+        print("distinct-executables check skipped (donate='big' wraps "
+              "the step; rely on the bit-equality + timing asserts)")
 
     def one_rep(step):
         nets, ps = init()

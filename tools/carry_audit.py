@@ -1,175 +1,65 @@
-"""HLO-level audit of the scan-carry writeback churn.
+"""HLO-level audit of the scan-carry writeback churn — thin CLI shim.
 
-The round-4 post-fix profile attributed ~22% of device time to
-dynamic-update-slice churn "around the scan carry" and asked for an
-HLO-level look at WHICH carry leaves bounce (BENCH_NOTES.md).  This tool
-answers that: it compiles the exact bench build (scan_chunk_batched on
-Handel) at a small config, walks the optimized HLO, and reports
+The generic machinery moved to `wittgenstein_tpu.analysis` (round 6):
+the carry-copy rule there compiles ANY registered protocol's superstep
+and budgets its while-body copies/DUS per protocol
+(`python -m wittgenstein_tpu.analysis --rule carry_copy`).  This entry
+point keeps the historical interface — the detailed per-op listing for
+the exact bench build (batched Handel, WTPU_PLANE_BARRIER honored) that
+found the round-5 40-copies regression:
 
-  * every `copy` / `dynamic-update-slice` inside the scan's while body,
-    sized in bytes, attributed to its source line when available;
-  * which while-loop carry tuple elements are NOT updated in place
-    (the copies XLA's copy-insertion pass adds when it cannot prove
-    aliasing) — the "bouncing" leaves, matched back to NetState /
-    HandelState field names by shape.
+  python tools/carry_audit.py [n] [seeds] [chunk_ms]
 
 Run anywhere (CPU HLO shows the same copy-insertion decisions; run
-on-chip for the Mosaic view):
-  python tools/carry_audit.py [n] [seeds] [chunk_ms]
+on-chip for the Mosaic view).
 """
 
 from __future__ import annotations
 
-import collections
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def build(n=256, seeds=2, chunk=40):
-    import jax
-    import jax.numpy as jnp
-
-    from wittgenstein_tpu.core.batched import scan_chunk_batched
-    from wittgenstein_tpu.models.handel import Handel
-
-    down = n // 10
-    proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
-                   nodes_down=down, pairing_time=4, level_wait_time=50,
-                   dissemination_period_ms=20, fast_path=10,
-                   horizon=64, inbox_cap=12)
-    lcm = getattr(proto, "schedule_lcm", None)
-    t0 = 0 if (lcm and chunk % lcm == 0) else None
-    # Same knob bench.py honors: WTPU_PLANE_BARRIER=0 audits the
-    # pre-fix build (reproduces the 40-copies-per-body baseline).
-    base = scan_chunk_batched(
-        proto, chunk, t0_mod=t0,
-        plane_barrier=os.environ.get("WTPU_PLANE_BARRIER", "1") != "0")
-
-    def init(seed0=0):
-        return jax.vmap(proto.init)(
-            seed0 + jnp.arange(seeds, dtype=jnp.int32))
-
-    args = init(0)
-    lowered = jax.jit(base).lower(*args)
-    compiled = lowered.compile()
-    return proto, args, compiled
-
-
-_BYTES = {"f32": 4, "s32": 4, "u32": 4, "s8": 1, "u8": 1, "pred": 1,
-          "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s64": 8, "u64": 8}
-
-
-def shape_bytes(shape: str) -> int:
-    m = re.match(r"(\w+)\[([\d,]*)\]", shape)
-    if not m:
-        return 0
-    dt, dims = m.groups()
-    total = _BYTES.get(dt, 4)
-    for d in dims.split(","):
-        if d:
-            total *= int(d)
-    return total
-
-
-def leaf_names(proto, args):
-    """shape-string -> candidate state field names, for attribution."""
-    import jax
-    names = collections.defaultdict(set)
-
-    def walk(prefix, obj):
-        import dataclasses
-        if dataclasses.is_dataclass(obj):
-            for f in dataclasses.fields(obj):
-                walk(f"{prefix}.{f.name}" if prefix else f.name,
-                     getattr(obj, f.name))
-        elif isinstance(obj, (tuple, list)):
-            for i, x in enumerate(obj):
-                walk(f"{prefix}[{i}]", x)
-        elif hasattr(obj, "shape"):
-            dt = str(obj.dtype)
-            dt = {"float32": "f32", "int32": "s32", "uint32": "u32",
-                  "bool": "pred", "int8": "s8", "uint8": "u8"}.get(dt, dt)
-            dims = ",".join(str(d) for d in obj.shape)
-            names[f"{dt}[{dims}]"].add(prefix)
-
-    walk("", args)
-    return names
-
-
-def audit(compiled, names):
-    text = compiled.as_text()
-    # The scan lowers to while(...) with body=<name>; extract each body
-    # computation by name.
-    body_names = set(re.findall(r"body=%?([\w.\-]+)", text))
-    bodies = []
-    for bn in body_names:
-        m = re.search(
-            r"^(?:%" + re.escape(bn) + r"|" + re.escape(bn) +
-            r") \([^)]*\) -> .*?\{(.*?)^\}", text, re.M | re.S)
-        if m:
-            bodies.append((bn, m.group(1)))
-    if not bodies:
-        bodies = [("whole-module", text)]
-    report = []
-    for name, body in bodies:
-        dus = []
-        copies = []
-        for line in body.splitlines():
-            line = line.strip()
-            m = re.match(r"%?([\w.\-]+) = (\S+?) (dynamic-update-slice|copy)\(",
-                         line)
-            if not m:
-                m2 = re.match(r"%?([\w.\-]+) = (\S+?)\s+"
-                              r"(dynamic-update-slice|copy)", line)
-                if not m2:
-                    continue
-                m = m2
-            out, shape, op = m.groups()
-            b = shape_bytes(shape)
-            src = ""
-            mm = re.search(r'metadata=\{[^}]*op_name="([^"]+)"', line)
-            if mm:
-                src = mm.group(1)[-70:]
-            mm = re.search(r'source_file="([^"]+)"[^}]*source_line=(\d+)',
-                           line)
-            if mm:
-                src += f" {os.path.basename(mm.group(1))}:{mm.group(2)}"
-            bare = shape.split("{")[0]
-            leaf = "/".join(sorted(names.get(bare, []))[:3])
-            (dus if op == "dynamic-update-slice" else copies).append(
-                (b, shape, src, leaf))
-        report.append((name, dus, copies))
-    return report
 
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     seeds = int(sys.argv[2]) if len(sys.argv) > 2 else 2
     chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 40
-    proto, args, compiled = build(n, seeds, chunk)
-    names = leaf_names(proto, args)
-    for body, dus, copies in audit(compiled, names):
-        tot_d = sum(b for b, *_ in dus)
-        tot_c = sum(b for b, *_ in copies)
-        if not dus and not copies:
-            continue
-        print(f"== {body}: {len(dus)} DUS ({tot_d/1e6:.1f} MB), "
-              f"{len(copies)} copies ({tot_c/1e6:.1f} MB)")
-        agg = collections.Counter()
-        size = collections.Counter()
-        for b, shape, src, leaf in dus:
-            agg[("DUS", shape, src, leaf)] += 1
-            size[("DUS", shape, src, leaf)] += b
-        for b, shape, src, leaf in copies:
-            agg[("copy", shape, src, leaf)] += 1
-            size[("copy", shape, src, leaf)] += b
-        for key, cnt in sorted(agg.items(), key=lambda kv: -size[kv[0]]):
-            op, shape, src, leaf = key
-            print(f"  {op:4s} x{cnt:<4d} {size[key]/1e6:9.2f} MB  {shape:24s}"
-                  f" {leaf or '?':40s} {src}")
+
+    from wittgenstein_tpu.analysis import rules_carry
+    from wittgenstein_tpu.analysis.targets import handel_audit_target
+
+    # Same knob bench.py honors: WTPU_PLANE_BARRIER=0 audits the
+    # pre-fix build (reproduces the 40-copies-per-body baseline).
+    target = handel_audit_target(
+        n=n, seeds=seeds, chunk=chunk,
+        plane_barrier=os.environ.get("WTPU_PLANE_BARRIER", "1") != "0")
+
+    rows = rules_carry.audit(target)
+    if not rows:
+        from wittgenstein_tpu.analysis import hlo
+        if not hlo.scan_bodies(target.hlo_text):
+            print("WARNING: no scan-shaped while body matched in the "
+                  "optimized HLO — parser found nothing (HLO text format "
+                  "change?), NOT a copy-free build")
+        else:
+            print("scan while body is clean: no copy/DUS ops")
+    by_body: dict[str, list] = {}
+    for r in rows:
+        by_body.setdefault(r.body, []).append(r)
+    for body, rs in by_body.items():
+        dus = [r for r in rs if r.op == "dynamic-update-slice"]
+        copies = [r for r in rs if r.op == "copy"]
+        tot_d = sum(r.bytes for r in dus)
+        tot_c = sum(r.bytes for r in copies)
+        print(f"== {body}: {sum(r.count for r in dus)} DUS "
+              f"({tot_d / 1e6:.1f} MB), {sum(r.count for r in copies)} "
+              f"copies ({tot_c / 1e6:.1f} MB)")
+        for r in rs:
+            print(f"  {r.op[:4]:4s} x{r.count:<4d} {r.bytes / 1e6:9.2f} MB  "
+                  f"{r.shape:24s} {r.leaf or '?':40s} {r.source}")
+    print(f"-- metrics: {rules_carry.metrics_from_rows(rows)}")
 
 
 if __name__ == "__main__":

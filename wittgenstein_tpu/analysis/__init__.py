@@ -1,0 +1,15 @@
+"""wittgenstein_tpu.analysis — static-analysis passes over the compiled
+simulator.
+
+Compiles each registered protocol's superstep on CPU (the copy-insertion
+and aliasing decisions the rules audit are backend-independent) and runs
+pluggable rules over the optimized HLO, the jaxpr, and the Python
+source, against checked-in per-protocol budgets that ratchet down, never
+up.  See analysis/README.md for the rule catalogue and the CLI:
+
+    python -m wittgenstein_tpu.analysis [--protocol NAME] [--rule NAME]
+"""
+
+from .framework import (RULES, Finding, Report, Rule, load_budgets,  # noqa
+                        register_rule, run_analysis)
+from .targets import AnalysisTarget, get_target, target_names  # noqa

@@ -1,0 +1,90 @@
+"""CLI: run the static-analysis rules against the checked-in budgets.
+
+    python -m wittgenstein_tpu.analysis                 # all rules, all protocols
+    python -m wittgenstein_tpu.analysis --protocol Handel --rule carry_copy
+    python -m wittgenstein_tpu.analysis --json report.json
+    python -m wittgenstein_tpu.analysis --update-budgets   # ratchet down
+
+Exit code 0 iff no error findings.  Runs on CPU (force JAX_PLATFORMS=cpu
+to audit from a TPU host without touching the chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from . import framework, targets
+
+    framework._install_rules()
+    ap = argparse.ArgumentParser(
+        prog="python -m wittgenstein_tpu.analysis",
+        description="jaxpr/HLO/source lints over every protocol's "
+                    "compiled superstep")
+    ap.add_argument("--protocol", action="append", metavar="NAME",
+                    help="restrict to protocol(s) (repeatable; default all)")
+    ap.add_argument("--rule", action="append", metavar="NAME",
+                    choices=sorted(framework.RULES),
+                    help="restrict to rule(s) (repeatable; default all)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable report to PATH "
+                         "('-' for stdout)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="ratchet analysis/budgets.json down to the "
+                         "measured values (never up)")
+    ap.add_argument("--list", action="store_true",
+                    help="list rules and targets, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        print("rules:   ", " ".join(sorted(framework.RULES)))
+        print("targets: ", " ".join(targets.target_names()))
+        return 0
+
+    import wittgenstein_tpu.models  # noqa: F401  (fill the registry)
+
+    known = set(targets.target_names())
+    for name in args.protocol or ():
+        if name not in known:
+            ap.error(f"unknown protocol {name!r}; known: "
+                     f"{' '.join(sorted(known))}")
+
+    def progress(msg):
+        print(f"[analysis] {msg}", file=sys.stderr, flush=True)
+
+    report = framework.run_analysis(target_names=args.protocol,
+                                    rule_names=args.rule,
+                                    progress=progress)
+
+    for f in report.findings:
+        if f.severity != "info":
+            print(f"{f.severity.upper():8s} {f.rule:12s} {f.target}: "
+                  f"{f.message}")
+    info = sum(1 for f in report.findings if f.severity == "info")
+    warn = sum(1 for f in report.findings if f.severity == "warning")
+    print(f"[analysis] {len(report.targets)} targets x "
+          f"{len(report.rules)} rules: {len(report.errors)} errors, "
+          f"{warn} warnings, {info} checks passed")
+
+    if args.update_budgets:
+        budgets = framework.load_budgets()
+        framework.ratchet_budgets(report.findings, budgets, framework.RULES)
+        framework.save_budgets(budgets)
+        print(f"[analysis] budgets ratcheted -> {framework.BUDGETS_PATH}")
+
+    if args.json:
+        payload = json.dumps(report.to_json(), indent=2)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as fh:
+                fh.write(payload + "\n")
+
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

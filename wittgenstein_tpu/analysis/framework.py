@@ -1,0 +1,182 @@
+"""Rule framework: findings, budgets, the ratchet, and the runner.
+
+A rule is a named check with one entry point:
+
+    run(target, budget) -> list[Finding]
+
+Compiled rules (``scope == "protocol"``) get an `AnalysisTarget` per
+protocol; global rules (``scope == "global"``) run once with
+``target=None`` (source lints, kernel cost models).  A `Finding` with
+severity "error" fails the run; "info" findings carry the measured
+metrics that budgets are ratcheted from.
+
+Budgets (analysis/budgets.json) ratchet DOWN, never up: `--update-
+budgets` writes a metric only when the measured value is strictly below
+the checked-in one (or when no budget exists yet).  A regression above
+budget is an error finding; tightening requires nothing; loosening
+requires a human editing the JSON in a reviewed diff.  That is the same
+one-way gate the round-5 carry-copy fix needed and did not have
+(ISSUE: a one-off audit script guards nothing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+BUDGETS_PATH = pathlib.Path(__file__).resolve().parent / "budgets.json"
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    target: str             # protocol/target name, or file for lints
+    severity: str           # "error" | "warning" | "info"
+    message: str
+    metric: str | None = None   # budgetable metric name
+    value: object = None        # measured value for `metric`
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class; subclasses set `name`, `scope` and implement run()."""
+
+    name: str = ""
+    scope: str = "protocol"     # "protocol" | "global"
+    #: metrics (by name) the budget ratchet tracks for this rule
+    budgeted_metrics: tuple = ()
+
+    def run(self, target, budget: dict) -> list[Finding]:
+        raise NotImplementedError
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    inst = cls()
+    assert inst.name and inst.name not in RULES, inst.name
+    RULES[inst.name] = inst
+    return cls
+
+
+def _install_rules():
+    """Import the rule modules for their registration side effect."""
+    from . import (rules_carry, rules_determinism, rules_dtype,  # noqa: F401
+                   rules_hostsync, rules_vmem)
+
+
+def load_budgets(path=BUDGETS_PATH) -> dict:
+    if pathlib.Path(path).exists():
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def save_budgets(budgets: dict, path=BUDGETS_PATH):
+    with open(path, "w") as f:
+        json.dump(budgets, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def check_budget(findings, budgets, rule, target_name) -> list[Finding]:
+    """Turn measured info-findings into errors where they exceed the
+    checked-in budget.  Metrics with no budget entry yet pass (run
+    --update-budgets to pin them)."""
+    out = list(findings)
+    rb = budgets.get(rule.name, {}).get(target_name, {})
+    for f in findings:
+        if f.metric is None or f.metric not in rule.budgeted_metrics:
+            continue
+        limit = rb.get(f.metric)
+        if limit is not None and f.value is not None and f.value > limit:
+            out.append(Finding(
+                rule=rule.name, target=target_name, severity="error",
+                metric=f.metric, value=f.value,
+                message=(f"{f.metric}={f.value} exceeds the checked-in "
+                         f"budget {limit} (analysis/budgets.json ratchets "
+                         "down only — fix the regression, do not raise "
+                         "the budget)")))
+    return out
+
+
+def ratchet_budgets(findings, budgets, rules) -> dict:
+    """Fold measured metrics into `budgets`, downward only."""
+    for f in findings:
+        rule = rules.get(f.rule)
+        if (rule is None or f.metric is None
+                or f.metric not in rule.budgeted_metrics
+                or not isinstance(f.value, (int, float))):
+            continue
+        rb = budgets.setdefault(f.rule, {}).setdefault(f.target, {})
+        old = rb.get(f.metric)
+        if old is None or f.value < old:
+            rb[f.metric] = f.value
+    return budgets
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list
+    targets: list
+    rules: list
+    errors: list = dataclasses.field(init=False)
+
+    def __post_init__(self):
+        self.errors = [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def to_json(self):
+        return {"ok": self.ok, "targets": self.targets, "rules": self.rules,
+                "n_errors": len(self.errors),
+                "findings": [f.to_json() for f in self.findings]}
+
+
+def run_analysis(target_names=None, rule_names=None, budgets=None,
+                 progress=None) -> Report:
+    """Run `rule_names` (default: all) over `target_names` (default: the
+    full pinned registry) against `budgets` (default: the checked-in
+    file).  Compile failures become error findings, not crashes — a
+    protocol whose superstep stops compiling on CPU is itself a
+    regression the report must surface."""
+    from . import targets as targets_mod
+
+    _install_rules()
+    budgets = load_budgets() if budgets is None else budgets
+    names = list(target_names) if target_names is not None \
+        else list(targets_mod.target_names())
+    rules = [RULES[r] for r in (rule_names or sorted(RULES))]
+
+    findings: list[Finding] = []
+    for rule in rules:
+        if rule.scope != "global":
+            continue
+        if progress:
+            progress(f"rule {rule.name} (global)")
+        fs = rule.run(None, budgets.get(rule.name, {}))
+        findings += check_budget(fs, budgets, rule, "global")
+
+    proto_rules = [r for r in rules if r.scope == "protocol"]
+    for name in names if proto_rules else []:
+        target = targets_mod.get_target(name)
+        for rule in proto_rules:
+            if progress:
+                progress(f"rule {rule.name} on {name}")
+            try:
+                fs = rule.run(target, budgets.get(rule.name, {}).get(name, {}))
+            except Exception as e:          # noqa: BLE001
+                findings.append(Finding(
+                    rule=rule.name, target=name, severity="error",
+                    message=f"rule crashed: {type(e).__name__}: {e}"))
+                continue
+            findings += check_budget(fs, budgets, rule, name)
+    return Report(findings=findings, targets=names,
+                  rules=[r.name for r in rules])

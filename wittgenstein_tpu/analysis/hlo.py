@@ -1,0 +1,142 @@
+"""Optimized-HLO text model: the minimal structured view the rules need.
+
+XLA's post-optimization HLO text is the ground truth for what a compiled
+superstep actually does (copy insertion, host transfers, fusion
+boundaries).  There is no stable Python API for walking it, but the text
+format is line-oriented and regular enough for the three queries the
+rules make:
+
+  * computations by name (``parse_computations``) — each ``%name (...)
+    -> ... {`` block;
+  * while ops with their body names and carry widths
+    (``find_while_ops``) — a ``lax.scan`` lowers to the while whose
+    carry tuple mirrors the scan carry, so "the scan body" is the body
+    of the widest while (CPU scatter lowering adds many narrow
+    4-element whiles that must not be confused with it);
+  * sized ops inside a body (``iter_sized_ops``) — opcode, shape,
+    byte size, and source attribution from the op metadata.
+
+The helpers began life in tools/carry_audit.py (round 4/5); they moved
+here so every rule — not just the Handel carry audit — shares one
+parser.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+_BYTES = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+          "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+          "pred": 1}
+
+
+def shape_bytes(shape: str) -> int:
+    """Byte size of an HLO array shape string like ``s32[2,49152]``
+    (layout braces stripped by the caller or ignored here)."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", shape)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    total = _BYTES.get(dt, 4)
+    for d in dims.split(","):
+        if d:
+            total *= int(d)
+    return total
+
+
+def bare_shape(shape: str) -> str:
+    """Strip the layout annotation: ``s32[2,64]{1,0}`` -> ``s32[2,64]``."""
+    return shape.split("{")[0]
+
+
+def parse_computations(text: str) -> dict[str, str]:
+    """name -> body text (the lines between ``{`` and the closing
+    ``}``), for every computation in an HLO module dump.  Names are
+    stored without the leading ``%``."""
+    comps: dict[str, list[str]] = {}
+    cur = None
+    for line in text.splitlines():
+        m = re.match(r"^(ENTRY )?(%?[\w.\-]+) \(.*\{\s*$", line)
+        if m:
+            cur = m.group(2).lstrip("%")
+            comps[cur] = []
+        elif cur is not None:
+            if line.startswith("}"):
+                cur = None
+            else:
+                comps[cur].append(line)
+    return {k: "\n".join(v) for k, v in comps.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class WhileOp:
+    body: str           # body computation name (no leading %)
+    carry_arrays: int   # number of array elements in the carry tuple
+
+
+def find_while_ops(text: str) -> list[WhileOp]:
+    """Every ``while(`` op in the module, widest carry first.  The carry
+    width is the count of array shapes in the result tuple — the scan
+    over the simulator state is by far the widest; CPU scatter loops
+    carry 4 elements."""
+    out = []
+    for line in text.splitlines():
+        if " while(" not in line:
+            continue
+        bm = re.search(r"body=%?([\w.\-]+)", line)
+        if not bm:
+            continue
+        result = line.split(" while(")[0]
+        out.append(WhileOp(body=bm.group(1), carry_arrays=result.count("[")))
+    out.sort(key=lambda w: -w.carry_arrays)
+    return out
+
+
+def scan_bodies(text: str, min_carry: int = 6) -> list[str]:
+    """Body names of the whiles that look like simulator scans (carry
+    tuple of at least `min_carry` arrays; the CPU backend's sequential
+    scatter loops carry exactly 4 — counter, plane, indices, updates —
+    so 6 cleanly separates them).  Deduplicated, widest first."""
+    seen, names = set(), []
+    for w in find_while_ops(text):
+        if w.carry_arrays >= min_carry and w.body not in seen:
+            seen.add(w.body)
+            names.append(w.body)
+    return names
+
+
+@dataclasses.dataclass(frozen=True)
+class SizedOp:
+    op: str             # opcode, e.g. "copy" / "dynamic-update-slice"
+    shape: str          # bare result shape, e.g. "s32[2,49152]"
+    bytes: int
+    source: str         # "<op_name tail> <file>:<line>" when present
+
+
+_OP_RE = re.compile(r"^\s*%?[\w.\-]+ = (\S+) ([\w\-]+)\(")
+
+
+def iter_sized_ops(body: str, opcodes: tuple[str, ...]):
+    """Yield `SizedOp` for every op in `body` whose opcode is in
+    `opcodes`, with byte size and source metadata attribution."""
+    for line in body.splitlines():
+        m = _OP_RE.match(line)
+        if not m or m.group(2) not in opcodes:
+            continue
+        shape = bare_shape(m.group(1))
+        src = ""
+        mm = re.search(r'metadata=\{[^}]*op_name="([^"]+)"', line)
+        if mm:
+            src = mm.group(1)[-70:]
+        mm = re.search(r'source_file="([^"]+)"[^}]*source_line=(\d+)', line)
+        if mm:
+            src += f" {os.path.basename(mm.group(1))}:{mm.group(2)}"
+        yield SizedOp(op=m.group(2), shape=shape,
+                      bytes=shape_bytes(shape), source=src)
+
+
+def custom_call_targets(text: str) -> set[str]:
+    """Every distinct custom_call_target in the module."""
+    return set(re.findall(r'custom_call_target="([^"]+)"', text))

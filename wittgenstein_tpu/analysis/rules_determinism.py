@@ -1,0 +1,146 @@
+"""Rule ``determinism`` — source lint over models/ and core/ for
+nondeterminism sneaking into traced code paths.
+
+Determinism is the reference's invariant #1 (same seed -> same run,
+HandelTest.java's copy()-reproducibility contract) and this port
+strengthens it to bit-determinism across hosts via counter-based PRNG
+(ops/prng.py).  One `time.time()` or `np.random.*` call inside a step
+function silently breaks it — and nothing at trace time complains,
+because the value is baked in as a constant.
+
+Flagged (as errors) anywhere in wittgenstein_tpu/models/ and core/:
+  * wall-clock reads: time.time / time.time_ns / datetime.now
+    (time.monotonic / perf_counter stay allowed — the harness uses
+    them for wall-clock BOUNDS, which never feed simulation state);
+  * stateful PRNG: the stdlib ``random`` module, np.random.* (all
+    randomness must flow from ops/prng.py or jax.random keys);
+  * environment reads: os.environ / os.getenv (config must be explicit
+    constructor arguments, never ambient — an env read inside a model
+    changes compiled behavior between processes that compare runs).
+
+Known-legitimate sites are allowlisted in budgets.json under
+``determinism.allow`` as "relpath::qualname::pattern" strings; the
+allowlist is part of the reviewed budget file, so an exemption is a
+diff, not a silent skip.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from .framework import Finding, Rule, register_rule
+
+PKG_DIR = pathlib.Path(__file__).resolve().parent.parent
+LINT_DIRS = ("models", "core")
+
+# dotted-name prefixes -> reason.  Names are resolved against each
+# module's imports (import aliases followed), so `import numpy as np;
+# np.random.rand()` matches "numpy.random".
+BANNED = {
+    "time.time": "wall-clock read inside simulation code",
+    "time.time_ns": "wall-clock read inside simulation code",
+    "datetime.datetime.now": "wall-clock read inside simulation code",
+    "datetime.datetime.utcnow": "wall-clock read inside simulation code",
+    "random": "stateful stdlib PRNG (use ops/prng.py counter draws)",
+    "numpy.random": "stateful numpy PRNG (use ops/prng.py counter draws)",
+    "os.getenv": "ambient environment read (pass explicit parameters)",
+    "os.environ": "ambient environment read (pass explicit parameters)",
+}
+
+
+class _Lint(ast.NodeVisitor):
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.aliases = {}       # local name -> canonical dotted module
+        self.scope = []         # enclosing function/class names
+        self.hits = []          # (qualname, lineno, banned_key, reason)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = \
+                a.name if a.asname else a.name.split(".")[0]
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            if node.module:
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def _canonical(self, node) -> str:
+        """Dotted name of an expression, import aliases resolved."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(self.aliases.get(node.id, node.id))
+        else:
+            return ""
+        return ".".join(reversed(parts))
+
+    def _check(self, name, lineno):
+        for banned, reason in BANNED.items():
+            if name == banned or name.startswith(banned + "."):
+                self.hits.append((".".join(self.scope) or "<module>",
+                                  lineno, banned, reason))
+                return
+
+    def _walk_scoped(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = \
+        _walk_scoped
+
+    def visit_Call(self, node):
+        self._check(self._canonical(node.func), node.lineno)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # os.environ["X"] reads (getenv is caught as a Call).
+        self._check(self._canonical(node.value), node.lineno)
+        self.generic_visit(node)
+
+
+def lint_source_text(relpath: str, text: str, allow=()):
+    """Lint one module's source; returns (rel, qual, lineno, banned,
+    reason) hits minus the allowlist.  Split out so tests can feed
+    synthetic sources."""
+    lint = _Lint(relpath)
+    lint.visit(ast.parse(text, filename=relpath))
+    return [(relpath, qual, lineno, banned, reason)
+            for qual, lineno, banned, reason in lint.hits
+            if f"{relpath}::{qual}::{banned}" not in allow]
+
+
+def lint_sources(allow=()):
+    """All hits across the linted trees, minus the allowlist.  An
+    allow entry is "relpath::qualname::banned_prefix"."""
+    hits = []
+    for sub in LINT_DIRS:
+        for path in sorted((PKG_DIR / sub).glob("*.py")):
+            hits += lint_source_text(f"{sub}/{path.name}",
+                                     path.read_text(), allow)
+    return hits
+
+
+@register_rule
+class DeterminismRule(Rule):
+    name = "determinism"
+    scope = "global"
+
+    def run(self, target, budget):
+        allow = tuple(budget.get("allow", ()))
+        findings = [
+            Finding(rule=self.name, target=f"{rel}:{lineno}",
+                    severity="error",
+                    message=f"{banned} in {qual}: {reason} (allowlist key: "
+                            f'"{rel}::{qual}::{banned}")')
+            for rel, qual, lineno, banned, reason in lint_sources(allow)]
+        if not findings:
+            findings.append(Finding(
+                rule=self.name, target="models+core", severity="info",
+                message="no wall-clock/stateful-PRNG/env reads in "
+                        "simulation sources"))
+        return findings

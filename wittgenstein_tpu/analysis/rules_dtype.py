@@ -1,0 +1,99 @@
+"""Rule ``dtype_leak`` — no 64-bit leaves anywhere in the simulator
+carry or the traced superstep.
+
+The reference keeps all simulation time in int milliseconds
+(Network.java's int-ms invariant); this port's contract is s32 time and
+32-bit-or-narrower state everywhere.  A float64/int64 leaf sneaking in
+(a numpy default dtype, an accidental x64 enable) doubles carry
+residency and desyncs counter-based PRNG draws between hosts, so it is
+an error, not a style nit.
+
+Checks, per protocol target:
+  * every leaf of the example (net, pstate) carry has an allowed dtype
+    (the carry is inspected pre-trace, so a float64 numpy array is
+    caught even though jit would silently downcast it under x64-off);
+  * ``net.time`` is exactly int32;
+  * no 64-bit aval appears anywhere in the traced jaxpr (recursing
+    into scan/cond sub-jaxprs) — catches x64 leaks in intermediates
+    that never reach the carry.
+"""
+
+from __future__ import annotations
+
+from .framework import Finding, Rule, register_rule
+
+ALLOWED = {"int32", "uint32", "int16", "uint16", "int8", "uint8",
+           "bool", "float32", "bfloat16", "float16"}
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield a jaxpr and every sub-jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for j in _maybe_jaxprs(v):
+                yield from _iter_jaxprs(j)
+
+
+def _maybe_jaxprs(v):
+    import jax.extend.core as jex_core
+
+    vals = v if isinstance(v, (tuple, list)) else (v,)
+    for x in vals:
+        if isinstance(x, jex_core.ClosedJaxpr):
+            yield x.jaxpr
+        elif isinstance(x, jex_core.Jaxpr):
+            yield x
+
+
+def check_carry_leaves(args, name, rule_name) -> list[Finding]:
+    import jax
+
+    findings = []
+    leaves = jax.tree.leaves(args)
+    for leaf in leaves:
+        dt = str(getattr(leaf, "dtype", ""))
+        if dt and dt not in ALLOWED:
+            sev = "error" if dt.endswith("64") else "warning"
+            findings.append(Finding(
+                rule=rule_name, target=name, severity=sev,
+                message=f"carry leaf with dtype {dt} (shape "
+                        f"{getattr(leaf, 'shape', '?')}); allowed: "
+                        f"{sorted(ALLOWED)}"))
+    return findings
+
+
+@register_rule
+class DtypeLeakRule(Rule):
+    name = "dtype_leak"
+    scope = "protocol"
+
+    def run(self, target, budget):
+        findings = check_carry_leaves(target.args, target.name, self.name)
+
+        net = target.args[0] if isinstance(target.args, tuple) else None
+        time_leaf = getattr(net, "time", None)
+        if time_leaf is not None and str(time_leaf.dtype) != "int32":
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="error",
+                message=f"net.time is {time_leaf.dtype}, contract is s32 "
+                        "(the reference's int-ms invariant)"))
+
+        bad64 = set()
+        for j in _iter_jaxprs(target.jaxpr.jaxpr):
+            for eqn in j.eqns:
+                for var in eqn.outvars:
+                    dt = str(getattr(var.aval, "dtype", ""))
+                    if dt.endswith("64"):
+                        bad64.add((eqn.primitive.name, dt))
+        for prim, dt in sorted(bad64):
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="error",
+                message=f"traced intermediate of dtype {dt} (primitive "
+                        f"{prim}) — x64 leak inside the superstep"))
+        if not findings:
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="info",
+                message="carry and jaxpr are 32-bit-or-narrower; "
+                        "net.time is s32"))
+        return findings

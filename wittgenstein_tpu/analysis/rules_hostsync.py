@@ -1,0 +1,78 @@
+"""Rule ``host_sync`` — the compiled superstep must be free of host
+round-trips.
+
+The whole performance model (ROADMAP north star, SURVEY §2.6) assumes a
+chunk of simulated milliseconds is ONE device program: any Python
+callback, infeed/outfeed, or host transfer inside the scan serializes
+the device on the host every iteration — catastrophic and silent (the
+program still returns bit-correct results).  The reference has no
+analogue (it runs on the JVM); this invariant is TPU-port-specific.
+
+Checks, per protocol target:
+  * jaxpr: no callback/debug primitives anywhere (pure_callback,
+    io_callback, debug_callback, outside_call, host_callback, ...);
+  * optimized HLO: no infeed/outfeed/send/recv ops and no custom-call
+    to a host-python trampoline target.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import hlo
+from .framework import Finding, Rule, register_rule
+from .rules_dtype import _iter_jaxprs
+
+BAD_PRIMITIVES = {"pure_callback", "io_callback", "debug_callback",
+                  "debug_print", "outside_call", "host_callback",
+                  "host_local_array_to_global_array", "infeed", "outfeed"}
+
+# Host-python trampolines XLA emits for jax callbacks (CPU and TPU
+# spellings), matched as substrings of the custom_call_target.
+BAD_CUSTOM_CALL_PAT = re.compile(
+    r"callback|CallbackToHost|host_compute|SendToHost|RecvFromHost",
+    re.IGNORECASE)
+
+BAD_HLO_OPS = ("infeed", "outfeed", "send", "send-done", "recv",
+               "recv-done")
+
+
+@register_rule
+class HostSyncRule(Rule):
+    name = "host_sync"
+    scope = "protocol"
+
+    def run(self, target, budget):
+        findings = []
+        bad_prims = set()
+        for j in _iter_jaxprs(target.jaxpr.jaxpr):
+            for eqn in j.eqns:
+                if eqn.primitive.name in BAD_PRIMITIVES:
+                    bad_prims.add(eqn.primitive.name)
+        for p in sorted(bad_prims):
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="error",
+                message=f"host-callback primitive {p!r} inside the traced "
+                        "superstep — every scan iteration would sync with "
+                        "the host"))
+
+        text = target.hlo_text
+        for opcode in BAD_HLO_OPS:
+            n = len(re.findall(rf"= \S+ {re.escape(opcode)}\(", text))
+            if n:
+                findings.append(Finding(
+                    rule=self.name, target=target.name, severity="error",
+                    message=f"{n} `{opcode}` op(s) in the optimized HLO — "
+                            "device/host transfer inside the step"))
+        for tgt in sorted(hlo.custom_call_targets(text)):
+            if BAD_CUSTOM_CALL_PAT.search(tgt):
+                findings.append(Finding(
+                    rule=self.name, target=target.name, severity="error",
+                    message=f"custom-call to host trampoline {tgt!r} in "
+                            "the optimized HLO"))
+        if not findings:
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="info",
+                message="no host callbacks or transfers in the compiled "
+                        "step"))
+        return findings

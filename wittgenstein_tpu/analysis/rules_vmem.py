@@ -1,0 +1,126 @@
+"""Rule ``vmem_budget`` — every Pallas kernel's block-size cost model
+must fit the scoped-VMEM budget at its real launch configurations.
+
+Background: the first on-chip compile of the merge kernel at blk=256
+requested a 56.26 MB scoped-vmem stack against the 16 MB limit
+(reports/pallas_validate_r5.log).  The fix was a per-row cost model fed
+to `_pick_block` — but the models were inline arithmetic at each launch
+site with nothing holding them together (ADVICE.md r5 items 2-3).  This
+rule pins them down statically, off-chip:
+
+  * every kernel's named cost model (merge_row_bytes,
+    gsf_merge_row_bytes, score_row_bytes — the launchers call the SAME
+    functions) is evaluated at the representative configs below; the
+    block `_pick_block` picks must fit the budget, and a config whose
+    single row exceeds it must RAISE (no more silent blk=1);
+  * an AST check over ops/pallas_*.py that every `_pick_block` call
+    site passes a row-bytes estimate — a new kernel launched with the
+    bare `_pick_block(m)` form reintroduces exactly the unbudgeted
+    compile the round-5 OOM came from.
+
+Representative configs cover the shipped tiers: the 2048-node headline
+(w=64), the 32k exact tier (w=1024), and the small CPU-test shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from .framework import Finding, Rule, register_rule
+
+OPS_DIR = pathlib.Path(__file__).resolve().parent.parent / "ops"
+
+
+def _kernel_models():
+    """(kernel name, cost_fn, [(m, kwargs, label), ...]) — shapes
+    mirror the launch sites: merge S = inbox_cap (delivery slots),
+    score W = ceil(n/32) sig words."""
+    from ..ops.pallas_gsf_merge import gsf_merge_row_bytes
+    from ..ops.pallas_merge import merge_row_bytes
+    from ..ops.pallas_score import score_row_bytes
+
+    return [
+        ("pallas_merge.merge_queue_pallas", merge_row_bytes, [
+            (2048, dict(q_cap=16, s_cap=12, w=64), "headline-2048n"),
+            (32768, dict(q_cap=16, s_cap=12, w=1024), "tier2-32k"),
+            (64, dict(q_cap=16, s_cap=12, w=2), "cpu-test"),
+        ]),
+        ("pallas_gsf_merge.gsf_merge_pallas", gsf_merge_row_bytes, [
+            (1024, dict(q_cap=16, s_cap=16, w=32), "gsf-1024n"),
+            (32768, dict(q_cap=16, s_cap=16, w=1024), "gsf-32k"),
+        ]),
+        ("pallas_score.score_queue_pallas", score_row_bytes, [
+            (2048, dict(q_cap=16, w=64), "headline-2048n"),
+            (32768, dict(q_cap=16, w=1024), "tier2-32k"),
+        ]),
+    ]
+
+
+def _unbudgeted_pick_block_calls() -> list[str]:
+    """`_pick_block(m)` call sites missing the row-bytes argument, as
+    "file:line" strings."""
+    bad = []
+    for path in sorted(OPS_DIR.glob("pallas_*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name == "_pick_block" and len(node.args) < 2 and \
+                    not any(k.arg == "row_bytes" for k in node.keywords):
+                bad.append(f"{path.name}:{node.lineno}")
+    return bad
+
+
+def check_model(kernel: str, cost_fn, configs,
+                rule_name="vmem_budget") -> list[Finding]:
+    """Evaluate one kernel's cost model at `configs` ((m, kwargs,
+    label) triples) against the scoped-VMEM budget.  Exposed so tests
+    can feed a deliberately over-budget fake model and watch it get
+    rejected."""
+    from ..ops.pallas_merge import _VMEM_BUDGET, _pick_block
+
+    findings = []
+    for m, kw, label in configs:
+        row = cost_fn(**kw)
+        try:
+            blk = _pick_block(m, row)
+        except ValueError as e:
+            findings.append(Finding(
+                rule=rule_name, target=kernel, severity="error",
+                message=f"{label}: cost model rejects the config even "
+                        f"at blk=1 ({e})"))
+            continue
+        if blk * row > _VMEM_BUDGET:
+            findings.append(Finding(
+                rule=rule_name, target=kernel, severity="error",
+                message=f"{label}: blk={blk} x {row} B/row = "
+                        f"{blk * row / 1e6:.1f} MB exceeds the "
+                        f"{_VMEM_BUDGET / 1e6:.1f} MB budget"))
+        else:
+            findings.append(Finding(
+                rule=rule_name, target=kernel, severity="info",
+                message=f"{label}: blk={blk}, {blk * row / 1e6:.2f} MB "
+                        f"of {_VMEM_BUDGET / 1e6:.1f} MB"))
+    return findings
+
+
+@register_rule
+class VmemBudgetRule(Rule):
+    name = "vmem_budget"
+    scope = "global"
+
+    def run(self, target, budget):
+        findings = []
+        for kernel, cost_fn, configs in _kernel_models():
+            findings += check_model(kernel, cost_fn, configs, self.name)
+        for site in _unbudgeted_pick_block_calls():
+            findings.append(Finding(
+                rule=self.name, target=site, severity="error",
+                message="_pick_block called without a row-bytes cost "
+                        "estimate — unbudgeted Pallas launch (the r5 "
+                        "56 MB scoped-VMEM compile failure mode)"))
+        return findings

@@ -1,0 +1,256 @@
+"""Analysis targets: one pinned small-CPU compile per registered protocol.
+
+A target wraps everything a rule can look at — the protocol instance,
+the example batched args, the jaxpr, and the optimized HLO of the
+compiled superstep — computed lazily so source-only rules never pay for
+a compile.  Configs are PINNED (node counts, seeds, chunk, ring sizing):
+the carry/copy budgets in budgets.json are measured at exactly these
+shapes, so a config change here is a budget change and must be reviewed
+as one.
+
+Engine selection mirrors the bench/harness dispatch: protocols eligible
+for the batched seed-folded engine (spill-free, broadcast-free,
+superstep-ok — core/batched.py) compile through `scan_chunk_batched`,
+everything else through the vmapped `scan_chunk`.  That way the audited
+program IS the shape of the program the drivers run, per protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+
+SEEDS = 2       # batched seed axis of every target
+CHUNK = 8       # even, small: one scan, no phase-specialized unroll
+
+
+def _enable_compile_cache():
+    """The persistent XLA compile cache (repo-local, gitignored) — the
+    same setup tests/conftest.py uses; analysis runs are compile-bound
+    on one core and every rerun after the first is ~free."""
+    import pathlib
+
+    import jax
+
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        cache = pathlib.Path(__file__).resolve().parent.parent.parent \
+            / ".jax_cache"
+        jax.config.update("jax_compilation_cache_dir", str(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def leaf_shape_names(args) -> dict[str, set]:
+    """HLO shape string -> candidate state leaf names, for attributing
+    copies/DUS back to NetState / protocol-state fields (moved from
+    tools/carry_audit.py)."""
+    import collections
+
+    names = collections.defaultdict(set)
+
+    def walk(prefix, obj):
+        if dataclasses.is_dataclass(obj):
+            for f in dataclasses.fields(obj):
+                walk(f"{prefix}.{f.name}" if prefix else f.name,
+                     getattr(obj, f.name))
+        elif isinstance(obj, (tuple, list)):
+            for i, x in enumerate(obj):
+                walk(f"{prefix}[{i}]", x)
+        elif hasattr(obj, "shape"):
+            dt = str(obj.dtype)
+            dt = {"float32": "f32", "float64": "f64", "int32": "s32",
+                  "int64": "s64", "uint32": "u32", "uint64": "u64",
+                  "bool": "pred", "int8": "s8", "uint8": "u8",
+                  "int16": "s16", "uint16": "u16"}.get(dt, dt)
+            dims = ",".join(str(d) for d in obj.shape)
+            names[f"{dt}[{dims}]"].add(prefix)
+
+    walk("", args)
+    return dict(names)
+
+
+class AnalysisTarget:
+    """Lazy compile artifacts for one protocol (or one bare function).
+
+    Attributes the rules use:
+      name          — registry name
+      protocol      — the instance (None for `from_fn` targets)
+      args          — example (net, pstate) batch, the scan carry
+      jaxpr         — ClosedJaxpr of the superstep chunk
+      hlo_text      — post-optimization HLO text (CPU backend)
+      leaf_names    — shape string -> state field names
+      engine        — "batched" | "vmapped" | "fn"
+    """
+
+    def __init__(self, name, build_fn, protocol=None, engine="fn"):
+        self.name = name
+        self.protocol = protocol
+        self.engine = engine
+        self._build_fn = build_fn       # () -> (callable, args)
+        self._built = None
+
+    @classmethod
+    def from_protocol(cls, name, proto_fn, seeds=SEEDS, chunk=CHUNK):
+        """Build from a zero-arg protocol factory; engine dispatch as in
+        bench/harness (batched when eligible, else vmapped scan)."""
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            from ..core.batched import scan_chunk_batched
+            from ..core.network import scan_chunk
+
+            proto = proto_fn()
+            # Eligibility is scan_chunk_batched's own guard — one source
+            # of truth; ineligible protocols audit the vmapped engine
+            # the drivers would actually run for them.
+            try:
+                base = scan_chunk_batched(proto, chunk, t0_mod=None)
+                engine = "batched"
+            except ValueError:
+                base = jax.vmap(scan_chunk(proto, chunk, superstep=1))
+                engine = "vmapped"
+            args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+            return base, args, proto, engine
+
+        t = cls(name, None)
+        t._build_fn = build
+        return t
+
+    @classmethod
+    def from_fn(cls, name, fn, args):
+        """Wrap an arbitrary ``fn(*args)`` (test fixtures, one-off
+        audits).  `args` is the example input pytree."""
+        return cls(name, lambda: (fn, args, None, "fn"))
+
+    def _ensure_built(self):
+        if self._built is None:
+            _enable_compile_cache()
+            fn, args, proto, engine = self._build_fn()
+            self.protocol = proto if proto is not None else self.protocol
+            self.engine = engine
+            self._built = (fn, args)
+        return self._built
+
+    @functools.cached_property
+    def args(self):
+        return self._ensure_built()[1]
+
+    @functools.cached_property
+    def jaxpr(self):
+        import jax
+
+        fn, args = self._ensure_built()
+        return jax.make_jaxpr(fn)(*args)
+
+    @functools.cached_property
+    def hlo_text(self) -> str:
+        import jax
+
+        fn, args = self._ensure_built()
+        return jax.jit(fn).lower(*args).compile().as_text()
+
+    @functools.cached_property
+    def leaf_names(self):
+        return leaf_shape_names(self.args)
+
+
+def _handel(n=64, seeds=SEEDS, chunk=CHUNK, **kw):
+    from ..models.handel import Handel
+
+    down = n // 10
+    params = dict(node_count=n, threshold=int(0.99 * (n - down)),
+                  nodes_down=down, pairing_time=4, level_wait_time=50,
+                  dissemination_period_ms=20, fast_path=10,
+                  horizon=64, inbox_cap=12)
+    params.update(kw)
+    return Handel(**params)
+
+
+def handel_audit_target(n=256, seeds=2, chunk=40,
+                        plane_barrier=True) -> AnalysisTarget:
+    """The tools/carry_audit.py build, at its historical defaults: the
+    exact bench program (batched Handel, phase-specialized when the
+    chunk aligns), with the plane-barrier A/B knob."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.batched import scan_chunk_batched
+
+        proto = _handel(n=n)
+        lcm = getattr(proto, "schedule_lcm", None)
+        t0 = 0 if (lcm and chunk % lcm == 0) else None
+        base = scan_chunk_batched(proto, chunk, t0_mod=t0,
+                                  plane_barrier=plane_barrier)
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "batched"
+
+    t = AnalysisTarget(f"Handel[n={n},audit]", None)
+    t._build_fn = build
+    return t
+
+
+def _registry() -> dict:
+    """name -> zero-arg protocol factory at the pinned analysis config.
+    Every entry must init + compile on CPU in seconds at these shapes."""
+    from ..models.avalanche import Slush, Snowflake
+    from ..models.casper import CasperIMD
+    from ..models.dfinity import Dfinity
+    from ..models.enr import ENRGossiping
+    from ..models.gsf import GSFSignature
+    from ..models.handel_cardinal import HandelCardinal
+    from ..models.handeleth2 import HandelEth2
+    from ..models.optimistic import OptimisticP2PSignature
+    from ..models.p2pflood import P2PFlood
+    from ..models.paxos import Paxos
+    from ..models.pingpong import PingPong
+    from ..models.sanfermin import SanFermin
+
+    return {
+        "Handel": _handel,
+        "HandelCardinal": lambda: HandelCardinal(
+            node_count=64, nodes_down=6, threshold=57, pairing_time=4,
+            dissemination_period_ms=20, fast_path=10),
+        "GSFSignature": lambda: GSFSignature(node_count=64),
+        "HandelEth2": lambda: HandelEth2(node_count=64),
+        "PingPong": lambda: PingPong(node_count=64),
+        "P2PFlood": lambda: P2PFlood(
+            node_count=64, dead_node_count=6, peers_count=8,
+            delay_before_resent=1, delay_between_sends=1),
+        "Slush": lambda: Slush(node_count=64, rounds=4, k=5),
+        "Snowflake": lambda: Snowflake(node_count=64, k=5, beta=3),
+        "Paxos": lambda: Paxos(acceptor_count=3, proposer_count=3,
+                               timeout=1000),
+        "OptimisticP2PSignature": lambda: OptimisticP2PSignature(
+            node_count=64, threshold=33, connection_count=13,
+            pairing_time=3),
+        "SanFermin": lambda: SanFermin(node_count=64),
+        "Dfinity": lambda: Dfinity(block_producers_count=10,
+                                   attesters_count=10,
+                                   attesters_per_round=10),
+        "CasperIMD": lambda: CasperIMD(
+            cycle_length=4, block_producers_count=2,
+            attesters_per_round=10, tick_ms=40),
+        "ENRGossiping": lambda: ENRGossiping(
+            nodes=40, total_peers=5, max_peers=12,
+            number_of_different_capabilities=5, cap_per_node=2,
+            cap_gossip_time=500, time_to_change=5_000,
+            time_to_leave=20_000, changing_nodes=0.4),
+    }
+
+
+@functools.lru_cache(maxsize=1)
+def target_names() -> tuple:
+    return tuple(sorted(_registry()))
+
+
+def get_target(name: str) -> AnalysisTarget:
+    reg = _registry()
+    if name not in reg:
+        raise KeyError(f"unknown analysis target {name!r}; "
+                       f"known: {sorted(reg)}")
+    return AnalysisTarget.from_protocol(name, reg[name])

@@ -150,8 +150,9 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
     bcast_slots == 0, per-seed times all equal and even.
 
     `plane_barrier=False` disables the read-write ordering barrier (the
-    same-process A/B knob — results are bit-identical either way; the
-    barrier only changes whether XLA can update the planes in place)."""
+    same-process A/B knob — results are bit-identical either way, per
+    tests/test_batched.py::test_plane_barrier_bit_identity; the barrier
+    only changes whether XLA can update the planes in place)."""
     cfg, model = protocol.cfg, protocol.latency
     assert cfg.spill_cap == 0 and cfg.bcast_slots == 0
     r = net.box_count.shape[0]
@@ -168,10 +169,12 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
     # after the slices whenever a phase-hinted step's outbox is
     # structurally independent of its inbox, and it inserts a FULL COPY
     # of every ring plane per superstep — measured 40 -> 2 plane copies
-    # in the compiled while body (tools/carry_audit.py), the "scan carry
-    # DUS churn" item of reports/PROFILE_r4.md.  The barrier is pure
-    # ordering: no data is copied and results are bit-identical
-    # (tests/test_batched.py).
+    # in the compiled while body (tools/carry_audit.py — now enforced as
+    # the carry_copy budget in wittgenstein_tpu/analysis), the "scan
+    # carry DUS churn" item of reports/PROFILE_r4.md.  The barrier is
+    # pure ordering: no data is copied and results are bit-identical
+    # with it on or off
+    # (tests/test_batched.py::test_plane_barrier_bit_identity).
     if plane_barrier:
         (inbox0, inbox1, bd, bs, bz, bc) = jax.lax.optimization_barrier(
             (inbox0, inbox1, net.box_data, net.box_src, net.box_size,

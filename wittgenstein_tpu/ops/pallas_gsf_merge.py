@@ -36,6 +36,18 @@ BIG0 = 0x7FFFFF00
 EXCLUDED = 0x7FFFFFFF
 
 
+def gsf_merge_row_bytes(q_cap: int, s_cap: int, w: int) -> int:
+    """Per-row VMEM cost model of `_gsf_kernel`: q_cap unrolled
+    selection rounds over q_cap + 2*s_cap candidate columns with
+    [blk, W]-lane sig temporaries (same structure as
+    pallas_merge.merge_row_bytes, validated there on chip).  Named so
+    the analysis vmem_budget rule evaluates the SAME model the launcher
+    budgets with."""
+    from .pallas_merge import _pad_lanes
+
+    return q_cap * (q_cap + 2 * s_cap) * _pad_lanes(w) * 4
+
+
 def _gsf_kernel(exf_ref, exl_ref, exi_ref, exk_ref, exs_ref,
                 src_ref, lvl_ref, aok_ref, iok_ref, isig_ref,
                 of_ref, ol_ref, oi_ref, os_ref, ogot_ref, okept_ref,
@@ -152,7 +164,7 @@ def gsf_merge_pallas(q_from, q_lvl, q_indiv, ex_keep, q_sig,
     """
     from jax.experimental import pallas as pl
 
-    from .pallas_merge import _pad_lanes, _pick_block
+    from .pallas_merge import _pick_block
 
     m, q = q_from.shape
     s = src.shape[1]
@@ -162,10 +174,7 @@ def gsf_merge_pallas(q_from, q_lvl, q_indiv, ex_keep, q_sig,
     if c_tot > 255:
         raise ValueError(f"gsf_merge_pallas supports q + 2s <= 255 "
                          f"(got {q} + 2*{s})")
-    # Per-row VMEM: q_cap unrolled selection rounds over c_tot candidate
-    # columns with [blk, W]-lane sig temporaries (same model as
-    # merge_queue_pallas, validated there on chip).
-    blk = _pick_block(m, q * c_tot * _pad_lanes(w) * 4)
+    blk = _pick_block(m, gsf_merge_row_bytes(q, s, w))
     grid = (m // blk,)
 
     def spec(shape):

@@ -153,6 +153,16 @@ def _pad_lanes(w):
     return -(-w // 128) * 128
 
 
+def merge_row_bytes(q_cap: int, s_cap: int, w: int) -> int:
+    """Per-row VMEM cost model of `_merge_kernel`: the q_cap unrolled
+    selection rounds keep [blk, C]-wide and [blk, W]-lane temporaries
+    live simultaneously — rounds x candidate columns x padded lanes x
+    4 B (validated against the observed 219.8 KB/row at q16/s12/w64,
+    see _pick_block).  Named so the analysis vmem_budget rule evaluates
+    the SAME model the launcher budgets with."""
+    return q_cap * (q_cap + s_cap) * _pad_lanes(w) * 4
+
+
 def _pick_block(m, row_bytes=0):
     """Largest power-of-two block <= 256 dividing the row count whose
     VMEM footprint stays within budget.
@@ -164,10 +174,22 @@ def _pick_block(m, row_bytes=0):
     219.8 KB/row, matching the rounds x candidate-columns x padded-lane
     model the launchers pass — so an unbudgeted block is a compile
     error, not a perf tradeoff.  The interpreter never models VMEM,
-    which is why only the on-chip validate can see this."""
+    which is why only the on-chip validate can see this.
+
+    Raises when even blk=1 exceeds the budget (one row of live
+    temporaries cannot fit): the old behavior silently returned blk=1
+    and left the failure to the Mosaic compile — or worse, to an
+    on-chip OOM (ADVICE.md r5 item 2, enforced by the analysis
+    vmem_budget rule)."""
     blk = 256
     while row_bytes and blk > 1 and blk * row_bytes > _VMEM_BUDGET:
         blk //= 2
+    if row_bytes and blk * row_bytes > _VMEM_BUDGET:
+        raise ValueError(
+            f"kernel VMEM cost model exceeds budget at blk=1: one row's "
+            f"live temporaries need {row_bytes / 1e6:.2f} MB against the "
+            f"{_VMEM_BUDGET / 1e6:.1f} MB scoped-VMEM budget; shrink the "
+            "queue/lane configuration or use the XLA path")
     while blk > 1 and m % blk:
         blk //= 2
     return blk
@@ -204,11 +226,9 @@ def merge_queue_pallas(q_from, q_lvl, q_rank, q_bad, q_sig,
         raise ValueError(
             f"merge_queue_pallas supports q_cap + s_cap <= 255 "
             f"(got {q} + {s}); use the XLA merge for wider rows")
-    # Per-row VMEM model: the q_cap unrolled selection rounds keep
-    # [blk, C]-wide and [blk, W]-lane temporaries live simultaneously —
-    # rounds x columns x padded lanes x 4 B (validated against the
+    # Per-row VMEM model: merge_row_bytes (validated against the
     # observed 219.8 KB/row at q16/s12/w64, see _pick_block).
-    blk = _pick_block(m, q * (q + s) * _pad_lanes(w) * 4)
+    blk = _pick_block(m, merge_row_bytes(q, s, w))
     grid = (m // blk,)
 
     def col(k):

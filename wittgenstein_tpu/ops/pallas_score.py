@@ -124,6 +124,19 @@ def _gsf_score_kernel(sig_ref, lvl_ref, ids_ref, ver_ref, ind_ref,
         ref[...] = jnp.concatenate(parts, axis=1)
 
 
+def score_row_bytes(q_cap: int, w: int) -> int:
+    """Per-row VMEM cost model shared by both scoring kernels: q
+    unrolled rounds x ~12 live [blk, W]-lane temporaries (masks, masked
+    views, popcount intermediates) x 4 B.  The '12 live temporaries'
+    constant is extrapolated from the merge kernel's on-chip observation
+    (ADVICE.md r5 item 2) — re-validate on chip when the tunnel returns;
+    the analysis vmem_budget rule holds launch configs to this model
+    either way."""
+    from .pallas_merge import _pad_lanes
+
+    return q_cap * 12 * _pad_lanes(w) * 4
+
+
 def _launch_scoring(kernel_fn, n_outputs, q_sig, q_lvl, ids,
                     *bitsets, interpret):
     """Shared pallas_call scaffolding for the per-entry scoring kernels:
@@ -132,12 +145,10 @@ def _launch_scoring(kernel_fn, n_outputs, q_sig, q_lvl, ids,
     [M, Q] i32 outputs."""
     from jax.experimental import pallas as pl
 
-    from .pallas_merge import _pad_lanes, _pick_block
+    from .pallas_merge import _pick_block
 
     m, q, w = q_sig.shape
-    # Per-row VMEM: q unrolled rounds x ~12 live [blk, W]-lane
-    # temporaries (masks, masked views, popcount intermediates) x 4 B.
-    blk = _pick_block(m, q * 12 * _pad_lanes(w) * 4)
+    blk = _pick_block(m, score_row_bytes(q, w))
 
     def spec(shape):
         return pl.BlockSpec((blk,) + shape,
