@@ -13,6 +13,12 @@ config (≈ 10 full 2048-node Handel runs per wall-second).
 Env overrides for smoke runs: WTPU_BENCH_NODES, WTPU_BENCH_SEEDS,
 WTPU_BENCH_MS; WTPU_BENCH_MODE=cardinal benches the O(N*L) tier-3
 variant (models/handel_cardinal.py) for 100k-class node counts.
+WTPU_FAST_FORWARD=1 swaps the dense scan for the quiet-window
+fast-forwarding engine (core/network.fast_forward_chunk — bit-identical,
+tests/test_fast_forward.py) and reports `skipped_ms`/`jump_count`/
+`skip_rate` so the speedup is attributable.  WTPU_BENCH_PROTO=
+pingpong|dfinity benches the quiet-heavy protocols where skipping, not
+node width, is the lever (skip-rate governs the win — SCALE.md).
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -29,6 +35,39 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _ff_step_wrapper(ff_step):
+    """Adapt a stats-bearing fast-forward chunk ``(nets, ps) -> (nets,
+    ps, stats)`` to the measurement protocol's 2-tuple interface,
+    stashing the per-chunk stats (device arrays — appending forces no
+    sync, so timed reps stay fully async).  `_ff_stats` sums the LAST
+    rep's worth afterwards: the runs are deterministic, so every rep's
+    skip accounting is identical."""
+    def step(nets, ps):
+        nets, ps, st = ff_step(nets, ps)
+        step.ff_stats.append(st)
+        return nets, ps
+
+    step.ff_stats = []
+    return step
+
+
+def _ff_stats(step, steps, chunk_ms):
+    """Skip accounting for the emitted JSON line (empty when the step is
+    not a fast-forward wrapper).  skip_rate is skipped-ms over the
+    per-run simulated span — the quantity that governs the win."""
+    stats = getattr(step, "ff_stats", None)
+    if not stats:
+        return {}
+    tail = stats[-steps:]
+    skipped = sum(int(np.asarray(s["skipped_ms"])) for s in tail)
+    jumps = sum(int(np.asarray(s["jump_count"])) for s in tail)
+    # Batched engines report lockstep-batch skips (one count for all
+    # seeds); the per-run span is steps * chunk either way.
+    return {"fast_forward": True, "skipped_ms": skipped,
+            "jump_count": jumps,
+            "skip_rate": round(skipped / max(1, steps * chunk_ms), 3)}
 
 
 def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
@@ -96,6 +135,13 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
     lcm = getattr(proto, "schedule_lcm", None)
     if os.environ.get("WTPU_BENCH_SPEC") == "0":
         lcm = None
+    # WTPU_FAST_FORWARD=1: the quiet-window while-loop engine replaces
+    # the dense scan AND the static phase hints (the oracle skips the
+    # hint-masked ms dynamically; the two cannot compose — see
+    # network.check_chunk_config).  Bit-identical either way.
+    fast_forward = os.environ.get("WTPU_FAST_FORWARD") == "1"
+    if fast_forward:
+        lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
     donate_big = os.environ.get("WTPU_BENCH_DONATE") == "big"
     # Batched (seed-folded) engine is the default: measured 92.3 vs 81.0
@@ -109,20 +155,43 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         raise ValueError("WTPU_BENCH_BATCHED=1 implies superstep=2 "
                          "(core/batched.py is hard-wired to the fused "
                          "2-ms step)")
+    ff_base = None          # stats-bearing (nets, ps) -> (nets, ps, stats)
     if (env_batched or "1") == "1" and superstep == 2:
         # Seed-folded mailbox machinery (core/batched.py): avoids the
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
         # bit-identical (tests/test_batched.py).
-        from wittgenstein_tpu.core.batched import scan_chunk_batched
-        base = scan_chunk_batched(
-            proto, chunk, t0_mod=t0,
-            # Same-process A/B knob for the plane-ordering barrier
-            # (bit-identical either way; tools/ab_plane_barrier.py).
-            plane_barrier=os.environ.get("WTPU_PLANE_BARRIER", "1") != "0")
+        from wittgenstein_tpu.core.batched import (
+            fast_forward_chunk_batched, scan_chunk_batched)
+        # Same-process A/B knob for the plane-ordering barrier
+        # (bit-identical either way; tools/ab_plane_barrier.py).
+        barrier = os.environ.get("WTPU_PLANE_BARRIER", "1") != "0"
+        if fast_forward:
+            base = ff_base = fast_forward_chunk_batched(
+                proto, chunk, plane_barrier=barrier)
+        else:
+            base = scan_chunk_batched(proto, chunk, t0_mod=t0,
+                                      plane_barrier=barrier)
         step = jax.jit(base)
     else:
-        base = jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
-                                   superstep=superstep))
+        from wittgenstein_tpu.core.network import fast_forward_chunk
+        if fast_forward:
+            if superstep == 2 and env_batched == "0":
+                # The vmapped fast-forward engine advances per-ms: an
+                # explicit SUPERSTEP=2 + BATCHED=0 + FF combination
+                # would silently measure the superstep-1 engine and
+                # mislabel the A/B — refuse loudly (the batched path
+                # keeps the fusion via fast_forward_chunk_batched).
+                raise ValueError(
+                    "WTPU_FAST_FORWARD=1 with WTPU_BENCH_BATCHED=0 "
+                    "runs the per-ms fast-forward engine; set "
+                    "WTPU_BENCH_SUPERSTEP=1 to label it honestly, or "
+                    "drop WTPU_BENCH_BATCHED=0 to keep the fused "
+                    "batched fast-forward engine")
+            base = ff_base = fast_forward_chunk(proto, chunk,
+                                                seed_axis=True)
+        else:
+            base = jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
+                                       superstep=superstep))
         step = jax.jit(base)
     steps = max(1, -(-sim_ms // chunk))
 
@@ -138,6 +207,9 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         from wittgenstein_tpu.core.network import (split_donate_jit,
                                                     split_spec)
         step = split_donate_jit(base, *split_spec(jax.eval_shape(init)))
+
+    if ff_base is not None:
+        step = _ff_step_wrapper(step)
 
     def check(nets, ps):
         done_at = np.asarray(nets.nodes.done_at)
@@ -171,7 +243,9 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     step, init, steps, check = _handel_setup(
         n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
-    return timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
+    res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
+    res.update(_ff_stats(step, steps, chunk))
+    return res
 
 
 def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
@@ -214,7 +288,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     # steps*chunk ms actually simulated per seed (sim_ms rounded up to a
     # whole number of chunks) — same accounting as measure.timed_chunks.
     agg = total_seeds * steps * chunk / wall
-    return {
+    out = {
         "value": round(agg, 1),
         "unit": "sim_ms/s",
         "total_seeds": total_seeds,
@@ -226,6 +300,63 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
         "batch_wall_max_s": round(max(walls), 2),
         "crosscheck": "per_batch_materialization",
     }
+    # All microbatches' chunks (warmup excluded by the tail slice);
+    # skip_rate is then the average across the whole seed sweep.
+    out.update(_ff_stats(step, steps * n_batches, chunk))
+    return out
+
+
+def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
+                reps=3):
+    """Quiet-heavy protocol bench (WTPU_BENCH_PROTO=pingpong|dfinity):
+    the configs where fast-forwarding, not node width, is the lever.
+    PingPong is delivery-driven after t == 0 (every in-flight-latency
+    window skips); Dfinity at the reference round time (3000 ms paced by
+    10 ms ticks) idles between consensus waves.  Same un-fakeable
+    measurement protocol as the Handel headline; `n` sizes PingPong and
+    is ignored by Dfinity (its node count is role-derived).
+
+    With WTPU_FAST_FORWARD=1 the emitted dict carries `skipped_ms` /
+    `jump_count` / `skip_rate` so the speedup is attributable."""
+    from wittgenstein_tpu.core.network import (fast_forward_chunk,
+                                               scan_chunk)
+    from wittgenstein_tpu.utils.measure import timed_chunks
+    fast_forward = os.environ.get("WTPU_FAST_FORWARD") == "1"
+    if proto_name == "pingpong":
+        from wittgenstein_tpu.models.pingpong import PingPong
+        proto = PingPong(node_count=n)
+    elif proto_name == "dfinity":
+        from wittgenstein_tpu.models.dfinity import Dfinity
+        proto = Dfinity()
+    else:
+        raise ValueError(f"unknown WTPU_BENCH_PROTO {proto_name!r}; "
+                         "known: handel pingpong dfinity")
+    if fast_forward:
+        step = _ff_step_wrapper(
+            jax.jit(fast_forward_chunk(proto, chunk, seed_axis=True)))
+    else:
+        step = jax.jit(jax.vmap(scan_chunk(proto, chunk)))
+    steps = max(1, -(-sim_ms // chunk))
+
+    def init(seed0=0):
+        return jax.vmap(proto.init)(
+            seed0 + jnp.arange(seeds, dtype=jnp.int32))
+
+    def check(nets, ps):
+        dropped = int(np.asarray(nets.dropped).sum())
+        bc_dropped = int(np.asarray(nets.bc_dropped).sum())
+        if proto_name == "pingpong":
+            progress = int(np.asarray(ps.pongs).sum())
+        else:
+            progress = int(np.asarray(ps.arena.height).max())
+        assert progress > 0, f"{proto_name} made no progress"
+        return {"progress": progress, "dropped": dropped,
+                "bc_dropped": bc_dropped}
+
+    res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
+    res.update(_ff_stats(step, steps, chunk))
+    res["node_count"] = proto.cfg.n
+    return res
 
 
 def _int_list_env(name, default):
@@ -422,6 +553,13 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if not fallback:
         _probe_ladder_or_fallback()
+    # Persistent compile cache (reports/jax_cache/): post-tunnel-wedge
+    # re-execs and repeated A/Bs stop paying full recompiles.  The
+    # entry-count delta is the honest hit/miss signal for the JSON line.
+    from wittgenstein_tpu.core.harness import (cache_entry_count,
+                                               enable_persistent_cache)
+    cache_dir = enable_persistent_cache()
+    cache_before = cache_entry_count(cache_dir)
     n = _int_env("WTPU_BENCH_NODES", 2048)
     seeds = _int_env("WTPU_BENCH_SEEDS", 16)
     sim_ms = _int_env("WTPU_BENCH_MS", 1000)
@@ -438,8 +576,13 @@ def main():
     # microbatches (the 256-seed path, RunMultipleTimes.java:41-87).
     seed_batch = _int_env("WTPU_BENCH_SEED_BATCH", 16)
     box_split = _int_env("WTPU_BENCH_BOX_SPLIT", 1)
+    proto_sel = os.environ.get("WTPU_BENCH_PROTO", "handel")
     try:
-        if seeds > seed_batch:
+        if proto_sel != "handel":
+            res = bench_quiet(proto_sel, n=n, seeds=seeds, sim_ms=sim_ms,
+                              reps=reps)
+            n = res.pop("node_count")
+        elif seeds > seed_batch:
             res = bench_handel_microbatched(
                 n=n, total_seeds=seeds, seed_batch=seed_batch,
                 sim_ms=sim_ms, mode=mode, horizon=horizon,
@@ -484,16 +627,23 @@ def main():
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
     suffix = "_cpu_fallback" if fallback else ""
-    if mode != "exact":
+    if mode != "exact" and proto_sel == "handel":
         suffix = f"_{mode}{suffix}"
+    if res.get("fast_forward"):
+        suffix = f"_ff{suffix}"
     agg = res.pop("value")
     res.pop("unit", None)
+    cache_new = cache_entry_count(cache_dir) - cache_before
     out = {
-        "metric": f"handel_{n}n_{seeds}seeds_agg_sim_ms_per_sec{suffix}",
+        "metric": f"{proto_sel}_{n}n_{seeds}seeds_agg_sim_ms_per_sec"
+                  f"{suffix}",
         "value": agg,
         "unit": "sim_ms/s",
         "vs_baseline": round(agg / 10_000.0, 3),
         "platform": jax.default_backend(),
+        "compile_cache": ("off" if cache_dir is None else
+                          "hit" if cache_new == 0 else "miss"),
+        "compile_cache_new_entries": cache_new,
         **res,
     }
     if os.environ.get("WTPU_BENCH_DEGRADED_FROM"):

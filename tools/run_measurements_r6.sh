@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-6 on-chip measurement session — run when .tpu_up appears.
+# ORDER IS THE POINT (VERDICT r4 #2): the official bench number is
+# captured FIRST, then the round's A/B (quiet-window fast-forwarding),
+# then the still-queued pallas_score/gsf VMEM cost-model validation
+# from ADVICE r5 item 2.  Frontier probes are NOT here — they run from
+# a separate shell, late in the round, after everything else landed.
+#
+# Usage: nohup bash tools/run_measurements_r6.sh > reports/r6_onchip.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+R=reports
+mkdir -p "$R"
+stamp() { date -u +%H:%M:%S; }
+
+echo "=== r6 on-chip session start $(stamp)"
+
+# 1. OFFICIAL bench, batched default, reps=3 — the BENCH_r06 config.
+#    (First run also warms reports/jax_cache/; every later stage and
+#    any post-wedge re-exec then logs compile_cache=hit.)
+echo "--- [1/6] official 2048x16 $(stamp)"
+timeout 3600 python bench.py 2>&1 | tee "$R/bench_r6_official.log"
+
+# 2. Fast-forward A/B at the official config (same process protocol):
+#    default batched superstep engine vs the quiet-window while-loop
+#    (core/batched.fast_forward_chunk_batched).  The baseline [1/6] IS
+#    the A side; this is the B side.  skipped_ms/jump_count in the JSON
+#    attribute whatever delta shows up.  NOTE: WTPU_FAST_FORWARD=1
+#    disables the static phase hints (the oracle subsumes them
+#    dynamically), so the A/B compares hints-vs-oracle, not oracle-off.
+echo "--- [2/6] fast-forward A/B 2048x16 $(stamp)"
+WTPU_FAST_FORWARD=1 timeout 3600 python bench.py 2>&1 \
+  | tee "$R/bench_r6_ff_handel.log"
+
+# 3. Quiet-heavy fast-forward configs — where skip-rate, not node
+#    count, is the lever (SCALE.md): Dfinity at the reference round
+#    time and PingPong, each off/on.
+echo "--- [3/6] quiet-heavy dfinity + pingpong off/on $(stamp)"
+WTPU_BENCH_PROTO=dfinity WTPU_BENCH_MS=4000 \
+  timeout 1800 python bench.py 2>&1 | tee "$R/bench_r6_dfinity_off.log"
+WTPU_BENCH_PROTO=dfinity WTPU_BENCH_MS=4000 WTPU_FAST_FORWARD=1 \
+  timeout 1800 python bench.py 2>&1 | tee "$R/bench_r6_dfinity_ff.log"
+WTPU_BENCH_PROTO=pingpong WTPU_BENCH_NODES=1024 \
+  timeout 1800 python bench.py 2>&1 | tee "$R/bench_r6_pingpong_off.log"
+WTPU_BENCH_PROTO=pingpong WTPU_BENCH_NODES=1024 WTPU_FAST_FORWARD=1 \
+  timeout 1800 python bench.py 2>&1 | tee "$R/bench_r6_pingpong_ff.log"
+
+# 4. ADVICE r5 item 2 (still queued from the wedged r5 session): the
+#    pallas_score / pallas_gsf_merge VMEM cost models were extrapolated
+#    from the merge kernel's on-chip observation, never validated
+#    through real Mosaic.  The probe first (construct mix fails in
+#    seconds, not the bench hour), then the full-kernel bit-equality +
+#    scoped-VMEM compile check; must print PALLAS_VALIDATE_ALL_OK
+#    before any WTPU_PALLAS=1 number is trusted.
+echo "--- [4/6] pallas probe $(stamp)"
+timeout 1200 python tools/pallas_probe.py 2>&1 \
+  | tee "$R/pallas_probe_r6.log"
+echo "--- [5/6] pallas score/gsf VMEM cost-model validation $(stamp)"
+timeout 2400 python tools/pallas_validate_tpu.py 2>&1 \
+  | tee "$R/pallas_validate_r6.log"
+
+# 6. WTPU_PALLAS=1 bench only if validation printed ALL_OK (a failed
+#    kernel compile ladder is what wedged the r5 tunnel).
+echo "--- [6/6] pallas bench (gated on ALL_OK) $(stamp)"
+if grep -q PALLAS_VALIDATE_ALL_OK "$R/pallas_validate_r6.log"; then
+  WTPU_PALLAS=1 timeout 3600 python bench.py 2>&1 \
+    | tee "$R/bench_r6_pallas.log"
+else
+  echo "pallas validation did not print ALL_OK; skipping the kernel bench"
+fi
+
+echo "=== r6 on-chip session done $(stamp)"

@@ -13,6 +13,12 @@ Checks, per protocol target:
     io_callback, debug_callback, outside_call, host_callback, ...);
   * optimized HLO: no infeed/outfeed/send/recv ops and no custom-call
     to a host-python trampoline target.
+
+The total count of offending constructs is also emitted as the
+budgetable metric ``transfer_ops`` so the ratchet file pins it at 0 per
+target (any occurrence is an error regardless; the budget entry makes
+the zero an explicit, checked-in fact per audited build — including the
+fast-forward while-loop bodies).
 """
 
 from __future__ import annotations
@@ -41,15 +47,18 @@ BAD_HLO_OPS = ("infeed", "outfeed", "send", "send-done", "recv",
 class HostSyncRule(Rule):
     name = "host_sync"
     scope = "protocol"
+    budgeted_metrics = ("transfer_ops",)
 
     def run(self, target, budget):
         findings = []
+        n_bad = 0
         bad_prims = set()
         for j in _iter_jaxprs(target.jaxpr.jaxpr):
             for eqn in j.eqns:
                 if eqn.primitive.name in BAD_PRIMITIVES:
                     bad_prims.add(eqn.primitive.name)
         for p in sorted(bad_prims):
+            n_bad += 1
             findings.append(Finding(
                 rule=self.name, target=target.name, severity="error",
                 message=f"host-callback primitive {p!r} inside the traced "
@@ -60,19 +69,23 @@ class HostSyncRule(Rule):
         for opcode in BAD_HLO_OPS:
             n = len(re.findall(rf"= \S+ {re.escape(opcode)}\(", text))
             if n:
+                n_bad += n
                 findings.append(Finding(
                     rule=self.name, target=target.name, severity="error",
                     message=f"{n} `{opcode}` op(s) in the optimized HLO — "
                             "device/host transfer inside the step"))
         for tgt in sorted(hlo.custom_call_targets(text)):
             if BAD_CUSTOM_CALL_PAT.search(tgt):
+                n_bad += 1
                 findings.append(Finding(
                     rule=self.name, target=target.name, severity="error",
                     message=f"custom-call to host trampoline {tgt!r} in "
                             "the optimized HLO"))
-        if not findings:
-            findings.append(Finding(
-                rule=self.name, target=target.name, severity="info",
-                message="no host callbacks or transfers in the compiled "
-                        "step"))
+        findings.append(Finding(
+            rule=self.name, target=target.name, severity="info",
+            metric="transfer_ops", value=n_bad,
+            message=(f"transfer_ops={n_bad} host callbacks/transfers in "
+                     "the compiled step" if n_bad else
+                     "no host callbacks or transfers in the compiled "
+                     "step (transfer_ops=0)")))
         return findings

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 
 SEEDS = 2       # batched seed axis of every target
 CHUNK = 8       # even, small: one scan, no phase-specialized unroll
@@ -27,18 +26,19 @@ CHUNK = 8       # even, small: one scan, no phase-specialized unroll
 
 def _enable_compile_cache():
     """The persistent XLA compile cache (repo-local, gitignored) — the
-    same setup tests/conftest.py uses; analysis runs are compile-bound
-    on one core and every rerun after the first is ~free."""
+    same setup tests/conftest.py uses, via the ONE shared helper
+    (core/harness.enable_persistent_cache); analysis runs are
+    compile-bound on one core and every rerun after the first is ~free.
+    The test/analysis cache stays at .jax_cache (conftest's location,
+    so CLI and pytest analysis runs share entries); the bench/harness
+    production cache lives under reports/jax_cache/."""
     import pathlib
 
-    import jax
+    from ..core.harness import enable_persistent_cache
 
-    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-        cache = pathlib.Path(__file__).resolve().parent.parent.parent \
-            / ".jax_cache"
-        jax.config.update("jax_compilation_cache_dir", str(cache))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    cache = pathlib.Path(__file__).resolve().parent.parent.parent \
+        / ".jax_cache"
+    enable_persistent_cache(str(cache))
 
 
 def leaf_shape_names(args) -> dict[str, set]:
@@ -243,14 +243,55 @@ def _registry() -> dict:
     }
 
 
+#: Protocols whose quiet-window fast-forward build (the `lax.while_loop`
+#: engine of core/network.fast_forward_chunk) is audited alongside the
+#: dense scan: the four bit-identity-tested opt-ins.  The while body is
+#: a different compiled program — its copies, dtypes and host-sync
+#: profile are gated separately under the "<name>+ff" target names.
+FF_PROTOCOLS = ("Handel", "PingPong", "P2PFlood", "Dfinity")
+
+FF_SUFFIX = "+ff"
+
+
+def _ff_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(FF_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import fast_forward_chunk, fast_forward_ok
+
+        proto = _registry()[base_name]()
+        assert fast_forward_ok(proto), base_name
+        base = fast_forward_chunk(proto, chunk, seed_axis=True)
+
+        def fn(net, pstate):
+            net, pstate, _ = base(net, pstate)
+            return net, pstate
+
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return fn, args, proto, "fast_forward"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 @functools.lru_cache(maxsize=1)
 def target_names() -> tuple:
-    return tuple(sorted(_registry()))
+    return tuple(sorted(_registry()) +
+                 sorted(f"{n}{FF_SUFFIX}" for n in FF_PROTOCOLS))
 
 
 def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
+    if name.endswith(FF_SUFFIX):
+        if name[:-len(FF_SUFFIX)] not in FF_PROTOCOLS:
+            raise KeyError(f"unknown fast-forward target {name!r}; "
+                           f"known: {sorted(f'{n}{FF_SUFFIX}' for n in FF_PROTOCOLS)}")
+        return _ff_target(name)
     if name not in reg:
         raise KeyError(f"unknown analysis target {name!r}; "
-                       f"known: {sorted(reg)}")
+                       f"known: {sorted(target_names())}")
     return AnalysisTarget.from_protocol(name, reg[name])

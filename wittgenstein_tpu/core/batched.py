@@ -26,7 +26,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .network import _route_unicast, superstep_ok
+from .network import (_route_unicast, check_chunk_config, fast_forward_ok,
+                      superstep_ok)
+from .protocol import FAR_FUTURE
 from .state import EngineConfig, Inbox, NetState
 
 
@@ -225,11 +227,97 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
     return net, pstate
 
 
-def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True):
+def _next_work_batched(protocol, net: NetState, pstate, t):
+    """Batched next-event oracle for the seed-folded engine: the MIN
+    over the seed batch of each run's earliest work ms — a window is
+    skipped only when EVERY seed is quiet, which keeps the batch in
+    lockstep (the folded mailbox scatter requires it).  bcast_slots == 0
+    by the engine's precondition, so the oracle is just the mailbox
+    term + the protocol timers (network.next_work's (a) and (c))."""
+    cfg = protocol.cfg
+    far = jnp.int32(FAR_FUTURE)
+    rows = jnp.arange(cfg.horizon, dtype=jnp.int32)
+    row_any = jnp.any(net.box_count > 0, axis=-1)              # [R, H]
+    nxt = jnp.min(jnp.where(row_any, t + (rows[None, :] - t) % cfg.horizon,
+                            far))
+    # next_action_time exists by fast_forward_chunk_batched's
+    # fast_forward_ok precondition — no no-oracle mode here.
+    nat = protocol.next_action_time
+    proto_next = jnp.min(jax.vmap(
+        lambda ps, nd: nat(ps, nd, t))(pstate, net.nodes))
+    return jnp.maximum(jnp.minimum(nxt, proto_next), t).astype(jnp.int32)
+
+
+def fast_forward_chunk_batched(protocol, ms: int, plane_barrier=True):
+    """Quiet-window fast-forwarding for the seed-folded superstep
+    engine: a `lax.while_loop` whose body is one `step_2ms_batched` pass
+    followed by a batch-min oracle jump, floored to EVEN offsets so
+    every loop entry satisfies the fused pair's even-entry-time contract
+    (an odd oracle target lands one quiet ms early — sound, one extra
+    no-op pair at worst).  Bit-identical to `scan_chunk_batched`
+    (tests/test_fast_forward.py); preconditions are the batched engine's
+    plus `network.fast_forward_ok`.  Returns ``run(net, pstate) ->
+    (net, pstate, stats)`` with the same skip accounting as
+    `network.fast_forward_chunk`."""
+    # Shared gate first (spill-free + no phase hints — the remedies live
+    # in network.check_chunk_config), then the batched engine's own
+    # narrower preconditions.
+    check_chunk_config(protocol, ms, fast_forward=True)
+    if (ms % 2 or protocol.cfg.bcast_slots or not superstep_ok(protocol)):
+        raise ValueError("fast_forward_chunk_batched needs an even chunk "
+                         "and a spill-free, broadcast-free, superstep-"
+                         "eligible protocol (core/batched.py scope)")
+    if not fast_forward_ok(protocol):
+        raise ValueError("fast_forward_chunk_batched needs a protocol "
+                         "implementing next_action_time (without it no "
+                         "window is provably quiet and the loop would "
+                         "degenerate to a slower dense scan)")
+
+    def run(net, pstate):
+        t_end = net.time[0] + ms
+
+        def cond(carry):
+            return carry[0].time[0] < t_end
+
+        def body(carry):
+            net, ps, skipped, jumps = carry
+            net, ps = step_2ms_batched(protocol, net, ps,
+                                       plane_barrier=plane_barrier)
+            t1 = net.time[0]
+            nw = jnp.clip(_next_work_batched(protocol, net, ps, t1),
+                          t1, t_end)
+            dt = (nw - t1) - (nw - t1) % 2        # keep entry times even
+            net = net.replace(time=net.time + dt)
+            return (net, ps, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, z, z))
+        return net, pstate, {"skipped_ms": skipped, "jump_count": jumps}
+
+    return run
+
+
+def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True,
+                       fast_forward=False):
     """Batched twin of scan_chunk(superstep=2) for vmap-batched state
     (leaves [R, ...]).  Same phase-specialization contract; chunk must
     be even and a multiple of the (even-adjusted) schedule lcm when
-    t0_mod is given.  `plane_barrier` — see `step_2ms_batched`."""
+    t0_mod is given.  `plane_barrier` — see `step_2ms_batched`.
+    `fast_forward=True` swaps the dense scan for the quiet-window while
+    loop (`fast_forward_chunk_batched`, stats dropped); incompatible
+    with t0_mod for the same reason as `network.scan_chunk`."""
+    if fast_forward:
+        check_chunk_config(protocol, ms, t0_mod=t0_mod, fast_forward=True)
+        base_ff = fast_forward_chunk_batched(protocol, ms,
+                                             plane_barrier=plane_barrier)
+
+        def run_ff(net, pstate):
+            net, pstate, _ = base_ff(net, pstate)
+            return net, pstate
+
+        return run_ff
     if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
             or not superstep_ok(protocol)):
         raise ValueError("scan_chunk_batched needs an even chunk and a "

@@ -24,6 +24,47 @@ from ..utils import stats as stats_mod
 from .network import scan_chunk, superstep_ok
 
 
+def enable_persistent_cache(cache_dir=None):
+    """Enable JAX's persistent compilation cache (default:
+    ``reports/jax_cache/``, repo-local and gitignored) so
+    post-tunnel-wedge re-execs and repeated A/B runs stop paying full
+    recompiles — the bench's recovery ladder re-execs a fresh process
+    per retry, and every retry used to recompile everything.
+
+    Respects an existing configuration: a caller (tests/conftest.py,
+    analysis/targets.py) or the JAX_COMPILATION_CACHE_DIR env var —
+    which JAX itself mirrors into `jax_compilation_cache_dir`, so no
+    ambient read happens here — wins; the env var set to "" disables
+    caching entirely.  Returns the cache directory in effect (None when
+    disabled)."""
+    import pathlib
+
+    # Cache-everything thresholds apply regardless of who picked the
+    # directory (the defaults skip fast-compiling programs, which is
+    # most of a small-config suite/bench).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    existing = jax.config.jax_compilation_cache_dir
+    if existing is not None:            # env var or an earlier caller
+        return existing or None         # "" = explicitly disabled
+    if cache_dir is None:
+        cache_dir = str(pathlib.Path(__file__).resolve().parents[2]
+                        / "reports" / "jax_cache")
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    return str(cache_dir)
+
+
+def cache_entry_count(cache_dir) -> int:
+    """Number of entries currently in the persistent compile cache —
+    sampled before/after a compile, the delta is the honest hit/miss
+    signal the bench logs (JAX exposes no per-lookup counter)."""
+    import os
+
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    return sum(len(files) for _, _, files in os.walk(cache_dir))
+
+
 def cont_until_done(net, pstate):
     """RunMultipleTimes.contUntilDone (:90-97): continue while any live node
     has doneAt == 0."""
@@ -147,6 +188,10 @@ class _BatchDriver:
 
     def __init__(self, protocol, run_count, chunk, cont_if, first_seed,
                  fail_on_drop, where, devices=None, mesh=None):
+        # Repeated experiment sweeps recompile the same chunk programs;
+        # the persistent cache makes every run after the first ~free
+        # (no-op when a caller/env already configured or disabled it).
+        enable_persistent_cache()
         self.cont = cont_if or cont_until_done
         self.seeds = jnp.arange(first_seed, first_seed + run_count,
                                 dtype=jnp.int32)

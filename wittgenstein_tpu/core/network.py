@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from ..ops import prng
 from .latency import full_latency
+from .protocol import FAR_FUTURE
 from .state import EngineConfig, Inbox, NetState, Outbox
 
 
@@ -543,8 +544,193 @@ def superstep_ok(protocol) -> bool:
             and not getattr(protocol, "mutates_liveness", False))
 
 
+def fast_forward_ok(protocol) -> bool:
+    """True iff the quiet-window fast-forward path is worth taking for
+    this protocol: spill-free (the spill drain is inherently per-ms,
+    same constraint as `superstep_ok`) and the protocol implements the
+    `next_action_time` oracle half (core/protocol.py).  Without the
+    method `fast_forward_chunk` is still SOUND — the engine then treats
+    every ms as active — but it never jumps, so callers gate on this."""
+    return (protocol.cfg.spill_cap == 0 and
+            getattr(protocol, "next_action_time", None) is not None)
+
+
+def check_chunk_config(protocol, ms, t0_mod=None, superstep=1,
+                       fast_forward=False):
+    """The shared eligibility gate for the engine chunk variants — plain
+    scan, fused superstep=2, phase-specialized, fast-forward.
+    `scan_chunk` and the fast-forward builders (including the batched
+    ones) route through it so each shared constraint and its remedy are
+    stated in one place; the batched engine layers its own narrower
+    preconditions (broadcast-free, even chunk) on top."""
+    cfg = protocol.cfg
+    if superstep not in (1, 2):
+        raise ValueError(f"superstep must be 1 or 2, got {superstep}")
+    if fast_forward:
+        if t0_mod is not None:
+            raise ValueError(
+                "fast_forward is incompatible with phase-specialized "
+                "scans (t0_mod): phase hints statically specialize each "
+                "ms of an unrolled schedule period, while fast-forward "
+                "jumps the clock dynamically — the hint<->time pairing "
+                "cannot survive a data-dependent jump. Drop t0_mod (the "
+                "oracle already skips the hint-masked quiet ms, "
+                "including data-dependent ones hints cannot see)")
+        if cfg.spill_cap > 0:
+            raise ValueError(
+                f"fast_forward requires spill_cap == 0 (got "
+                f"{cfg.spill_cap}): the spill drain re-examines the "
+                "buffer every ms, so a skipped window could miss a "
+                "re-injection. Use a horizon that covers the latency "
+                "tail instead of spill, or run without fast_forward")
+    if superstep == 2:
+        if fast_forward:
+            raise ValueError(
+                "fast_forward + superstep=2 is not supported in "
+                "scan_chunk (the fused pair would straddle jump "
+                "targets); use core/batched.fast_forward_chunk_batched "
+                "for the fused+fast-forward engine, or superstep=1 here")
+        # step_2ms preconditions (see its docstring).  Entry-time
+        # evenness cannot be checked statically for t0_mod=None callers;
+        # every in-tree driver enters at an even time (init time=0, even
+        # chunks), and the phase-specialized path checks t0_mod below.
+        if not superstep_ok(protocol) or ms % 2:
+            raise ValueError(
+                f"superstep=2 needs spill_cap == 0 (got {cfg.spill_cap}), "
+                f"an even horizon (got {cfg.horizon}), an even chunk "
+                f"(got {ms}), and a protocol whose step() does not mutate "
+                "node liveness (the second ms's inbox is built before the "
+                "first ms's step runs). Fix: make the chunk length even "
+                "(or pad the horizon to even), or fall back to "
+                "superstep=1 for this protocol/config")
+        if t0_mod is not None and t0_mod % 2:
+            raise ValueError(
+                f"superstep=2 needs an even entry time (t0_mod={t0_mod})."
+                " Fix: enter on an even chunk boundary (an even t0_mod — "
+                "in-tree drivers start at time 0 and use even chunks; "
+                "burn one odd-length superstep=1 chunk first to realign),"
+                " or keep superstep=1 for this chunk. (allow_unaligned "
+                "only relaxes the schedule-lcm length check, not entry "
+                "parity — it cannot fix this one.)")
+
+
+def next_work(protocol, net: NetState, pstate, t):
+    """The next-event oracle: the earliest absolute ms >= t that can
+    contain work, computed entirely on-device.  Min over
+
+      (a) the next nonempty mailbox ring row — `box_count` is indexed by
+          absolute-time-mod-horizon, and with ``spill_cap == 0`` every
+          in-flight unicast lives in the ring, so a row with a nonzero
+          count IS a pending delivery at ``t + ((row - t) % horizon)``;
+      (b) the earliest live broadcast arrival >= t — recomputed exactly
+          per (record, dest), the same stateless-latency trick as
+          delivery (`broadcast_arrivals`); conservative only in keeping
+          arrivals to down/irrelevant destinations (an under-jump, never
+          an over-jump);
+      (c) the protocol's `next_action_time(pstate, nodes, t)` timers —
+          protocols without the method declare every ms active.
+
+    Soundness contract (tests/test_fast_forward.py): every ms in
+    ``[t, next_work)`` is bit-identical to a no-op step — empty inbox,
+    no timer, `protocol.step` is the identity and emits nothing — so
+    `fast_forward_chunk` may jump straight to the returned time.
+    """
+    cfg, model = protocol.cfg, protocol.latency
+    far = jnp.int32(FAR_FUTURE)
+    rows = jnp.arange(cfg.horizon, dtype=jnp.int32)
+    row_any = jnp.any(net.box_count > 0, axis=-1)            # [H]
+    nxt = jnp.min(jnp.where(row_any, t + (rows - t) % cfg.horizon, far))
+    if cfg.bcast_slots > 0:
+        # NOT redundant with the recompute build_inbox did this ms: the
+        # oracle runs on the POST-step table — the step may have
+        # enqueued new broadcasts or retired old ones, and reusing the
+        # pre-step arrivals could miss a new record's arrival and
+        # over-jump (the one failure mode the contract forbids).
+        arrival, ok, _ = broadcast_arrivals(cfg, model, net, net.nodes)
+        nxt = jnp.minimum(
+            nxt, jnp.min(jnp.where(ok & (arrival >= t), arrival, far)))
+    nat = getattr(protocol, "next_action_time", None)
+    proto_next = t if nat is None else nat(pstate, net.nodes, t)
+    return jnp.maximum(jnp.minimum(nxt, proto_next), t).astype(jnp.int32)
+
+
+def _jump(cfg: EngineConfig, net: NetState, dt, t2):
+    """Fast-forward `dt` provably-quiet milliseconds to absolute time
+    `t2` in one hop.  Only time-translation-trivial state moves: the
+    clock (which IS the ring head — rows are indexed by time % horizon,
+    and every skipped row is empty by the oracle's guarantee) and
+    broadcast retirement.  Retirement must match the per-ms path
+    bit-for-bit: after per-ms steps t..t2-1 the last retire ran at
+    t2-1, and retirement is monotone in t, so one retire at t2-1
+    reproduces the whole sequence (idempotent when dt == 0)."""
+    if cfg.bcast_slots > 0:
+        net = net.replace(bc_active=net.bc_active &
+                          ((t2 - 1 - net.bc_time) < cfg.horizon))
+    return net.replace(time=net.time + dt)
+
+
+def fast_forward_chunk(protocol, ms: int, seed_axis: bool = False):
+    """Quiet-window fast-forwarding chunk: advance exactly `ms`
+    simulated milliseconds as one `lax.while_loop` that runs a full
+    `step_ms` body only on milliseconds that can contain work and jumps
+    the clock by ``next_work - t`` across provably-quiet windows — the
+    compiled-engine recovery of the reference's event-driven main loop
+    (Network.java receiveUntil/nextMessage :533-637), which never pays
+    for an empty ms.  Bit-identical to the per-ms `scan_chunk`
+    (tests/test_fast_forward.py) because a skipped ms is exactly a
+    no-op step body.
+
+    ``seed_axis=True`` operates on vmap-batched state (leading [R] axis
+    on every leaf, lockstep times — the bench/harness batch layout):
+    ONE while loop whose body vmaps `step_ms` over the batch and jumps
+    by the MIN of the per-seed oracles, so the whole batch stays in
+    lockstep and a window is skipped only when every seed is quiet.
+
+    Returns ``run(net, pstate) -> (net, pstate, stats)`` with
+    ``stats = {"skipped_ms": int32, "jump_count": int32}`` — the skip
+    accounting that makes a fast-forward speedup attributable
+    (`bench.py` reports both).  `scan_chunk(fast_forward=True)` wraps
+    this and drops the stats for interface-compatible callers.
+    """
+    check_chunk_config(protocol, ms, fast_forward=True)
+    cfg = protocol.cfg
+
+    def run(net, pstate):
+        t0 = net.time[0] if seed_axis else net.time
+        t_end = t0 + ms
+
+        def cond(carry):
+            t = carry[0].time[0] if seed_axis else carry[0].time
+            return t < t_end
+
+        def body(carry):
+            net, ps, skipped, jumps = carry
+            if seed_axis:
+                net, ps = jax.vmap(
+                    lambda n_, p_: step_ms(protocol, n_, p_))(net, ps)
+                t1 = net.time[0]
+                nw = jnp.min(jax.vmap(
+                    lambda n_, p_: next_work(protocol, n_, p_, t1))(
+                    net, ps))
+            else:
+                net, ps = step_ms(protocol, net, ps)
+                t1 = net.time
+                nw = next_work(protocol, net, ps, t1)
+            nw = jnp.clip(nw, t1, t_end)
+            net = _jump(cfg, net, nw - t1, nw)
+            return (net, ps, skipped + (nw - t1),
+                    jumps + (nw > t1).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, z, z))
+        return net, pstate, {"skipped_ms": skipped, "jump_count": jumps}
+
+    return run
+
+
 def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
-               superstep: int = 1):
+               superstep: int = 1, fast_forward: bool = False):
     """Returns ``run(net, pstate) -> (net, pstate)`` advancing `ms`
     milliseconds as one `lax.scan` — the single shared chunk body used by
     `Runner`, the harness, and the sharded runner.
@@ -575,25 +761,23 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
     A deliberately unaligned one-shot chunk may pass
     ``allow_unaligned=True`` (the sub-lcm tail is unrolled after the
     block scan); the next chunk's t0_mod is then ``(t0_mod + ms) % lcm``.
+
+    ``fast_forward=True`` swaps the dense scan for the quiet-window
+    `lax.while_loop` engine (`fast_forward_chunk` — bit-identical,
+    tests/test_fast_forward.py), dropping the skip statistics; callers
+    that want them use `fast_forward_chunk` directly.  Incompatible with
+    `t0_mod`/`superstep=2` (see `check_chunk_config` for the remedies).
     """
-    if superstep not in (1, 2):
-        raise ValueError(f"superstep must be 1 or 2, got {superstep}")
-    if superstep == 2:
-        # step_2ms preconditions (see its docstring).  Entry-time evenness
-        # cannot be checked statically for t0_mod=None callers; every
-        # in-tree driver enters at an even time (init time=0, even
-        # chunks), and the phase-specialized path checks t0_mod below.
-        cfg = protocol.cfg
-        if not superstep_ok(protocol) or ms % 2:
-            raise ValueError(
-                f"superstep=2 needs spill_cap == 0 (got {cfg.spill_cap}), "
-                f"an even horizon (got {cfg.horizon}), an even chunk "
-                f"(got {ms}), and a protocol whose step() does not mutate "
-                "node liveness (the second ms's inbox is built before the "
-                "first ms's step runs)")
-        if t0_mod is not None and t0_mod % 2:
-            raise ValueError(f"superstep=2 needs an even entry time "
-                             f"(t0_mod={t0_mod})")
+    check_chunk_config(protocol, ms, t0_mod=t0_mod, superstep=superstep,
+                       fast_forward=fast_forward)
+    if fast_forward:
+        base_ff = fast_forward_chunk(protocol, ms)
+
+        def run_ff(net, pstate):
+            net, pstate, _ = base_ff(net, pstate)
+            return net, pstate
+
+        return run_ff
     lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
         else None
     if lcm and superstep == 2 and lcm % 2:

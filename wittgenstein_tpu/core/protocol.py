@@ -12,7 +12,19 @@ Here a protocol is a *pure description*:
     way HandelTest.java:14-34 tests it);
   - ``step(pstate, nodes, inbox, t, key) -> (pstate, nodes, outbox)`` is the
     per-ms transition for ALL nodes at once — the vectorized replacement for
-    every Message.action + registered task of the reference.
+    every Message.action + registered task of the reference;
+  - OPTIONAL ``next_action_time(pstate, nodes, t) -> int32`` is the
+    protocol's half of the quiet-window oracle (core/network.next_work):
+    the earliest absolute ms ``u >= t`` at which ``step`` with an EMPTY
+    inbox might not be the identity on ``(pstate, nodes)`` — pending
+    verification completions, periodic dissemination/round/resend
+    timers, queued sends, one-shot start kicks.  The contract is
+    one-sided: returning too EARLY only costs skipped-ms opportunity;
+    returning later than a real action would silently change results,
+    so when in doubt return ``t``.  ``FAR_FUTURE`` means "no timer at
+    all — purely delivery-driven from here".  Protocols without the
+    method declare every ms active (fast-forward then degenerates to
+    the plain per-ms scan).
 
 Protocols register themselves by class name so the scenario harness and the
 REST server can look them up by string, mirroring the wserver's classpath
@@ -21,7 +33,31 @@ scan (wserver/Server.java:56-70).
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .state import EngineConfig  # noqa: F401  (re-export for implementors)
+
+#: `next_action_time` sentinel for "no timer pending".  1 << 30 (not
+#: INT32_MAX) so the engine can add small offsets without overflow.
+FAR_FUTURE = 1 << 30
+
+
+def next_tick(t, phase, period):
+    """Earliest ``u >= max(t, phase)`` with ``(u - phase) % period == 0``
+    — the shared periodic-timer primitive for `next_action_time`
+    implementations.  Element-wise over broadcastable int32 arrays;
+    ``period`` is clamped to >= 1."""
+    period = jnp.maximum(jnp.asarray(period, jnp.int32), 1)
+    base = jnp.maximum(jnp.asarray(t, jnp.int32),
+                       jnp.asarray(phase, jnp.int32))
+    return base + (phase - base) % period
+
+
+def masked_min(values, mask):
+    """Min of `values` where `mask`, else FAR_FUTURE (int32 scalar)."""
+    return jnp.min(jnp.where(mask, values,
+                             jnp.int32(FAR_FUTURE))).astype(jnp.int32)
+
 
 PROTOCOLS: dict[str, type] = {}
 

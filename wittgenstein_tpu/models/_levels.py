@@ -50,6 +50,52 @@ class StaticScheduleMixin:
         return {"verify": (tmod - 1) % max(1, self.pairing_time) == 0,
                 "periodic": (tmod - 1) % max(1, self.period) == 0}
 
+    def next_action_time(self, pstate, nodes, t):
+        """Quiet-window oracle half (core/protocol.py contract), shared
+        by the Handel variants: the earliest ms at which any node's
+        timers can act — an in-flight verification applying at
+        ``pend_at``, the next pairing tick of a node with a non-empty
+        verification queue, the next dissemination-period tick of any
+        started live node (pos/extra-cycle bookkeeping advances even
+        for done nodes), a queued fast-path send (drains immediately),
+        or the bounded-queue compaction the ms after a pick leaves a
+        hole.  Unlike `phase_hints` this is fully dynamic: it honours
+        per-node desynchronized starts and speed-scaled pairing times,
+        and sees data-dependent idleness (drained queues, finished
+        runs) that no static schedule can."""
+        from ..core.protocol import masked_min, next_tick
+
+        if getattr(self, "byzantine_suicide", False) or \
+                getattr(self, "hidden_byzantine", False):
+            # The attack paths scan window state on every pick tick and
+            # plant queue entries outside the delivery flow — the
+            # quiet-ms identity argument does not cover them, so declare
+            # every ms active (sound: fast-forward just never jumps).
+            return jnp.asarray(t, jnp.int32)
+        live = ~nodes.down
+        start = pstate.start_at + 1
+        pend = masked_min(jnp.maximum(pstate.pend_at, t),
+                          live & (pstate.pend_from >= 0))
+        filled = pstate.q_from >= 0
+        pick = masked_min(next_tick(t, start, pstate.pairing),
+                          live & (pstate.pend_from < 0) &
+                          jnp.any(filled, axis=1))
+        # The shared bounded-queue merge (merge_bounded_queue) re-sorts
+        # the queue EVERY executed ms; that is the identity only while
+        # the queue is hole-free (valid entries form a rank-sorted
+        # prefix).  A pick/curation can leave a hole mid-queue, and the
+        # very next ms compacts it — a real state change the oracle
+        # must not skip.
+        hole_before_valid = jnp.any(
+            (jnp.cumsum((~filled).astype(jnp.int32), axis=1) > 0) & filled,
+            axis=1)
+        compact = masked_min(t, hole_before_valid)
+        per = masked_min(next_tick(t, start, self.period), live)
+        fast = masked_min(jnp.maximum(start, t),
+                          live & (pstate.fast_pending != 0))
+        return jnp.minimum(jnp.minimum(pend, pick),
+                           jnp.minimum(jnp.minimum(per, fast), compact))
+
 
 def keyed_level_peer(seed, tag, ids, level, pos):
     """The `pos`-th peer of `ids` at `level` under a keyed bijective

@@ -170,6 +170,15 @@ class _AvalancheBase:
                            decided=p2.decided | decided),
                 nodes, out)
 
+    def next_action_time(self, p, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): the only timer
+        is the two seeded nodes' first query at t == 0; re-queries fire
+        on the ms a query completes (an answer arrival — the mailbox
+        oracle's territory), so the protocol is event-driven and every
+        in-flight-latency window is skippable."""
+        from ..core.protocol import FAR_FUTURE
+        return jnp.where(t <= 0, 0, FAR_FUTURE).astype(jnp.int32)
+
     def colors(self, p):
         return p.color
 

@@ -516,6 +516,32 @@ class Dfinity:
             bcast_size=jnp.ones((n,), jnp.int32))
         return p, nodes, out
 
+    def next_action_time(self, p: DfinityState, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): Dfinity's step
+        acts only on deliveries (the engine oracle's territory), the
+        t == 1 beacon kick, proposal builds maturing at ``q_prop_at``,
+        beacon exchanges maturing at ``q_exch_at``, and queued sends
+        (proposals, votes, block/beacon broadcasts) which drain one
+        batch per tick.  Majority checks fire on the tick the deciding
+        delivery lands, so they never pin a quiet ms.  Between round
+        waves (roundTime = 3000 ms paced by tick_ms) the chain is
+        genuinely idle — the quiet-heavy regime fast-forward targets."""
+        from ..core.protocol import masked_min
+        alive = ~nodes.down
+        _, _, rb = self._roles()
+        kick = masked_min(1, alive & rb & (p.rb_last_sent == 0) & (t <= 1))
+        build = masked_min(jnp.maximum(p.q_prop_at, t),
+                           alive & (p.q_prop == -2))
+        exch = masked_min(jnp.maximum(p.q_exch_at, t),
+                          alive & (p.q_exch_h >= 0))
+        imm = alive & ((p.q_prop >= 0) |
+                       jnp.any(p.q_vote != 0, axis=1) |
+                       (p.q_rb_h >= 0) |
+                       jnp.any(p.q_bcast_blk != 0, axis=1))
+        queued = masked_min(t, imm)
+        return jnp.minimum(jnp.minimum(kick, build),
+                           jnp.minimum(exch, queued))
+
 
 def _mask_blocks(h_match, capacity):
     """Pack an [N, A] bool into [N, Aw] words."""

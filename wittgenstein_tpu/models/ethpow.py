@@ -571,6 +571,21 @@ class ETHPoW:
             bcast_size=jnp.ones((n,), jnp.int32))
         return p, nodes, out
 
+    def next_action_time(self, p: PoWState, nodes, t):
+        """Quiet-window oracle half (core/protocol.py).  Mining is a
+        FRESH per-tick Bernoulli draw keyed on t (mine10ms :118-129) —
+        skipping a tick would drop a draw from the stream and change
+        every subsequent block arrival, so any live miner pins every
+        tick (a geometric-jump rewrite would be faster but not
+        bit-identical; deliberately not done).  Only miner-free windows
+        are skippable: observer-only configs, and the drain of queued
+        block broadcasts after all miners go down — then block arrivals
+        ride the engine's broadcast-oracle term alone."""
+        from ..core.protocol import FAR_FUTURE
+        mining = jnp.any((~nodes.down) & (p.hash_power > 0))
+        queued = jnp.any(p.release != 0)
+        return jnp.where(mining | queued, t, FAR_FUTURE).astype(jnp.int32)
+
 
 # ------------------------------------------------------------- host stats
 

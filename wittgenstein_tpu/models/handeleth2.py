@@ -543,3 +543,26 @@ class HandelEth2(LevelMixin):
                                              size=sizes)
         return p.replace(contacted=contacted, cycle=cycle, pos=pos,
                          fast_pending=fast_pending), out
+
+    def next_action_time(self, p: HandelEth2State, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): the aggregation
+        lifecycle tick every PERIOD_TIME from each node's start delta, a
+        pending verification applying at ``pend_at``, the next pairing
+        tick of a node with a non-empty queue (an empty-queue verify
+        tick is the identity), the dissemination-period tick of nodes
+        with a live aggregation, and queued fast-path sends (drain one
+        level per tick).  Fully dynamic — honours desynchronized starts
+        and speed-scaled pairing, like the Handel mixin oracle."""
+        from ..core.protocol import masked_min, next_tick
+        live = ~nodes.down
+        born = masked_min(next_tick(t, p.start_delta + 1, PERIOD_TIME),
+                          live)
+        pend = masked_min(jnp.maximum(p.pend_at, t), live & p.pend_on)
+        pick = masked_min(next_tick(t, 1, p.pairing),
+                          live & ~p.pend_on &
+                          jnp.any(p.q_from >= 0, axis=1))
+        per = masked_min(next_tick(t, 1, self.period),
+                         live & jnp.any(p.active, axis=1))
+        fast = masked_min(t, live & jnp.any(p.fast_pending > 0, axis=1))
+        return jnp.minimum(jnp.minimum(born, pend),
+                           jnp.minimum(pick, jnp.minimum(per, fast)))

@@ -157,3 +157,14 @@ class P2PFlood:
         return (pstate.replace(received=received, pending=pending,
                                pending_src=pending_src),
                 nodes, out)
+
+    def next_action_time(self, pstate, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): a node with a
+        pending flood forwards one message id THIS ms (the resend/
+        stagger delays ride in the outbox `delay` field, so the sends
+        themselves sit in the mailbox ring — the engine oracle's
+        territory); with no pending forwards anywhere, the next event is
+        an arrival.  t == 0 is pinned for the initial-senders kick."""
+        from ..core.protocol import FAR_FUTURE
+        act_now = jnp.any(pstate.pending) | (t <= 0)
+        return jnp.where(act_now, t, FAR_FUTURE).astype(jnp.int32)

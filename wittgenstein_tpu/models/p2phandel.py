@@ -333,6 +333,30 @@ class P2PHandel:
                           pend_on=pend_on),
                 nodes, out)
 
+    def next_action_time(self, p: P2PHandelState, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): verification
+        completions at ``pend_at`` (either pipeline slot), the next
+        checkSigs pairing tick of an undone node with material to verify
+        (accumulator or queue non-empty — an empty checkSigs tick is the
+        identity) and a free pipeline slot, the periodic sendSigs tick
+        of undone nodes, and the t == 1 state-broadcast kick when
+        sendState is on.  With pairingTime 100 and sigsSendPeriod 1000
+        (the reference defaults) almost every ms between timer
+        boundaries is skippable."""
+        from ..core.protocol import FAR_FUTURE, masked_min, next_tick
+        live = ~nodes.down
+        undone = live & (nodes.done_at == 0)
+        pend = masked_min(jnp.maximum(p.pend_at, t), p.pend_on)
+        has_free = ~jnp.all(p.pend_on, axis=1)
+        material = p.has_acc | jnp.any(p.q_used, axis=1)
+        pick = masked_min(next_tick(t, 1, self.pairing_time),
+                          undone & has_free & material)
+        per = masked_min(next_tick(t, 1, self.period), undone)
+        kick = masked_min(1, live & (t <= 1)) if self.send_state \
+            else jnp.int32(FAR_FUTURE)
+        return jnp.minimum(jnp.minimum(pend, pick),
+                           jnp.minimum(per, kick)).astype(jnp.int32)
+
 
 def cont_if_p2phandel(net, pstate):
     live = ~net.nodes.down

@@ -84,5 +84,14 @@ class PingPong:
         nodes = nodes.replace(done_at=done_at.astype(jnp.int32))
         return pstate, nodes, out
 
+    def next_action_time(self, pstate, nodes, t):
+        """Quiet-window oracle half (core/protocol.py): PingPong's only
+        timer is the witness's sendAll(Ping) at t == 0 — everything
+        after is purely delivery-driven (pong replies and the pong
+        counter fire on arrival ms, which the engine's mailbox/broadcast
+        oracle terms already see), so most of a run is skippable."""
+        from ..core.protocol import FAR_FUTURE
+        return jnp.where(t <= 0, 0, FAR_FUTURE).astype(jnp.int32)
+
     def done(self, pstate, nodes):
         return pstate.pongs >= self.node_count
