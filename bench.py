@@ -19,6 +19,10 @@ tests/test_fast_forward.py) and reports `skipped_ms`/`jump_count`/
 `skip_rate` so the speedup is attributable.  WTPU_BENCH_PROTO=
 pingpong|dfinity benches the quiet-heavy protocols where skipping, not
 node width, is the lever (skip-rate governs the win — SCALE.md).
+Every emitted line carries an `engine_metrics` block (wittgenstein_tpu/
+obs — on-device per-interval telemetry from an un-timed bit-identical
+instrumented pass; schema in BENCH_NOTES.md).  WTPU_METRICS=0 skips it;
+WTPU_METRICS_EACH_MS / WTPU_METRICS_SEEDS size it.
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -70,10 +74,69 @@ def _ff_stats(step, steps, chunk_ms):
             "skip_rate": round(skipped / max(1, steps * chunk_ms), 3)}
 
 
+def _collect_engine_metrics(proto, seeds, total_ms, fast_forward=False):
+    """Un-timed instrumented pass for the JSON line's `engine_metrics`
+    block (wittgenstein_tpu/obs; schema in BENCH_NOTES.md).
+
+    Runs AFTER the timed reps so the measured hot path stays exactly
+    the uninstrumented engine (the `metrics_zero_cost` analysis rule
+    pins that the OFF build carries no residue); the instrumented pass
+    is bit-identical on the simulation trajectory (tests/test_obs.py),
+    so the block describes the same runs the bench timed.  Engine
+    dispatch mirrors the bench (batched seed-folded when eligible, else
+    vmapped per-ms; fast-forward twins under WTPU_FAST_FORWARD=1).
+    WTPU_METRICS=0 skips the pass; WTPU_METRICS_EACH_MS /
+    WTPU_METRICS_SEEDS size it.  Never raises: a failed pass reports
+    itself in the block instead of killing the metric line."""
+    try:
+        from wittgenstein_tpu.obs import (MetricsFrame, MetricsSpec,
+                                          engine_metrics_block)
+        from wittgenstein_tpu.obs import engine as obs_engine
+
+        each = _int_env("WTPU_METRICS_EACH_MS",
+                        max(2, (total_ms // 10) & ~1))
+        spec = MetricsSpec(stat_each_ms=each + (each % 2))
+        mseeds = min(seeds, _int_env("WTPU_METRICS_SEEDS", 4))
+        ms = total_ms + (total_ms % 2)
+        nets, ps = jax.vmap(proto.init)(
+            jnp.arange(mseeds, dtype=jnp.int32))
+        try:
+            if fast_forward:
+                run = jax.jit(obs_engine.fast_forward_chunk_batched_metrics(
+                    proto, ms, spec))
+            else:
+                run = jax.jit(obs_engine.scan_chunk_batched_metrics(
+                    proto, ms, spec))
+        except ValueError:
+            from wittgenstein_tpu.core.network import fast_forward_ok
+            if fast_forward and fast_forward_ok(proto):
+                run = jax.jit(obs_engine.fast_forward_chunk_metrics(
+                    proto, ms, spec, seed_axis=True))
+            else:
+                run = jax.jit(jax.vmap(obs_engine.scan_chunk_metrics(
+                    proto, ms, spec)))
+        out = run(nets, ps)
+        mc = out[-1]
+        frame = MetricsFrame.from_carry(spec, mc)
+        return engine_metrics_block(frame,
+                                    extra={"metrics_seeds": mseeds})
+    except Exception as e:      # noqa: BLE001 — the bench line must emit
+        print(f"bench: engine-metrics pass failed: {type(e).__name__}: "
+              f"{e!s:.300}", file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e!s:.200}"}
+
+
+def _maybe_engine_metrics(res, proto, seeds, total_ms, fast_forward=False):
+    if os.environ.get("WTPU_METRICS", "1") != "0":
+        res["engine_metrics"] = _collect_engine_metrics(
+            proto, seeds, total_ms, fast_forward=fast_forward)
+    return res
+
+
 def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
                   superstep, box_split=1):
-    """Build the benchmark's (step, init, steps, check) quadruple for the
-    reference default Handel scenario."""
+    """Build the benchmark's (step, init, steps, check, proto) tuple for
+    the reference default Handel scenario."""
     import dataclasses
 
     from wittgenstein_tpu.core.network import scan_chunk
@@ -225,7 +288,7 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         assert evicted == 0   # queue never overflowed
         return {}
 
-    return step, init, steps, check
+    return step, init, steps, check, proto
 
 
 def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
@@ -240,12 +303,14 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     Returns a result dict (rate + provenance), not a bare float.
     """
     from wittgenstein_tpu.utils.measure import timed_chunks
-    step, init, steps, check = _handel_setup(
+    step, init, steps, check, proto = _handel_setup(
         n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res.update(_ff_stats(step, steps, chunk))
-    return res
+    return _maybe_engine_metrics(
+        res, proto, seeds, steps * chunk,
+        fast_forward=os.environ.get("WTPU_FAST_FORWARD") == "1")
 
 
 def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
@@ -266,7 +331,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     import time
     assert total_seeds % seed_batch == 0
     n_batches = total_seeds // seed_batch
-    step, init, steps, check = _handel_setup(
+    step, init, steps, check, proto = _handel_setup(
         n, seed_batch, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
 
@@ -303,7 +368,11 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     # All microbatches' chunks (warmup excluded by the tail slice);
     # skip_rate is then the average across the whole seed sweep.
     out.update(_ff_stats(step, steps * n_batches, chunk))
-    return out
+    # One microbatch's worth of engine metrics (runs are deterministic
+    # per seed; the first batch is representative of the sweep).
+    return _maybe_engine_metrics(
+        out, proto, seed_batch, steps * chunk,
+        fast_forward=os.environ.get("WTPU_FAST_FORWARD") == "1")
 
 
 def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
@@ -356,7 +425,8 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res.update(_ff_stats(step, steps, chunk))
     res["node_count"] = proto.cfg.n
-    return res
+    return _maybe_engine_metrics(res, proto, seeds, steps * chunk,
+                                 fast_forward=fast_forward)
 
 
 def _int_list_env(name, default):

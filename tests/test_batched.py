@@ -97,6 +97,8 @@ def test_batched_rejects_broadcast_protocols():
         scan_chunk_batched(PingPong(node_count=64), 40)
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 32 s; pallas-in-engine equality stays via test_gsf_pallas_merge_bit_equal
 def test_batched_with_pallas_merge():
     """The batched engine composed with the fused Pallas delivery-merge
     kernel — the exact combination the on-chip bench session runs

@@ -68,8 +68,12 @@ def test_attestation_endorses_ancestors():
         assert not (anc[a, h // 32] >> (h % 32) & 1)
 
 
-@pytest.mark.parametrize("kind", ["ByzBlockProducer", "ByzBlockProducerSF",
-                                  "ByzBlockProducerNS"])
+# tier-1 budget (reports/TIER1_DURATIONS.md): ~20 s per variant and the
+# three exercise the same step machinery — one stays fast, two go slow.
+@pytest.mark.parametrize("kind", [
+    "ByzBlockProducer",
+    pytest.param("ByzBlockProducerSF", marks=pytest.mark.slow),
+    pytest.param("ByzBlockProducerNS", marks=pytest.mark.slow)])
 def test_byz_variants_run(kind):
     p = make(byz_kind=kind, byz_delay=1000 if kind == "ByzBlockProducer"
              else 0)

@@ -27,6 +27,8 @@ def test_slush_converges():
     assert (np.asarray(net.nodes.done_at) > 0).all()
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 50 s; test_slush_converges keeps the Avalanche family fast-gated
 def test_snowflake_converges_with_confidence():
     proto = Snowflake(node_count=100, k=7, beta=3)
     net, p = proto.init(0)
@@ -37,6 +39,9 @@ def test_snowflake_converges_with_confidence():
     assert int(net.dropped) == 0
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 46 s; slush convergence keeps the family fast-gated; the determinism
+# contract stays gated by the Handel/GSF/Casper/PingPong fast runs
 def test_avalanche_deterministic():
     proto = Slush(node_count=64, rounds=4, k=5)
     outs = []

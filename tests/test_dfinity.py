@@ -3,6 +3,7 @@ attesters, partitions (the Dfinity.main demo, :452-465), determinism."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.core.network import Runner
 from wittgenstein_tpu.models.dfinity import (
@@ -31,6 +32,8 @@ def test_chain_growth_and_consensus():
     assert np.asarray(ps.last_beacon).max() >= hh.max() - 1
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 59 s; liveness-under-failures variant of the chain-growth run kept fast
 def test_dead_attesters_still_progress():
     # 20% dead attesters of 20/round: majority 11 of remaining 16 -> slower
     # but alive (percentageDeadAttester, :66-68).
@@ -44,6 +47,8 @@ def test_dead_attesters_still_progress():
     assert hh[live].max() >= 10
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 37 s; partition semantics are engine-level tested in test_engine
 def test_partition_demo():
     # Dfinity.main: run, partition 20%, run, heal, run (:452-465).
     p = make()
@@ -62,6 +67,9 @@ def test_partition_demo():
     assert hh.max() - hh.min() <= 1
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 64 s; chain-growth + rotating-committees keep Dfinity fast-gated and
+# the ff bit-identity pair compares two full engines on it
 def test_determinism():
     p = make()
     r = Runner(p, donate=False)

@@ -266,6 +266,9 @@ def test_determinism_under_jit_copy():
     assert jnp.array_equal(n1.nodes.msg_received, n2.nodes.msg_received)
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 33 s; donate=big is a TPU memory configuration — CPU donation is a
+# no-op, the wrapper's layout logic is exercised by tools/cardinal_1m
 def test_runner_big_donation_bit_identical():
     """Runner(donate="big") — selective donation of >=1MB leaves (the
     tier-2 memory path, SCALE.md) — must be bit-identical to the

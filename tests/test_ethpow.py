@@ -96,6 +96,9 @@ def test_difficulty_golden_exact():
         <= 2 ** (DIFF_SHIFT - 1)
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 78 s long-sim difficulty tracking; test_difficulty_golden_exact
+# keeps the formula gated in the fast suite
 def test_difficulty_tracks_constantinople():
     p = ETHPoW(number_of_miners=5,
                network_latency_name="NetworkFixedLatency(100)")

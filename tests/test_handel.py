@@ -235,6 +235,9 @@ def test_message_filtering_after_done():
     assert int(np.asarray(p.msg_filtered).sum()) > 0
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 60 s; kernel bit-equality stays gated by tests/test_pallas_merge.py
+# and test_gsf_pallas_merge_bit_equal
 def test_pallas_merge_path_bit_equal():
     """The fused Pallas delivery-merge kernel (ops/pallas_merge.py,
     interpret mode on CPU) leaves the ENTIRE simulation bit-identical:

@@ -59,6 +59,9 @@ def test_cardinal_converges_and_counts_are_sane():
     assert int(np.asarray(ps.sigs_checked).sum()) > 0
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 68 s; cardinal coverage stays via test_cardinal_converges_... and the
+# phase-hint cardinal equality pair
 def test_cardinal_determinism():
     p = _cardinal(n=128, down=12)
     net1, ps1 = _run(p, 1200, seed=5)

@@ -7,6 +7,7 @@ analogue)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.core import p2p
 from wittgenstein_tpu.core.network import Runner
@@ -81,6 +82,8 @@ def test_p2pflood_converges_and_counts():
     assert int(jnp.sum(nodes.msg_sent)) > 100
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 48 s; converges_and_counts + the fast ff equality pair keep P2PFlood gated
 def test_p2pflood_deterministic_and_seed_sensitive():
     proto = P2PFlood(node_count=64, dead_node_count=0, peers_count=5,
                      delay_before_resent=5, delay_between_sends=2)
@@ -93,6 +96,8 @@ def test_p2pflood_deterministic_and_seed_sensitive():
     assert not np.array_equal(outs[0], outs[2])
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 50 s; the deterministic + converges runs keep P2PFlood gated fast
 def test_p2pflood_multiple_messages():
     proto = P2PFlood(node_count=96, dead_node_count=0, msg_count=3,
                      peers_count=6, delay_before_resent=2,

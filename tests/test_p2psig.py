@@ -11,6 +11,9 @@ from wittgenstein_tpu.models.p2phandel import (P2PHandel, compressed_size)
 from wittgenstein_tpu.ops import bitset
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 54 s; test_scenarios.test_optimistic_node_scaling_smoke keeps the
+# protocol running in the fast suite
 def test_optimistic_run():
     # OptimisticP2PSignature.main: 1000 nodes, threshold n/2+1, 13 peers,
     # pairing 3 — scaled down for the test.
@@ -105,6 +108,8 @@ def test_p2phandel_cmp_all_strategy():
     assert int(np.asarray(net.nodes.bytes_sent).sum()) > 0
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 55 s; P2PHandel stays gated by the (slow) ff equality battery
 def test_p2phandel_checksigs1():
     p = P2PHandel(signing_node_count=64, relaying_node_count=0,
                   threshold=60, connection_count=8, pairing_time=10,

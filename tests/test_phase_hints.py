@@ -39,6 +39,9 @@ def _run_both(proto, ms, seeds=2):
     return out_plain, out_spec
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 165 s: the heaviest tier-1 test; the cardinal twin below keeps the
+# phase-hint equality gate in the fast suite
 def test_specialized_scan_bit_equal_honest():
     proto = Handel(node_count=64, threshold=56, nodes_down=6,
                    pairing_time=4, dissemination_period_ms=20,
@@ -55,6 +58,9 @@ def test_specialized_scan_bit_equal_honest():
     assert int(np.asarray(bitset.popcount(ps.last_agg)).sum()) > 0
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 99 s; both phase-hint equality pairs are now slow-only —
+# test_desynchronized_start_never_specializes keeps the guard-rail fast
 def test_specialized_scan_bit_equal_cardinal():
     proto = Handel(node_count=64, threshold=56, nodes_down=6,
                    pairing_time=4, dissemination_period_ms=20,

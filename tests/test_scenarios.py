@@ -21,6 +21,8 @@ def _rows(csv_path):
     return lines
 
 
+@pytest.mark.slow      # tier-1 budget (reports/TIER1_DURATIONS.md):
+# 22 s sweep smoke; Handel itself is heavily covered in the fast suite
 def test_handel_tor_sweep_smoke(tmp_path):
     csv = handel_scenarios.tor_sweep(fractions=(0.33,), nodes=32, seeds=2,
                                      out_dir=str(tmp_path))

@@ -51,6 +51,12 @@ def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
                        reps=reps)
     out.update(sim_ms=steps * chunk, batch=seeds or 1,
                platform=jax.default_backend())
+    # engine_metrics block (wittgenstein_tpu/obs; schema BENCH_NOTES.md):
+    # an un-timed bit-identical instrumented pass — the timed reps above
+    # stay on the uninstrumented engine.  WTPU_METRICS=0 skips (checked
+    # inside, one shared guard).
+    from bench import _maybe_engine_metrics
+    _maybe_engine_metrics(out, proto, seeds or 1, steps * chunk)
     return out
 
 

@@ -1,0 +1,93 @@
+"""Rule ``metrics_zero_cost`` — the metrics plane may never silently
+tax the hot path, and may never silently die.
+
+The obs package's contract (wittgenstein_tpu/obs) is two-sided:
+
+  * metrics-OFF builds carry ZERO instrumentation residue.  Enforced
+    structurally: the chunk's outermost scan/while carry width must
+    equal the state pytree's leaf count exactly (any extra carried
+    array is residue — budget `carry_extra_leaves`, pinned at 0 for
+    the dense targets and at the fast-forward engine's two skip
+    counters for the `+ff` ones), and the total jaxpr equation count is
+    ratcheted (`jaxpr_eqns`) so leftover dead reductions can't ride in
+    unnoticed either;
+  * metrics-ON builds actually instrument: an `+metrics`/`+ffmetrics`
+    target whose loop carry does NOT widen by the `MetricsCarry` leaves
+    has a silently-dead plane — an error, not a budget.
+
+Both sides run over the same pinned compiles as every other rule, so
+`python -m wittgenstein_tpu.analysis` proves the invariant per
+protocol per engine variant.
+"""
+
+from __future__ import annotations
+
+from .framework import Finding, Rule, register_rule
+
+#: MetricsCarry contributes this many pytree leaves (t0 + series).
+_METRICS_CARRY_LEAVES = 2
+
+#: analysis target-name suffixes of the instrumented builds
+INSTRUMENTED_SUFFIXES = ("+metrics", "+ffmetrics")
+
+
+def _loop_carry_widths(jaxpr) -> list:
+    """(primitive, carry_width) of every top-level scan/while eqn, in
+    program order.  The chunk loop is top-level in every pinned target
+    (vmap inlines batching before make_jaxpr returns)."""
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.append(("scan", eqn.params["num_carry"]))
+        elif eqn.primitive.name == "while":
+            carry = (len(eqn.invars) - eqn.params["cond_nconsts"] -
+                     eqn.params["body_nconsts"])
+            out.append(("while", carry))
+    return out
+
+
+def _count_eqns(jaxpr) -> int:
+    from .rules_dtype import _iter_jaxprs
+    return sum(len(j.eqns) for j in _iter_jaxprs(jaxpr))
+
+
+@register_rule
+class MetricsZeroCostRule(Rule):
+    name = "metrics_zero_cost"
+    scope = "protocol"
+    budgeted_metrics = ("carry_extra_leaves", "jaxpr_eqns")
+
+    def run(self, target, budget):
+        import jax
+
+        n_state = len(jax.tree.leaves(target.args))
+        loops = _loop_carry_widths(target.jaxpr.jaxpr)
+        if not loops:
+            return [Finding(
+                rule=self.name, target=target.name, severity="warning",
+                message="no top-level scan/while loop in the traced "
+                        "chunk — carry-residue check has nothing to "
+                        "measure")]
+        # The chunk loop: the widest top-level loop (phase-specialized
+        # builds can emit a narrower tail scan after the block scan).
+        prim, carry = max(loops, key=lambda pc: pc[1])
+        extra = carry - n_state
+        instrumented = target.name.endswith(INSTRUMENTED_SUFFIXES)
+        findings = [
+            Finding(rule=self.name, target=target.name, severity="info",
+                    metric="carry_extra_leaves", value=extra,
+                    message=f"{prim} carry holds {carry} vars for "
+                            f"{n_state} state leaves "
+                            f"(carry_extra_leaves={extra})"),
+            Finding(rule=self.name, target=target.name, severity="info",
+                    metric="jaxpr_eqns", value=_count_eqns(target.jaxpr.jaxpr),
+                    message="total jaxpr equations in the compiled chunk"),
+        ]
+        if instrumented and extra < _METRICS_CARRY_LEAVES:
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="error",
+                message=f"instrumented target carries only {extra} extra "
+                        f"loop vars (< {_METRICS_CARRY_LEAVES}: the "
+                        "MetricsCarry leaves) — the metrics plane is "
+                        "silently dead in this build"))
+        return findings

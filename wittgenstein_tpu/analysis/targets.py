@@ -252,6 +252,75 @@ FF_PROTOCOLS = ("Handel", "PingPong", "P2PFlood", "Dfinity")
 
 FF_SUFFIX = "+ff"
 
+#: Protocols whose metrics-ON builds (wittgenstein_tpu/obs) are audited
+#: alongside the uninstrumented engines: the instrumented chunk is a
+#: different compiled program — its host-sync profile, carry copies and
+#: carry width are gated separately under "<name>+metrics" (dense
+#: recorder; batched seed-folded when eligible, mirroring the obs
+#: engine dispatch) and "<name>+ffmetrics" (instrumented quiet-window
+#: while loop).  The `metrics_zero_cost` rule additionally asserts the
+#: plane is actually LIVE in these builds (carry widens by the
+#: MetricsCarry leaves) and has zero residue everywhere else.
+METRICS_PROTOCOLS = ("Handel", "PingPong", "Dfinity")
+METRICS_SUFFIX = "+metrics"
+FFM_PROTOCOLS = ("PingPong",)
+FFM_SUFFIX = "+ffmetrics"
+
+#: pinned instrumentation for the metrics targets: even interval (the
+#: batched fused-pair engine requires it), 4 rows over the CHUNK=8 ms.
+_METRICS_EACH_MS = 2
+
+
+def _metrics_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(METRICS_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..obs import MetricsSpec
+        from ..obs.engine import (scan_chunk_batched_metrics,
+                                  scan_chunk_metrics)
+
+        proto = _registry()[base_name]()
+        spec = MetricsSpec(stat_each_ms=_METRICS_EACH_MS)
+        try:
+            base = scan_chunk_batched_metrics(proto, chunk, spec)
+            engine = "batched+metrics"
+        except ValueError:
+            base = jax.vmap(scan_chunk_metrics(proto, chunk, spec))
+            engine = "vmapped+metrics"
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, engine
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
+def _ffm_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(FFM_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import fast_forward_ok
+        from ..obs import MetricsSpec
+        from ..obs.engine import fast_forward_chunk_metrics
+
+        proto = _registry()[base_name]()
+        assert fast_forward_ok(proto), base_name
+        spec = MetricsSpec(stat_each_ms=_METRICS_EACH_MS)
+        base = fast_forward_chunk_metrics(proto, chunk, spec,
+                                          seed_axis=True)
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "fast_forward+metrics"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
 
 def _ff_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     base_name = name[:-len(FF_SUFFIX)]
@@ -281,11 +350,25 @@ def _ff_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
 @functools.lru_cache(maxsize=1)
 def target_names() -> tuple:
     return tuple(sorted(_registry()) +
-                 sorted(f"{n}{FF_SUFFIX}" for n in FF_PROTOCOLS))
+                 sorted(f"{n}{FF_SUFFIX}" for n in FF_PROTOCOLS) +
+                 sorted(f"{n}{METRICS_SUFFIX}" for n in METRICS_PROTOCOLS) +
+                 sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS))
 
 
 def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
+    if name.endswith(FFM_SUFFIX):
+        if name[:-len(FFM_SUFFIX)] not in FFM_PROTOCOLS:
+            raise KeyError(
+                f"unknown ff-metrics target {name!r}; known: "
+                f"{sorted(f'{n}{FFM_SUFFIX}' for n in FFM_PROTOCOLS)}")
+        return _ffm_target(name)
+    if name.endswith(METRICS_SUFFIX):
+        if name[:-len(METRICS_SUFFIX)] not in METRICS_PROTOCOLS:
+            raise KeyError(
+                f"unknown metrics target {name!r}; known: "
+                f"{sorted(f'{n}{METRICS_SUFFIX}' for n in METRICS_PROTOCOLS)}")
+        return _metrics_target(name)
     if name.endswith(FF_SUFFIX):
         if name[:-len(FF_SUFFIX)] not in FF_PROTOCOLS:
             raise KeyError(f"unknown fast-forward target {name!r}; "

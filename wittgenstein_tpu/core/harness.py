@@ -310,6 +310,47 @@ class TimeSeries:
     merged: dict          # "<getter>.<component>" -> {"min"/"max"/"avg": [...]}
 
 
+def progress_per_time_on_device(protocol, run_count=1, max_time=20_000,
+                                stat_each_ms=10, counters=None,
+                                first_seed=0, fast_forward=False):
+    """`progress_per_time` with the sampling moved ON DEVICE: one
+    compiled chunk covers the whole span and the obs metrics plane
+    (wittgenstein_tpu/obs) records the per-interval series as an extra
+    scan/while carry — no host round trip per sample period, which is
+    what lets a 10k-ms scan be observed without serializing the device
+    on the host every `stat_each_ms`.
+
+    Returns ``(frame, nets, pstates)``: an `obs.MetricsFrame` (exporter
+    matrix: CSV / Perfetto / bench block) aggregated over the seed
+    batch, plus the final states.  Differences from `progress_per_time`:
+    the counter set is the engine plane's (obs/spec.py COUNTERS), not
+    arbitrary stats getters, and runs are not frozen at their stop time
+    (the whole batch advances `max_time` ms — protocol counters of a
+    converged run simply flatline).  ``fast_forward=True`` uses the
+    instrumented quiet-window engine (skipped intervals record
+    ``samples == 0`` and forward-fill exactly)."""
+    from ..obs import MetricsFrame, MetricsSpec
+    from ..obs.engine import (fast_forward_chunk_metrics,
+                              scan_chunk_metrics)
+
+    enable_persistent_cache()
+    spec = MetricsSpec(stat_each_ms=stat_each_ms,
+                       **({"counters": tuple(counters)} if counters
+                          else {}))
+    seeds = jnp.arange(first_seed, first_seed + run_count,
+                       dtype=jnp.int32)
+    nets, ps = jax.vmap(protocol.init)(seeds)
+    if fast_forward:
+        run = jax.jit(fast_forward_chunk_metrics(protocol, max_time, spec,
+                                                 seed_axis=True))
+        nets, ps, _, mc = run(nets, ps)
+    else:
+        run = jax.jit(jax.vmap(scan_chunk_metrics(protocol, max_time,
+                                                  spec)))
+        nets, ps, mc = run(nets, ps)
+    return MetricsFrame.from_carry(spec, mc), nets, ps
+
+
 def progress_per_time(protocol, run_count=1, max_time=20_000,
                       stat_each_ms=10, stats_getters=(), cont_if=None,
                       first_seed=0, fail_on_drop=True, devices=None):

@@ -860,7 +860,8 @@ class Runner:
     """
 
     def __init__(self, protocol, donate="auto", chunk_limit=10_000,
-                 donate_threshold=1 << 20, superstep=1):
+                 donate_threshold=1 << 20, superstep=1,
+                 fast_forward=False, metrics=None):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
@@ -870,24 +871,84 @@ class Runner:
         self._split = None          # (treedef, big_idx) for donate="big"
         self._validated = False
         self.chunk_limit = chunk_limit
+        # fast_forward=True runs chunks through the quiet-window
+        # while-loop engine (bit-identical) and accumulates the skip
+        # stats (`ff_stats()` — utils/profiling.run_report reports
+        # them).  Demoted silently when the protocol is ineligible,
+        # matching the superstep demotion convention below.
+        self._fast_forward = bool(fast_forward) and fast_forward_ok(protocol)
+        # metrics (an obs.MetricsSpec) swaps in the instrumented chunk
+        # builders: each chunk's MetricsCarry is appended to
+        # `metrics_carries` (device arrays — no sync); `metrics_frame()`
+        # fetches and stitches them.
+        self._metrics = metrics
+        self._ff_raw = []           # per-chunk device stats dicts
+        self.metrics_carries = []
         # superstep=2 fuses engine work across ms pairs (step_2ms,
         # bit-identical).  Applied per chunk only when the chunk length
         # and the entry time are even and the config allows it; otherwise
         # that chunk silently runs the per-ms path (results identical).
-        if superstep == 2 and not superstep_ok(protocol):
+        # The fast-forward and instrumented engines advance per ms.
+        if superstep == 2 and (not superstep_ok(protocol)
+                               or self._fast_forward
+                               or metrics is not None):
             superstep = 1
         self._superstep = superstep
 
     def _chunk_fn(self, ms, superstep=1):
         key = (ms, superstep)
         if key not in self._jits:
-            base = scan_chunk(self.protocol, ms, superstep=superstep)
+            if self._metrics is not None and self._fast_forward:
+                from ..obs.engine import fast_forward_chunk_metrics
+                base = fast_forward_chunk_metrics(self.protocol, ms,
+                                                  self._metrics)
+            elif self._metrics is not None:
+                from ..obs.engine import scan_chunk_metrics
+                base = scan_chunk_metrics(self.protocol, ms, self._metrics)
+            elif self._fast_forward:
+                base = fast_forward_chunk(self.protocol, ms)
+            else:
+                base = scan_chunk(self.protocol, ms, superstep=superstep)
             if self._donate == "big":
                 self._jits[key] = split_donate_jit(base, *self._split)
             else:
                 kw = {"donate_argnums": (0, 1)} if self._donate else {}
                 self._jits[key] = jax.jit(base, **kw)
         return self._jits[key]
+
+    def _call_chunk(self, fn, net, pstate):
+        """Run one chunk and stash the fast-forward stats / metrics
+        carry its builder returns beyond ``(net, pstate)``."""
+        out = fn(net, pstate)
+        net, pstate = out[0], out[1]
+        if self._fast_forward:
+            self._ff_raw.append(out[2])
+        if self._metrics is not None:
+            self.metrics_carries.append(out[-1])
+        return net, pstate
+
+    def ff_stats(self):
+        """Accumulated quiet-window skip accounting across every chunk
+        this Runner ran, or None when fast-forward was off/never ran.
+        Forces a device sync (host ints)."""
+        if not self._ff_raw:
+            return None
+        import numpy as np
+        return {
+            "skipped_ms": sum(int(np.asarray(s["skipped_ms"]).reshape(-1)[0])
+                              for s in self._ff_raw),
+            "jump_count": sum(int(np.asarray(s["jump_count"]).reshape(-1)[0])
+                              for s in self._ff_raw),
+        }
+
+    def metrics_frame(self):
+        """Host-side `obs.MetricsFrame` stitched from every chunk's
+        carry, or None when metrics were off/never ran."""
+        if self._metrics is None or not self.metrics_carries:
+            return None
+        from ..obs.export import MetricsFrame
+        return MetricsFrame.from_carries(self._metrics,
+                                         self.metrics_carries)
 
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:
@@ -919,11 +980,12 @@ class Runner:
             fn = self._chunk_fn(self.chunk_limit,
                                 eff(self.chunk_limit, t_entry))
             for _ in range(whole):
-                net, pstate = fn(net, pstate)
+                net, pstate = self._call_chunk(fn, net, pstate)
                 if t_entry is not None:
                     t_entry += self.chunk_limit
             if rem:
-                net, pstate = self._chunk_fn(rem, eff(rem, t_entry))(
-                    net, pstate)
+                net, pstate = self._call_chunk(
+                    self._chunk_fn(rem, eff(rem, t_entry)), net, pstate)
             return net, pstate
-        return self._chunk_fn(ms, eff(ms, t_entry))(net, pstate)
+        return self._call_chunk(self._chunk_fn(ms, eff(ms, t_entry)),
+                                net, pstate)

@@ -1,0 +1,41 @@
+"""On-device metrics plane — zero-host-sync engine telemetry.
+
+The reference's observability surface is host-side and per-event
+(`StatsHelper` min/max/avg over live nodes, `ProgressPerTime` per-round
+time series — SURVEY.md §2.3, §5.5).  Inside a compiled superstep chunk
+neither exists: `utils/profiling.run_report` reads final-state counters
+only AFTER the chunk returns, so everything that happens *during* a
+10k-ms scan is invisible.  This package adds the missing plane:
+
+  device side (`plane`, `engine`): a `MetricsSpec(stat_each_ms,
+  counters)` compiles an interval recorder into the engine chunk —
+  fixed-shape ``[T, K]`` int32 series carried alongside the simulation
+  state and updated with pure on-device reductions (no host callbacks,
+  no `device_get` mid-scan — the `host_sync` lint runs over the
+  instrumented builds too, analysis/targets.py `+metrics` targets);
+
+  host side (`export`): a `MetricsFrame` wraps the fetched series and
+  exports (a) a ProgressPerTime-style CSV via `tools/csvf`, (b) a
+  Chrome-trace/Perfetto JSON that loads on one timeline with the XLA
+  op traces `tools/tpu_profile.py` parses, and (c) the structured
+  ``engine_metrics`` block `bench.py` embeds in its JSON line.
+
+Two hard invariants (tests/test_obs.py, analysis `metrics_zero_cost`):
+
+  * metrics-ON is simulation-bit-identical: the recorder only READS the
+    carried state (`counter_values` is a pure function of it), so the
+    `NetState`/`pstate` trajectory equals the uninstrumented engine's
+    for every covered protocol and engine variant;
+  * metrics-OFF has zero residue: the uninstrumented builders never
+    import this package, and the `metrics_zero_cost` lint pins their
+    scan-carry width and jaxpr op count so the plane can never silently
+    tax the hot path.
+"""
+
+from .engine import (fast_forward_chunk_batched_metrics,  # noqa: F401
+                     fast_forward_chunk_metrics, scan_chunk_batched_metrics,
+                     scan_chunk_metrics, step_ms_metrics)
+from .export import (MetricsFrame, engine_metrics_block,  # noqa: F401
+                     to_perfetto, to_progress_csv)
+from .plane import MetricsCarry, counter_values, init_metrics  # noqa: F401
+from .spec import COUNTERS, MetricsSpec  # noqa: F401

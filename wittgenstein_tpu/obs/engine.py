@@ -1,0 +1,204 @@
+"""Instrumented engine chunk builders — the metrics-ON twins of
+`core/network.scan_chunk` / `fast_forward_chunk` and the batched
+seed-folded pair in `core/batched`.
+
+Each builder returns the uninstrumented engine's result tuple with a
+`MetricsCarry` appended; the simulation dataflow is the SAME functions
+(`step_ms`, `step_2ms_batched`, the oracle, the jump) — the recorder
+only reads the carried state between steps, which is what the
+bit-identity tests in tests/test_obs.py pin.  The instrumented dense
+path runs the per-ms engine (superstep=1); every engine variant is
+bit-identical to it (tests/test_superstep.py, test_batched.py,
+test_fast_forward.py), so an instrumented per-ms run observes exactly
+the trajectory the fused/batched production engines compute.
+
+The uninstrumented builders never import this module — metrics-OFF
+compiles with zero residue, enforced by the `metrics_zero_cost`
+analysis rule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.batched import step_2ms_batched
+from ..core.network import (check_chunk_config, fast_forward_ok, next_work,
+                            step_ms, superstep_ok, _jump)
+from .plane import init_metrics, record_jump, record_step
+from .spec import MetricsSpec
+
+
+def step_ms_metrics(protocol, spec: MetricsSpec, net, pstate, mc):
+    """One instrumented millisecond: `step_ms` then the interval
+    recorder.  The building block of every dense builder below."""
+    net, pstate = step_ms(protocol, net, pstate)
+    return net, pstate, record_step(spec, mc, net)
+
+
+def scan_chunk_metrics(protocol, ms: int, spec: MetricsSpec):
+    """Returns ``run(net, pstate) -> (net, pstate, MetricsCarry)``
+    advancing `ms` milliseconds as one per-ms `lax.scan` with the
+    recorder in the carry — the instrumented twin of
+    `scan_chunk(protocol, ms)`."""
+    check_chunk_config(protocol, ms)
+
+    def run(net, pstate):
+        mc = init_metrics(spec, ms, net.time)
+
+        def body(carry, _):
+            return step_ms_metrics(protocol, spec, *carry), ()
+
+        (net2, p2, mc), _ = jax.lax.scan(body, (net, pstate, mc),
+                                         length=ms)
+        return net2, p2, mc
+
+    return run
+
+
+def fast_forward_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
+                               seed_axis: bool = False):
+    """Instrumented twin of `fast_forward_chunk`: returns
+    ``run(net, pstate) -> (net, pstate, stats, MetricsCarry)``.  Jumps
+    land in the `ff_skipped_ms`/`ff_jumps` columns of their origin
+    interval; intervals wholly inside a quiet window keep
+    ``samples == 0`` (host-side forward fill — exact, since a skipped
+    ms is a no-op step).  ``seed_axis=True`` mirrors the engine's
+    vmap-batched mode: per-seed recorders (series ``[R, T, K]``),
+    lockstep rows."""
+    check_chunk_config(protocol, ms, fast_forward=True)
+    cfg = protocol.cfg
+
+    def run(net, pstate):
+        t0 = net.time[0] if seed_axis else net.time
+        t_end = t0 + ms
+        if seed_axis:
+            r = net.time.shape[0]
+            mc0 = jax.vmap(lambda t: init_metrics(spec, ms, t))(net.time)
+        else:
+            mc0 = init_metrics(spec, ms, net.time)
+
+        def cond(carry):
+            t = carry[0].time[0] if seed_axis else carry[0].time
+            return t < t_end
+
+        def body(carry):
+            net, ps, mc, skipped, jumps = carry
+            if seed_axis:
+                net, ps = jax.vmap(
+                    lambda n_, p_: step_ms(protocol, n_, p_))(net, ps)
+                mc = jax.vmap(lambda m_, n_: record_step(spec, m_, n_))(
+                    mc, net)
+                t1 = net.time[0]
+                nw = jnp.min(jax.vmap(
+                    lambda n_, p_: next_work(protocol, n_, p_, t1))(
+                    net, ps))
+            else:
+                net, ps = step_ms(protocol, net, ps)
+                mc = record_step(spec, mc, net)
+                t1 = net.time
+                nw = next_work(protocol, net, ps, t1)
+            nw = jnp.clip(nw, t1, t_end)
+            net = _jump(cfg, net, nw - t1, nw)
+            if seed_axis:
+                mc = jax.vmap(
+                    lambda m_: record_jump(spec, m_, t1, nw - t1))(mc)
+            else:
+                mc = record_jump(spec, mc, t1, nw - t1)
+            return (net, ps, mc, skipped + (nw - t1),
+                    jumps + (nw > t1).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, mc, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, mc0, z, z))
+        return net, pstate, {"skipped_ms": skipped,
+                             "jump_count": jumps}, mc
+
+    return run
+
+
+def _check_batched(protocol, ms: int, spec: MetricsSpec):
+    if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
+            or not superstep_ok(protocol)):
+        raise ValueError("the batched metrics builders need an even chunk "
+                         "and a spill-free, broadcast-free, superstep-"
+                         "eligible protocol (core/batched.py scope)")
+    if spec.stat_each_ms % 2:
+        raise ValueError(
+            f"the batched engine advances in fused 2-ms pairs, so "
+            f"stat_each_ms must be even (got {spec.stat_each_ms}) — an "
+            "odd interval would straddle a pair and sample mid-pair "
+            "state that the fused step never materializes")
+
+
+def scan_chunk_batched_metrics(protocol, ms: int, spec: MetricsSpec,
+                               plane_barrier: bool = True):
+    """Instrumented twin of `scan_chunk_batched`: per-seed recorders
+    over the seed-folded fused engine; each `step_2ms_batched` pass
+    records once with ``n_steps=2`` (sampling granularity is the fused
+    pair — `stat_each_ms` must be even, so rows never straddle one)."""
+    _check_batched(protocol, ms, spec)
+
+    def run(net, pstate):
+        mc0 = jax.vmap(lambda t: init_metrics(spec, ms, t))(net.time)
+
+        def body(carry, _):
+            net, ps, mc = carry
+            net, ps = step_2ms_batched(protocol, net, ps,
+                                       plane_barrier=plane_barrier)
+            mc = jax.vmap(
+                lambda m_, n_: record_step(spec, m_, n_, n_steps=2))(
+                mc, net)
+            return (net, ps, mc), ()
+
+        (net2, p2, mc), _ = jax.lax.scan(body, (net, pstate, mc0),
+                                         length=ms // 2)
+        return net2, p2, mc
+
+    return run
+
+
+def fast_forward_chunk_batched_metrics(protocol, ms: int,
+                                       spec: MetricsSpec,
+                                       plane_barrier: bool = True):
+    """Instrumented twin of `fast_forward_chunk_batched` (batch-min
+    oracle, even-aligned jumps): returns ``run(net, pstate) ->
+    (net, pstate, stats, MetricsCarry)`` with per-seed recorders."""
+    check_chunk_config(protocol, ms, fast_forward=True)
+    _check_batched(protocol, ms, spec)
+    if not fast_forward_ok(protocol):
+        raise ValueError("fast_forward_chunk_batched_metrics needs a "
+                         "protocol implementing next_action_time — same "
+                         "precondition as the uninstrumented engine")
+    from ..core.batched import _next_work_batched
+
+    def run(net, pstate):
+        t_end = net.time[0] + ms
+        mc0 = jax.vmap(lambda t: init_metrics(spec, ms, t))(net.time)
+
+        def cond(carry):
+            return carry[0].time[0] < t_end
+
+        def body(carry):
+            net, ps, mc, skipped, jumps = carry
+            net, ps = step_2ms_batched(protocol, net, ps,
+                                       plane_barrier=plane_barrier)
+            mc = jax.vmap(
+                lambda m_, n_: record_step(spec, m_, n_, n_steps=2))(
+                mc, net)
+            t1 = net.time[0]
+            nw = jnp.clip(_next_work_batched(protocol, net, ps, t1),
+                          t1, t_end)
+            dt = (nw - t1) - (nw - t1) % 2        # keep entry times even
+            net = net.replace(time=net.time + dt)
+            mc = jax.vmap(lambda m_: record_jump(spec, m_, t1, dt))(mc)
+            return (net, ps, mc, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, mc, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, mc0, z, z))
+        return net, pstate, {"skipped_ms": skipped,
+                             "jump_count": jumps}, mc
+
+    return run

@@ -1,0 +1,241 @@
+"""Host side of the metrics plane: MetricsFrame + the exporter matrix.
+
+A `MetricsFrame` wraps the fetched ``[T, K]`` (or per-seed
+``[R, T, K]``) series plus its spec, and derives the host-facing views:
+forward-filled cumulative series (fast-forwarded quiet intervals carry
+``samples == 0`` and flat-line exactly — a skipped ms is a no-op step),
+per-interval deltas for the cumulative counters, and run totals.
+
+Exporters:
+  * `to_progress_csv` — the ProgressPerTime-style table
+    (ProgressPerTime.java:53-149) via `tools/csvf.CSVFormatter`;
+  * `to_perfetto` — Chrome-trace/Perfetto JSON using the same event
+    conventions `tools/tpu_profile.py` parses (`process_name` metadata,
+    "X" slices, "C" counter tracks), so engine intervals and XLA op
+    traces load on one Perfetto timeline (the engine lane's clock is
+    SIMULATED ms, scaled 1 sim-ms -> 1 trace-ms);
+  * `engine_metrics_block` — the structured dict `bench.py` /
+    `tools/bench_suite.py` embed as ``engine_metrics`` in `BENCH_*.json`
+    (schema: BENCH_NOTES.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+
+import numpy as np
+
+from .spec import CUMULATIVE, GAUGES, MetricsSpec
+
+
+@dataclasses.dataclass
+class MetricsFrame:
+    """Host-side view of one chunk's metrics series."""
+
+    spec: MetricsSpec
+    t0: int
+    series: np.ndarray          # int64 [T, K] — run axis already reduced
+
+    @classmethod
+    def from_carry(cls, spec: MetricsSpec, mc) -> "MetricsFrame":
+        """Fetch a device `MetricsCarry`.  A per-seed carry (series
+        ``[R, T, K]``, lockstep rows) is aggregated by SUMMING over the
+        run axis — counts/bytes become batch aggregates, gauges become
+        batch totals (e.g. done_count across all runs); per-run frames
+        are one `mc.series[i]` slice away for callers that want them."""
+        series = np.asarray(mc.series, dtype=np.int64)
+        t0 = np.asarray(mc.t0).reshape(-1)[0]
+        if series.ndim == 3:
+            series = series.sum(axis=0)
+        return cls(spec=spec, t0=int(t0), series=series)
+
+    @classmethod
+    def from_carries(cls, spec: MetricsSpec, carries) -> "MetricsFrame":
+        """Stitch consecutive chunks' carries into one frame.  Requires
+        interval-aligned chunks (every chunk length a multiple of
+        `stat_each_ms`) so rows concatenate without straddling."""
+        frames = [cls.from_carry(spec, mc) for mc in carries]
+        for a, b in zip(frames, frames[1:]):
+            if b.t0 != a.t0 + a.n_intervals * spec.stat_each_ms:
+                raise ValueError(
+                    f"chunk carries are not interval-aligned (t0 {b.t0} "
+                    f"follows {a.t0} + {a.n_intervals} x "
+                    f"{spec.stat_each_ms}): run chunks whose length is a "
+                    "multiple of stat_each_ms, or export each chunk's "
+                    "frame separately")
+        return cls(spec=spec, t0=frames[0].t0,
+                   series=np.concatenate([f.series for f in frames]))
+
+    @property
+    def n_intervals(self) -> int:
+        return self.series.shape[0]
+
+    def times(self) -> np.ndarray:
+        """Interval END times in absolute simulated ms."""
+        e = self.spec.stat_each_ms
+        return self.t0 + e * (1 + np.arange(self.n_intervals))
+
+    def column(self, name: str) -> np.ndarray:
+        i = self.spec.col(name)
+        if i is None:
+            raise KeyError(f"counter {name!r} not enabled in {self.spec}")
+        return self.series[:, i]
+
+    def filled(self, name: str) -> np.ndarray:
+        """Sampled series with quiet (samples == 0) intervals
+        forward-filled from the last sampled row; leading quiet rows
+        stay 0 (counters start at zero)."""
+        vals = self.column(name).copy()
+        samples = self.column("samples") if self.spec.col("samples") \
+            is not None else np.ones_like(vals)
+        last = 0
+        for i in range(vals.shape[0]):
+            if samples[i] > 0:
+                last = vals[i]
+            else:
+                vals[i] = last
+        return vals
+
+    def deltas(self, name: str) -> np.ndarray:
+        """Per-interval deltas of a cumulative counter (forward-filled
+        first, so quiet intervals contribute exactly 0)."""
+        c = self.filled(name)
+        return np.diff(np.concatenate([[0], c]))
+
+    def totals(self) -> dict:
+        """Whole-chunk totals: final cumulative values, additive sums,
+        high-water maxima, final gauges."""
+        out = {}
+        for name in self.spec.columns:
+            if name in CUMULATIVE:
+                out[name] = int(self.filled(name)[-1])
+            elif name in ("samples", "ff_skipped_ms", "ff_jumps"):
+                out[name] = int(self.column(name).sum())
+            elif name == "spill_hwm":
+                out[name] = int(self.column(name).max(initial=0))
+            else:                       # gauges: value at chunk end
+                out[name] = int(self.filled(name)[-1])
+        return out
+
+
+def to_progress_csv(frame: MetricsFrame):
+    """ProgressPerTime-style table: one row per interval — cumulative
+    counters as per-interval deltas (`<name>` column) plus their
+    running totals (`<name>_cum`), gauges forward-filled, additive
+    columns as recorded.  Returns a `tools/csvf.CSVFormatter` (str() or
+    .save(path) it)."""
+    from ..tools.csvf import CSVFormatter
+
+    spec = frame.spec
+    cols = ["time"]
+    for name in spec.columns:
+        if name in CUMULATIVE:
+            cols += [name, f"{name}_cum"]
+        else:
+            cols.append(name)
+    csv = CSVFormatter(cols)
+    times = frame.times()
+    cum = {n: frame.filled(n) for n in spec.columns if n in CUMULATIVE}
+    dlt = {n: frame.deltas(n) for n in cum}
+    gauge = {n: frame.filled(n) for n in spec.columns if n in GAUGES}
+    raw = {n: frame.column(n) for n in spec.columns
+           if n not in CUMULATIVE and n not in GAUGES}
+    for i in range(frame.n_intervals):
+        row = {"time": int(times[i])}
+        for n in cum:
+            row[n] = int(dlt[n][i])
+            row[f"{n}_cum"] = int(cum[n][i])
+        for n in gauge:
+            row[n] = int(gauge[n][i])
+        for n in raw:
+            row[n] = int(raw[n][i])
+        csv.add(**row)
+    return csv
+
+
+#: pid of the engine lane in the emitted trace — distinct from any XLA
+#: device pid so a merged Perfetto session shows it as its own process.
+ENGINE_PID = 90210
+
+
+def to_perfetto(frame: MetricsFrame, path: str | None = None,
+                name: str = "wtpu engine") -> dict:
+    """Chrome-trace JSON for the engine's interval series.
+
+    Event conventions match what `tools/tpu_profile.collect_trace`
+    parses: `process_name`/`thread_name` "M" metadata, "X" duration
+    slices (one per executed interval, args = that row's counters) and
+    "C" counter events per enabled series.  Timestamps are
+    ``1 sim-ms -> 1000 trace-us`` so the sim clock reads in ms in the
+    UI.  `path` (optional) writes the JSON; a ``.gz`` suffix gzips it.
+    """
+    spec = frame.spec
+    e_ms = spec.stat_each_ms
+    events = [
+        {"ph": "M", "pid": ENGINE_PID, "name": "process_name",
+         "args": {"name": f"{name} (simulated time)"}},
+        {"ph": "M", "pid": ENGINE_PID, "tid": 0, "name": "thread_name",
+         "args": {"name": "engine intervals"}},
+    ]
+    times = frame.times()
+    samples = (frame.column("samples")
+               if spec.col("samples") is not None
+               else np.ones(frame.n_intervals, np.int64))
+    dlt = {n: frame.deltas(n) for n in spec.columns if n in CUMULATIVE}
+    for i in range(frame.n_intervals):
+        ts_us = int(times[i] - e_ms) * 1000
+        args = {n: int(frame.series[i, k])
+                for k, n in enumerate(spec.columns)}
+        args.update({f"{n}_delta": int(d[i]) for n, d in dlt.items()})
+        if samples[i] > 0:
+            events.append({
+                "ph": "X", "pid": ENGINE_PID, "tid": 0, "ts": ts_us,
+                "dur": e_ms * 1000, "name": "engine interval",
+                "args": args})
+        for k, n in enumerate(spec.columns):
+            val = int(dlt[n][i]) if n in CUMULATIVE \
+                else int(frame.series[i, k])
+            events.append({"ph": "C", "pid": ENGINE_PID, "ts": ts_us,
+                           "name": n, "args": {"value": val}})
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path:
+        if str(path).endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                json.dump(trace, f)
+        else:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+    return trace
+
+
+#: series longer than this are summarized (totals only) in the bench
+#: JSON line — one JSON line must stay one line.
+_MAX_SERIES_ROWS = 64
+
+
+def engine_metrics_block(frame: MetricsFrame, extra: dict | None = None) \
+        -> dict:
+    """The ``engine_metrics`` block for `BENCH_*.json` (schema table:
+    BENCH_NOTES.md).  Totals always; full per-interval series only up
+    to _MAX_SERIES_ROWS rows (`"series_truncated": true` past that —
+    no silent cap)."""
+    out = {
+        "stat_each_ms": frame.spec.stat_each_ms,
+        "t0": frame.t0,
+        "intervals": frame.n_intervals,
+        "counters": list(frame.spec.columns),
+        "totals": frame.totals(),
+    }
+    if frame.n_intervals <= _MAX_SERIES_ROWS:
+        out["series"] = {
+            "time": [int(x) for x in frame.times()],
+            **{n: [int(x) for x in frame.column(n)]
+               for n in frame.spec.columns},
+        }
+    else:
+        out["series_truncated"] = True
+    if extra:
+        out.update(extra)
+    return out

@@ -1,0 +1,142 @@
+"""Device side of the metrics plane: the interval recorder.
+
+`MetricsCarry` rides the engine chunk as an extra scan/while carry —
+fixed-shape ``[T, K]`` int32, updated per EXECUTED millisecond with a
+K-wide gather + dynamic-update-slice (tiny next to any engine step).
+Everything here is a pure function of the carried simulation state:
+no host callback, no transfer, no extra PRNG draw — which is what makes
+metrics-ON bit-identical to metrics-OFF on the `NetState`/`pstate`
+trajectory (tests/test_obs.py) and keeps the `host_sync` lint green
+over the instrumented builds.
+
+Sampling semantics (see obs/spec.py COUNTERS):
+  * cumulative counters and gauges are written last-write-wins, so an
+    interval row holds their value AS OF its last executed ms;
+  * under fast-forwarding, intervals wholly inside a quiet window keep
+    ``samples == 0`` and are forward-filled on the host
+    (`export.MetricsFrame`) — a skipped ms is a no-op step, so the
+    flat-line is exact, not an approximation;
+  * a fast-forward jump is attributed once, to the interval containing
+    its origin ms (`record_jump`), even when it spans several rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .spec import _ADDITIVE, _HIGH_WATER, MetricsSpec
+
+
+@struct.dataclass
+class MetricsCarry:
+    """The on-device series: ``series[i, k]`` = counter k over interval
+    ``[t0 + i*stat_each_ms, t0 + (i+1)*stat_each_ms)``."""
+
+    t0: jnp.ndarray         # int32 scalar — chunk entry time
+    series: jnp.ndarray     # int32 [T, K]
+
+
+def init_metrics(spec: MetricsSpec, ms: int, t0) -> MetricsCarry:
+    """Fresh zeroed carry covering a chunk of `ms` simulated ms."""
+    t = spec.n_intervals(ms)
+    return MetricsCarry(
+        t0=jnp.asarray(t0, jnp.int32),
+        series=jnp.zeros((t, len(spec.columns)), jnp.int32))
+
+
+def counter_values(spec: MetricsSpec, net) -> dict:
+    """Current values of the enabled sampled/high-water counters, as
+    int32 scalars, from one (unbatched) NetState.  Pure reductions over
+    state the engine already maintains — the choke points
+    (`build_inbox`, `enqueue_unicast`, `enqueue_broadcast`,
+    `_park_in_spill`, `_drain_spill`) all publish their effects through
+    these arrays, so observing the state IS observing them, with zero
+    change to the simulation dataflow."""
+    nodes = net.nodes
+    cols = set(spec.columns)
+    out = {}
+
+    def want(*names):
+        return any(n in cols for n in names)
+
+    if want("msg_sent"):
+        out["msg_sent"] = jnp.sum(nodes.msg_sent)
+    if want("msg_received"):
+        out["msg_received"] = jnp.sum(nodes.msg_received)
+    if want("bytes_sent"):
+        out["bytes_sent"] = jnp.sum(nodes.bytes_sent)
+    if want("bytes_received"):
+        out["bytes_received"] = jnp.sum(nodes.bytes_received)
+    if want("done_count"):
+        out["done_count"] = jnp.sum((~nodes.down) & (nodes.done_at > 0))
+    if want("live_count"):
+        out["live_count"] = jnp.sum(~nodes.down)
+    if want("ring_rows"):
+        out["ring_rows"] = jnp.sum(jnp.any(net.box_count > 0, axis=-1))
+    if want("ring_occupancy"):
+        out["ring_occupancy"] = jnp.sum(net.box_count)
+    if want("bc_live"):
+        out["bc_live"] = jnp.sum(net.bc_active)
+    if want("spill_hwm"):
+        out["spill_hwm"] = jnp.sum(net.sp_arrival >= 0)
+    if want("drop_count"):
+        out["drop_count"] = (net.dropped + net.bc_dropped + net.clamped +
+                             net.sp_dropped)
+    return {k: v.astype(jnp.int32) for k, v in out.items()}
+
+
+def record(spec: MetricsSpec, mc: MetricsCarry, t, values: dict,
+           n_steps: int = 1) -> MetricsCarry:
+    """Fold one executed ms (or fused pair: ``n_steps=2``) at absolute
+    time `t` into its interval row.  `values` comes from
+    `counter_values` (or a sharded-engine equivalent)."""
+    k = len(spec.columns)
+    row = jnp.clip((jnp.asarray(t, jnp.int32) - mc.t0) // spec.stat_each_ms,
+                   0, mc.series.shape[0] - 1)
+    old = jax.lax.dynamic_slice(mc.series, (row, 0), (1, k)).reshape(k)
+    new = []
+    for i, name in enumerate(spec.columns):
+        if name == "samples":
+            new.append(old[i] + jnp.int32(n_steps))
+        elif name in _HIGH_WATER:
+            new.append(jnp.maximum(old[i], values[name]))
+        elif name in _ADDITIVE:
+            new.append(old[i])          # ff_*: written by record_jump only
+        else:
+            new.append(values[name])
+    series = jax.lax.dynamic_update_slice(
+        mc.series, jnp.stack(new)[None].astype(jnp.int32), (row, 0))
+    return mc.replace(series=series)
+
+
+def record_step(spec: MetricsSpec, mc: MetricsCarry, net,
+                n_steps: int = 1) -> MetricsCarry:
+    """Record the step(s) that just ran: `net.time` has already been
+    advanced, so the last executed ms is ``net.time - 1``."""
+    return record(spec, mc, net.time - 1, counter_values(spec, net),
+                  n_steps=n_steps)
+
+
+def record_jump(spec: MetricsSpec, mc: MetricsCarry, t_from,
+                dt) -> MetricsCarry:
+    """Attribute a fast-forward jump of `dt` quiet ms to the interval
+    containing its origin `t_from`.  ``dt == 0`` is a no-op by
+    construction (adds zero)."""
+    i_skip = spec.col("ff_skipped_ms")
+    i_jump = spec.col("ff_jumps")
+    if i_skip is None and i_jump is None:
+        return mc
+    k = len(spec.columns)
+    dt = jnp.asarray(dt, jnp.int32)
+    row = jnp.clip(
+        (jnp.asarray(t_from, jnp.int32) - mc.t0) // spec.stat_each_ms,
+        0, mc.series.shape[0] - 1)
+    old = jax.lax.dynamic_slice(mc.series, (row, 0), (1, k)).reshape(k)
+    if i_skip is not None:
+        old = old.at[i_skip].add(dt)
+    if i_jump is not None:
+        old = old.at[i_jump].add((dt > 0).astype(jnp.int32))
+    series = jax.lax.dynamic_update_slice(mc.series, old[None], (row, 0))
+    return mc.replace(series=series)

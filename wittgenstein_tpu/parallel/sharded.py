@@ -435,28 +435,101 @@ class ShardedRunner:
             return jax.tree.map(un, sn2), jax.tree.map(un, ps2)
 
         spec = P("sp")
-        return jax.shard_map(wrapped, mesh=self.mesh,
-                             in_specs=(spec, spec), out_specs=(spec, spec),
-                             check_vma=False)
+        # jax >= 0.6 exposes jax.shard_map (check_vma); 0.4.x only has
+        # the experimental module (check_rep).  Same semantics; the
+        # check is disabled either way (the per-shard body mixes
+        # replicated broadcast state with sharded node state).
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(wrapped, mesh=self.mesh,
+                                 in_specs=(spec, spec),
+                                 out_specs=(spec, spec), check_vma=False)
+        from jax.experimental.shard_map import shard_map
+        return shard_map(wrapped, mesh=self.mesh, in_specs=(spec, spec),
+                         out_specs=(spec, spec), check_rep=False)
 
-    def run_ms(self, snet, pstate, ms: int):
+    def _metric_values(self, spec, snet):
+        """Global-aggregate counter values from the sharded state —
+        the sharded analogue of obs.plane.counter_values.  Reductions
+        over the shard axis lower to in-mesh collectives; everything
+        stays on device (no host sync)."""
+        net = snet.net
+        nodes = net.nodes
+        cols = set(spec.columns)
+        out = {}
+        if "msg_sent" in cols:
+            out["msg_sent"] = jnp.sum(nodes.msg_sent)
+        if "msg_received" in cols:
+            out["msg_received"] = jnp.sum(nodes.msg_received)
+        if "bytes_sent" in cols:
+            out["bytes_sent"] = jnp.sum(nodes.bytes_sent)
+        if "bytes_received" in cols:
+            out["bytes_received"] = jnp.sum(nodes.bytes_received)
+        if "done_count" in cols:
+            out["done_count"] = jnp.sum((~nodes.down) & (nodes.done_at > 0))
+        if "live_count" in cols:
+            out["live_count"] = jnp.sum(~nodes.down)
+        if "ring_rows" in cols:
+            # box_count is [S, H, n_local]: a ring ROW is global (one
+            # per ms slot), occupied when any shard holds a delivery.
+            out["ring_rows"] = jnp.sum(
+                jnp.any(net.box_count > 0, axis=(0, 2)))
+        if "ring_occupancy" in cols:
+            out["ring_occupancy"] = jnp.sum(net.box_count)
+        if "bc_live" in cols:
+            # bc table is replicated per shard; count one shard's view.
+            out["bc_live"] = jnp.sum(net.bc_active[0])
+        if "spill_hwm" in cols:
+            out["spill_hwm"] = jnp.asarray(0, jnp.int32)  # spill unsupported
+        if "drop_count" in cols:
+            # dropped/clamped/xdropped are per-shard (local ring + local
+            # exchange) — sum; bc_dropped rides the REPLICATED broadcast
+            # table (every shard computes the same global value, like
+            # bc_active above) — one shard's view, not a sum.
+            out["drop_count"] = (
+                jnp.sum(net.dropped) + net.bc_dropped[0] +
+                jnp.sum(net.clamped) + jnp.sum(snet.xdropped))
+        return {k: v.astype(jnp.int32) for k, v in out.items()}
+
+    def run_ms(self, snet, pstate, ms: int, metrics=None):
+        """Advance `ms` milliseconds.  ``metrics`` (an
+        `obs.MetricsSpec`) additionally records the global-aggregate
+        interval series on device and returns ``(snet, pstate,
+        MetricsCarry)`` — the sharded twin of
+        `obs.engine.scan_chunk_metrics`."""
         ms = int(ms)
         if not hasattr(self, "_jits"):
             self._jits = {}
             self._step = self.step_fn()
-        if ms not in self._jits:
+        key = (ms, metrics)
+        if key not in self._jits:
             step = self._step
+            if metrics is None:
+                @jax.jit
+                def run(sn, ps):
+                    def body(carry, _):
+                        return step(*carry), ()
+                    (sn2, ps2), _ = jax.lax.scan(body, (sn, ps), length=ms)
+                    return sn2, ps2
+            else:
+                from ..obs.plane import init_metrics, record
 
-            @jax.jit
-            def run(sn, ps):
-                def body(carry, _):
-                    return step(*carry), ()
-                (sn2, ps2), _ = jax.lax.scan(body, (sn, ps), length=ms)
-                return sn2, ps2
+                @jax.jit
+                def run(sn, ps):
+                    mc0 = init_metrics(metrics, ms, sn.net.time[0])
 
-            self._jits[ms] = run
+                    def body(carry, _):
+                        sn, ps, mc = carry
+                        sn, ps = step(sn, ps)
+                        mc = record(metrics, mc, sn.net.time[0] - 1,
+                                    self._metric_values(metrics, sn))
+                        return (sn, ps, mc), ()
+                    (sn2, ps2, mc), _ = jax.lax.scan(body, (sn, ps, mc0),
+                                                     length=ms)
+                    return sn2, ps2, mc
+
+            self._jits[key] = run
         with self.mesh:
-            return self._jits[ms](snet, pstate)
+            return self._jits[key](snet, pstate)
 
     # ---------------------------------------------------------------- util
 

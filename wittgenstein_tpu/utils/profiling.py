@@ -53,11 +53,18 @@ def timed():
         box["end"] = time.perf_counter()
 
 
-def run_report(net, wall_s: float | None = None) -> str:
+def run_report(net, wall_s: float | None = None, ff: dict | None = None) \
+        -> str:
     """One-line run summary from the engine counters: simulated time,
     per-node message/byte traffic over live nodes (via the StatsHelper
     getters, which guard the all-down case), drop/clamp health, and
-    sim-ms-per-second when wall_s is given."""
+    sim-ms-per-second when wall_s is given.
+
+    `ff` is the quiet-window skip accounting from a fast-forwarded run
+    (`Runner(fast_forward=True).ff_stats()`, or the stats dict
+    `core/network.fast_forward_chunk` returns): when given, the report
+    carries ``skipped_ms`` / ``jump_count`` / ``skip_rate`` instead of
+    silently omitting how the simulated span was covered."""
     from . import stats
     nodes = net.nodes
     live = int(np.asarray((~np.asarray(nodes.down)).sum()))
@@ -79,6 +86,11 @@ def run_report(net, wall_s: float | None = None) -> str:
         f"+{int(np.asarray(net.bc_dropped))}bc",
         f"clamped={int(np.asarray(net.clamped))}",
     ]
+    if ff is not None:
+        skipped = int(np.asarray(ff["skipped_ms"]).reshape(-1)[0])
+        jumps = int(np.asarray(ff["jump_count"]).reshape(-1)[0])
+        parts.append(f"ff skipped={skipped}ms jumps={jumps} "
+                     f"skip_rate={skipped / max(1, t):.3f}")
     if wall_s is not None and wall_s > 0:
         parts.append(f"wall={wall_s:.2f}s ({t / wall_s:.0f} sim-ms/s)")
     return "Simulation execution time: " + " ".join(parts)
