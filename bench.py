@@ -181,6 +181,11 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
             kw["state_split"] = _int_env("WTPU_BENCH_STATE_SPLIT", 1)
         if os.environ.get("WTPU_BENCH_PALLAS"):
             kw["pallas_merge"] = os.environ["WTPU_BENCH_PALLAS"] == "1"
+    # WTPU_BENCH_LATENCY overrides the latency model by registry name —
+    # the floor-rich A/B lever (e.g. "NetworkFixedLatency(16)" licenses
+    # the superstep-K ladder; the default distance model floors at 2).
+    if os.environ.get("WTPU_BENCH_LATENCY"):
+        kw["network_latency_name"] = os.environ["WTPU_BENCH_LATENCY"]
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,
@@ -206,6 +211,17 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
     if fast_forward:
         lcm = None
     t0 = 0 if (lcm and chunk % lcm == 0) else None
+    # superstep="auto": the largest K the K-aware gate proves for this
+    # protocol/chunk (latency floor + 1, horizon/chunk divisibility —
+    # core/network.pick_superstep); an explicit K is passed through to
+    # the gate, which raises with a remedy instead of silently demoting
+    # (a mislabeled A/B is worse than a refused one).
+    from wittgenstein_tpu.core.network import pick_superstep
+    if superstep == "auto":
+        superstep = pick_superstep(proto, chunk, t0=0,
+                                   lcm=lcm if t0 is not None else None)
+    else:
+        superstep = int(superstep)
     donate_big = os.environ.get("WTPU_BENCH_DONATE") == "big"
     # Batched (seed-folded) engine is the default: measured 92.3 vs 81.0
     # agg sim-ms/s at the headline config (BENCH_NOTES.md r4), bit
@@ -214,12 +230,12 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
     # EXPLICITLY, which would silently mislabel a superstep A/B — refuse
     # loudly instead.
     env_batched = os.environ.get("WTPU_BENCH_BATCHED")
-    if env_batched == "1" and superstep != 2:
-        raise ValueError("WTPU_BENCH_BATCHED=1 implies superstep=2 "
+    if env_batched == "1" and superstep < 2:
+        raise ValueError("WTPU_BENCH_BATCHED=1 implies superstep >= 2 "
                          "(core/batched.py is hard-wired to the fused "
-                         "2-ms step)")
+                         "K-ms window engine)")
     ff_base = None          # stats-bearing (nets, ps) -> (nets, ps, stats)
-    if (env_batched or "1") == "1" and superstep == 2:
+    if (env_batched or "1") == "1" and superstep >= 2:
         # Seed-folded mailbox machinery (core/batched.py): avoids the
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
         # bit-identical (tests/test_batched.py).
@@ -230,28 +246,21 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         barrier = os.environ.get("WTPU_PLANE_BARRIER", "1") != "0"
         if fast_forward:
             base = ff_base = fast_forward_chunk_batched(
-                proto, chunk, plane_barrier=barrier)
+                proto, chunk, plane_barrier=barrier, superstep=superstep)
         else:
             base = scan_chunk_batched(proto, chunk, t0_mod=t0,
-                                      plane_barrier=barrier)
+                                      plane_barrier=barrier,
+                                      superstep=superstep)
         step = jax.jit(base)
     else:
         from wittgenstein_tpu.core.network import fast_forward_chunk
         if fast_forward:
-            if superstep == 2 and env_batched == "0":
-                # The vmapped fast-forward engine advances per-ms: an
-                # explicit SUPERSTEP=2 + BATCHED=0 + FF combination
-                # would silently measure the superstep-1 engine and
-                # mislabel the A/B — refuse loudly (the batched path
-                # keeps the fusion via fast_forward_chunk_batched).
-                raise ValueError(
-                    "WTPU_FAST_FORWARD=1 with WTPU_BENCH_BATCHED=0 "
-                    "runs the per-ms fast-forward engine; set "
-                    "WTPU_BENCH_SUPERSTEP=1 to label it honestly, or "
-                    "drop WTPU_BENCH_BATCHED=0 to keep the fused "
-                    "batched fast-forward engine")
+            # The vmapped fast-forward engine fuses the while body into
+            # the same K-ms windows (K-aligned jumps) — no mislabeled
+            # A/B: the superstep value is honored on every path.
             base = ff_base = fast_forward_chunk(proto, chunk,
-                                                seed_axis=True)
+                                                seed_axis=True,
+                                                superstep=superstep)
         else:
             base = jax.vmap(scan_chunk(proto, chunk, t0_mod=t0,
                                        superstep=superstep))
@@ -288,7 +297,64 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         assert evicted == 0   # queue never overflowed
         return {}
 
-    return step, init, steps, check, proto
+    return step, init, steps, check, proto, superstep
+
+
+def _fixed_cost_estimate(n, seeds, chunk, mode, horizon, inbox_cap,
+                         box_split, eff_ss):
+    """Two-point per-ms fixed-cost estimate for the bench JSON line.
+
+    The superstep-K window removes (K-1)/K of the per-ms fixed cost
+    (sort/scatter/slice/clear — core/network.step_kms) and none of the
+    per-ms protocol work, so timing a short window at superstep=1 and
+    at the effective K gives ``fixed ≈ (c1 - cK) * K / (K - 1)`` where
+    c is wall time per simulated ms of the whole seed batch.  A 2-chunk
+    calibration (no convergence assert — too short to converge) keeps
+    the overhead to one extra compile; WTPU_FIXED_COST_EST=0 skips.
+
+    Both legs are pinned to the VMAPPED DENSE scan engine regardless of
+    what the measured run uses: the formula is only valid when the two
+    legs differ solely in K.  The seed-folded batched engine cannot run
+    superstep=1 (it is hard-wired to the fused window), and the
+    fast-forward while-loop's wall time is dominated by skip/jump
+    behavior rather than the sort/scatter fixed cost — letting the
+    default env pick per leg would conflate the ~14% batched-vs-vmapped
+    engine delta (BENCH_NOTES r4) or the quiet-window skip rate with
+    the amortization being estimated, so both env knobs are forced off
+    around the legs."""
+    if eff_ss <= 1 or os.environ.get("WTPU_FIXED_COST_EST", "1") == "0":
+        return {}
+    from wittgenstein_tpu.utils.measure import timed_chunks
+    prev = {name: os.environ.get(name)
+            for name in ("WTPU_BENCH_BATCHED", "WTPU_FAST_FORWARD")}
+    os.environ["WTPU_BENCH_BATCHED"] = "0"
+    os.environ["WTPU_FAST_FORWARD"] = "0"
+    try:
+        cost_us = {}
+        for ss in (1, eff_ss):
+            step, init, _, _, _, _ = _handel_setup(
+                n, seeds, 2 * chunk, chunk, mode, horizon, inbox_cap, ss,
+                box_split=box_split)
+            r = timed_chunks(step, init, 2, seeds, chunk,
+                             lambda nets, ps: {}, reps=1)
+            cost_us[ss] = 1e6 * seeds / r["value"]   # µs per simulated ms
+    except Exception as e:                     # noqa: BLE001 — the bench
+        # line must still emit whatever happens to the calibration legs
+        return {"fixed_cost_est_error": f"{type(e).__name__}: {e!s:.200}"}
+    finally:
+        for name, value in prev.items():
+            if value is None:
+                del os.environ[name]
+            else:
+                os.environ[name] = value
+    c1, ck = cost_us[1], cost_us[eff_ss]
+    fixed = max(0.0, (c1 - ck) * eff_ss / (eff_ss - 1))
+    return {
+        "fixed_cost_cal_us_per_ms": {"ss1": round(c1, 2),
+                                     f"ss{eff_ss}": round(ck, 2)},
+        "fixed_cost_est_us_per_ms": round(fixed, 2),
+        "fixed_cost_frac_est": round(fixed / c1, 4) if c1 > 0 else 0.0,
+    }
 
 
 def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
@@ -303,10 +369,13 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     Returns a result dict (rate + provenance), not a bare float.
     """
     from wittgenstein_tpu.utils.measure import timed_chunks
-    step, init, steps, check, proto = _handel_setup(
+    step, init, steps, check, proto, eff_ss = _handel_setup(
         n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
+    res["superstep"] = eff_ss
+    res.update(_fixed_cost_estimate(n, seeds, chunk, mode, horizon,
+                                    inbox_cap, box_split, eff_ss))
     res.update(_ff_stats(step, steps, chunk))
     return _maybe_engine_metrics(
         res, proto, seeds, steps * chunk,
@@ -331,7 +400,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     import time
     assert total_seeds % seed_batch == 0
     n_batches = total_seeds // seed_batch
-    step, init, steps, check, proto = _handel_setup(
+    step, init, steps, check, proto, eff_ss = _handel_setup(
         n, seed_batch, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
 
@@ -364,6 +433,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
         "batch_wall_min_s": round(min(walls), 2),
         "batch_wall_max_s": round(max(walls), 2),
         "crosscheck": "per_batch_materialization",
+        "superstep": eff_ss,
     }
     # All microbatches' chunks (warmup excluded by the tail slice);
     # skip_rate is then the average across the whole seed sweep.
@@ -376,7 +446,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
 
 
 def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
-                reps=3):
+                reps=3, superstep=2):
     """Quiet-heavy protocol bench (WTPU_BENCH_PROTO=pingpong|dfinity):
     the configs where fast-forwarding, not node width, is the lever.
     PingPong is delivery-driven after t == 0 (every in-flight-latency
@@ -400,11 +470,20 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     else:
         raise ValueError(f"unknown WTPU_BENCH_PROTO {proto_name!r}; "
                          "known: handel pingpong dfinity")
+    # Largest provable K under the requested bound: PingPong and Dfinity
+    # both self-send (witness self-pong / committee addressing), so
+    # their window caps at the universal K = 2.
+    from wittgenstein_tpu.core.network import pick_superstep
+    eff_ss = pick_superstep(
+        proto, chunk, t0=0,
+        max_k=32 if superstep == "auto" else int(superstep))
     if fast_forward:
         step = _ff_step_wrapper(
-            jax.jit(fast_forward_chunk(proto, chunk, seed_axis=True)))
+            jax.jit(fast_forward_chunk(proto, chunk, seed_axis=True,
+                                       superstep=eff_ss)))
     else:
-        step = jax.jit(jax.vmap(scan_chunk(proto, chunk)))
+        step = jax.jit(jax.vmap(scan_chunk(proto, chunk,
+                                           superstep=eff_ss)))
     steps = max(1, -(-sim_ms // chunk))
 
     def init(seed0=0):
@@ -425,6 +504,7 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res.update(_ff_stats(step, steps, chunk))
     res["node_count"] = proto.cfg.n
+    res["superstep"] = eff_ss
     return _maybe_engine_metrics(res, proto, seeds, steps * chunk,
                                  fast_forward=fast_forward)
 
@@ -633,15 +713,30 @@ def main():
     n = _int_env("WTPU_BENCH_NODES", 2048)
     seeds = _int_env("WTPU_BENCH_SEEDS", 16)
     sim_ms = _int_env("WTPU_BENCH_MS", 1000)
+    # The scan length per jitted call.  An explicit superstep K needs
+    # chunk % K == 0 (the gate refuses instead of mislabeling the A/B),
+    # so ladder scripts probing K > 8 override the default 200 — e.g.
+    # 240 admits every K in {2, 4, 8, 16} while staying a multiple of
+    # Handel's schedule lcm 20 (phase specialization stays on).
+    chunk = _int_env("WTPU_BENCH_CHUNK", 200)
     mode = os.environ.get("WTPU_BENCH_MODE", "exact")
     horizon = _int_env("WTPU_BENCH_HORIZON", 256)
     # inbox 12 measured drop-free at both the 2048-node headline config
     # and the 65536-node cardinal tier-2 config (BENCH_NOTES.md r3).
     inbox_cap = _int_env("WTPU_BENCH_INBOX", 12)
     reps = _int_env("WTPU_BENCH_REPS", 3)
-    # superstep=2 fuses engine work across ms pairs (core/network.step_2ms,
-    # bit-identical — tests/test_superstep.py).
-    superstep = _int_env("WTPU_BENCH_SUPERSTEP", 2)
+    # WTPU_SUPERSTEP=K runs the fused K-ms window engine
+    # (core/network.step_kms, bit-identical — tests/test_superstep.py);
+    # "auto" picks the largest K the latency floor proves.  The legacy
+    # WTPU_BENCH_SUPERSTEP spelling still works; default stays the
+    # universally-valid 2.
+    raw_ss = os.environ.get("WTPU_SUPERSTEP")
+    if raw_ss == "auto":
+        superstep = "auto"
+    elif raw_ss is not None:
+        superstep = _int_env("WTPU_SUPERSTEP", 2)
+    else:
+        superstep = _int_env("WTPU_BENCH_SUPERSTEP", 2)
     # Seed counts past the single-chip vmap ceiling run as sequential
     # microbatches (the 256-seed path, RunMultipleTimes.java:41-87).
     seed_batch = _int_env("WTPU_BENCH_SEED_BATCH", 16)
@@ -650,16 +745,17 @@ def main():
     try:
         if proto_sel != "handel":
             res = bench_quiet(proto_sel, n=n, seeds=seeds, sim_ms=sim_ms,
-                              reps=reps)
+                              chunk=chunk, reps=reps, superstep=superstep)
             n = res.pop("node_count")
         elif seeds > seed_batch:
             res = bench_handel_microbatched(
                 n=n, total_seeds=seeds, seed_batch=seed_batch,
-                sim_ms=sim_ms, mode=mode, horizon=horizon,
+                sim_ms=sim_ms, chunk=chunk, mode=mode, horizon=horizon,
                 inbox_cap=inbox_cap, superstep=superstep,
                 box_split=box_split)
         else:
-            res = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms, mode=mode,
+            res = bench_handel(n=n, seeds=seeds, sim_ms=sim_ms,
+                               chunk=chunk, mode=mode,
                                horizon=horizon, inbox_cap=inbox_cap,
                                reps=reps, superstep=superstep,
                                box_split=box_split)

@@ -174,8 +174,13 @@ def test_fast_forward_rejects_bad_configs():
     proto = _protocols()["Handel"]()
     with pytest.raises(ValueError, match="t0_mod"):
         scan_chunk(proto, 40, t0_mod=0, fast_forward=True)
-    with pytest.raises(ValueError, match="superstep"):
-        scan_chunk(proto, 40, superstep=2, fast_forward=True)
+    # fast_forward composes with superstep (PR 4: K-aligned jumps) —
+    # building the fused+fast-forward chunk must NOT raise...
+    scan_chunk(proto, 40, superstep=2, fast_forward=True)
+    # ...but the K-window proof still gates it: the default distance
+    # model's floor (2 ms) cannot license an 8-ms window.
+    with pytest.raises(ValueError, match="superstep=8"):
+        scan_chunk(proto, 40, superstep=8, fast_forward=True)
     spilled = _protocols()["Handel"]()
     spilled.cfg = dataclasses.replace(spilled.cfg, spill_cap=8)
     with pytest.raises(ValueError, match="spill_cap"):

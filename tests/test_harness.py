@@ -138,3 +138,58 @@ def test_latency_registry():
     assert get_by_name(None).name == "NetworkLatencyByDistanceWJitter"
     assert get_by_name("NetworkNoLatency").name == "NetworkNoLatency"
     assert get_by_name("IC3NetworkLatency").name == "IC3NetworkLatency"
+
+
+def test_odd_entry_time_demotes_superstep():
+    """PR 4 regression (superstep entry-time alignment hole): the
+    harness used to gate the fused superstep on chunk PARITY alone
+    (`chunk % 2 == 0`), ignoring the runs' actual entry time.  A
+    protocol whose init starts the clock at an odd ms would then enter
+    the fused window misaligned — the K-row ring reads would straddle
+    the wrong rows.  All alignment decisions now route through the
+    K-aware gate with the REAL entry time: an odd t0 must demote to
+    the per-ms path and stay bit-identical to it."""
+    import jax
+    import numpy as np
+    from wittgenstein_tpu.core.network import pick_superstep, scan_chunk
+    from wittgenstein_tpu.models.handel import Handel
+
+    class OddStart:
+        """Handel whose init enters the engine at t=1."""
+
+        def __init__(self):
+            self._p = Handel(
+                node_count=64, threshold=56, nodes_down=6, pairing_time=4,
+                dissemination_period_ms=20, level_wait_time=50,
+                fast_path=10, horizon=64,
+                network_latency_name="NetworkFixedLatency(16)")
+            self.cfg, self.latency = self._p.cfg, self._p.latency
+            self.may_self_send = self._p.may_self_send
+
+        def init(self, seed):
+            net, ps = self._p.init(seed)
+            return net.replace(time=jnp.asarray(1, jnp.int32)), ps
+
+        def step(self, *a, **kw):
+            return self._p.step(*a, **kw)
+
+    proto = OddStart()
+    # The chunk is even (the historical gate would have fused it) but
+    # the entry time is odd: the pick must demote.
+    assert pick_superstep(proto, 20, t0=1) == 1
+    assert pick_superstep(proto, 20, t0=0) == 4
+
+    # End-to-end through the harness chunk builder: bit-identical to
+    # the per-ms scan from the odd entry time.
+    chunk_all = harness._freeze_chunk(proto, 20, harness.cont_until_done,
+                                      t0=1)
+    seeds = jnp.arange(2, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(seeds)
+    stopped = jnp.zeros((2,), bool)
+    stopped_at = jnp.zeros((2,), jnp.int32)
+    nets2, ps2, *_ = chunk_all(nets, ps, stopped, stopped_at)
+
+    ref = jax.jit(jax.vmap(scan_chunk(proto._p, 20)))(
+        *jax.vmap(proto.init)(seeds))
+    for a, b in zip(jax.tree.leaves((nets2, ps2)), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -185,3 +185,83 @@ def test_city_set_matches_reference_pruning():
     expected = sorted(c for c in kept if c in geo_names)
     names = sorted(geo.load().names)
     assert names == expected and len(names) == 218
+
+
+# --------------------------------------------------------------------------
+# The latency-floor contract (PR 4, core/latency.py module docstring):
+# `latency_floor_ms()` must be a conservative lower bound on
+# `full_latency` over DISTINCT node pairs, for every builder layout the
+# model supports.  Same oracle-soundness shape as the fast-forward
+# never-over-jumps property: a floor that is too LOW only wastes
+# superstep-K opportunity; one that is too HIGH would let `step_kms`
+# fuse a window a message arrives inside.
+# --------------------------------------------------------------------------
+
+
+def _floor_models():
+    from wittgenstein_tpu.core.latency import (
+        EthScanNetworkLatency, IC3NetworkLatency, NetworkNoLatency,
+        NetworkUniformLatency)
+
+    positioned = builders.NodeBuilder()
+    cities = builders.NodeBuilder(location="cities")
+    aws = builders.NodeBuilder(location="aws")
+    return [
+        (NetworkNoLatency(), positioned),
+        (NetworkFixedLatency(25), positioned),
+        (NetworkUniformLatency(80), positioned),
+        (NetworkLatencyByDistanceWJitter(), positioned),
+        (AwsRegionNetworkLatency(), aws),
+        (EthScanNetworkLatency(), positioned),
+        (MeasuredNetworkLatency([50, 50], [100, 200], name="M"),
+         positioned),
+        (NetworkLatencyByCity(), cities),
+        (NetworkLatencyByCityWJitter(), cities),
+        (IC3NetworkLatency(), positioned),
+    ]
+
+
+def test_latency_floor_is_sound():
+    from wittgenstein_tpu.core.latency import latency_floor_ms
+    from wittgenstein_tpu.ops import prng
+
+    rows = []
+    for model, builder in _floor_models():
+        floor = latency_floor_ms(model)
+        assert floor >= 1
+        observed = 1 << 30
+        for n, seed in ((16, 0), (64, 1), (256, 7)):
+            nodes = builder.build(seed, n)
+            ids = jnp.arange(4096, dtype=jnp.int32)
+            s = prng.hash2(jnp.asarray(seed, jnp.int32), jnp.int32(0xF100))
+            src = prng.uniform_int(prng.hash2(s, 1), ids, n)
+            dst = prng.uniform_int(prng.hash2(s, 2), ids, n)
+            delta = prng.uniform_delta(prng.hash2(s, 3), ids)
+            lat = np.asarray(full_latency(model, nodes, src, dst, delta))
+            keep = np.asarray(src != dst)
+            assert lat[keep].min() >= floor, (
+                f"{model!r} claims floor {floor} but a distinct-pair "
+                f"latency of {lat[keep].min()} was observed (n={n}, "
+                f"seed={seed}) — the floor is UNSOUND and any superstep "
+                "window it licensed would corrupt results")
+            observed = min(observed, int(lat[keep].min()))
+        rows.append((repr(model), floor, observed))
+    # The fixed model's floor must also be TIGHT (the A/B lever the
+    # bench ladder relies on), and the tick-scaled wrapper conservative.
+    tight = {r[0]: r for r in rows}
+    assert tight["NetworkFixedLatency(25)"][1] == 25
+
+
+def test_latency_floor_tick_scaled_and_mathis():
+    from wittgenstein_tpu.core.latency import latency_floor_ms
+    from wittgenstein_tpu.models.ethpow import _TickScaled
+
+    assert latency_floor_ms(_TickScaled(NetworkFixedLatency(25), 10)) == 3
+    assert latency_floor_ms(_TickScaled(NetworkFixedLatency(25), 50)) == 1
+    assert latency_floor_ms(
+        MathisNetworkThroughput(NetworkFixedLatency(25))) == 25
+    # Unknown models never license a window they cannot prove.
+    class Custom:
+        def extended(self, nodes, src, dst, delta):
+            return jnp.full_like(delta, 99)
+    assert latency_floor_ms(Custom()) == 1

@@ -84,3 +84,25 @@ def test_cross_shard_destinations():
     rec = np.asarray(sps.received).reshape(-1)
     expect = np.array([(((i - 9) % 64) * 10) * 5 for i in range(64)])
     assert np.array_equal(rec - 777, expect)  # broadcast 777 included once
+
+
+def test_sharded_superstep_window_bit_identical():
+    """PR 4: the K-ms sharded superstep (one ICI exchange, one bin, one
+    K-row clear per window — `ShardedRunner.step_fn(superstep=K)`) must
+    be bit-identical to the per-ms sharded step, which is itself parity-
+    tested against the single-chip engine above.  RingForward's fixed
+    10 ms latency licenses K = 4 (floor + 1 = 11; 4 divides horizon 64
+    and the 40-ms chunk)."""
+    proto = RingForward(n=64, stride=9, latency=10)
+    sr = ShardedRunner(proto, _mesh(), xcap=32)
+    snet, sps = sr.init(0)
+    per_ms = sr.run_ms(snet, sps, 40)
+    snet, sps = sr.init(0)
+    fused = sr.run_ms(snet, sps, 40, superstep=4)
+    for a, b in zip(jax.tree.leaves(per_ms), jax.tree.leaves(fused)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The gate raises — never silently changes results — on a window
+    # the latency floor cannot prove (floor 10 -> K <= 11 < 16).
+    with pytest.raises(ValueError, match="superstep=16"):
+        sr.run_ms(snet, sps, 32, superstep=16)

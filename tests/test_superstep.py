@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from wittgenstein_tpu.core.network import scan_chunk
+from wittgenstein_tpu.core.network import (pick_superstep, scan_chunk,
+                                           unicast_floor_ms)
 from wittgenstein_tpu.models.handel import Handel
 from wittgenstein_tpu.models.pingpong import PingPong
 
@@ -34,6 +35,17 @@ def _trees_equal(a, b):
     assert len(la) == len(lb)
     for x, y in zip(la, lb):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _floor_handel(**kw):
+    """Handel on a floor-rich model (fixed 16 ms): floor + 1 = 17
+    licenses every K in the {1, 2, 4, 8, 16} ladder."""
+    params = dict(node_count=64, threshold=56, nodes_down=6,
+                  pairing_time=4, dissemination_period_ms=20,
+                  level_wait_time=50, fast_path=10, horizon=64,
+                  network_latency_name="NetworkFixedLatency(16)")
+    params.update(kw)
+    return Handel(**params)
 
 
 def _run_pair(proto, ms, seeds=2, t0_mod=None):
@@ -110,3 +122,208 @@ def test_superstep_rejects_bad_configs():
     spill_proto.cfg = dataclasses.replace(spill_proto.cfg, spill_cap=8)
     with pytest.raises(ValueError, match="spill_cap"):
         scan_chunk(spill_proto, 40, superstep=2)
+
+
+# --------------------------------------------------------------------------
+# Superstep-K (PR 4): latency-floor-proved K-ms windows, K > 2.
+# Fast suite: the K=4 ladder on floor-rich Handel for every engine
+# variant (dense, batched, fast-forward, metrics-ON) + the no-compile
+# gate/pick tests.  The deeper K=8/16 ladders and the extra protocols
+# (cardinal, P2PFlood, HandelEth2) are `slow` per the suite's
+# compile-budget convention — each K is a fresh step-body compile.
+# --------------------------------------------------------------------------
+
+
+def _per_ms_reference(proto, ms, seeds=2):
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    return jax.jit(jax.vmap(scan_chunk(proto, ms)))(nets, ps)
+
+
+def _ladder_check(proto, ms, ks, seeds=2):
+    ref = _per_ms_reference(proto, ms, seeds)
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    for k in ks:
+        nets, ps = jax.vmap(proto.init)(sd)
+        got = jax.jit(jax.vmap(scan_chunk(proto, ms, superstep=k)))(
+            nets, ps)
+        _trees_equal(ref, got)
+    return ref
+
+
+def test_superstep_k4_every_engine_variant():
+    """K=4 bit-identity for the dense scan, the seed-folded batched
+    engine, the quiet-window fast-forward engine, and the metrics-ON
+    recorder (state AND interval series) — one per-ms reference, every
+    variant compared against it."""
+    from wittgenstein_tpu.core.batched import scan_chunk_batched
+    from wittgenstein_tpu.core.network import fast_forward_chunk
+    from wittgenstein_tpu.obs import MetricsSpec
+    from wittgenstein_tpu.obs.engine import scan_chunk_metrics
+
+    proto = _floor_handel()
+    ms = 40
+    ref = _ladder_check(proto, ms, (4,))
+    sd = jnp.arange(2, dtype=jnp.int32)
+
+    nets, ps = jax.vmap(proto.init)(sd)
+    _trees_equal(ref, jax.jit(scan_chunk_batched(proto, ms, superstep=4))(
+        nets, ps))
+
+    nets, ps = jax.vmap(proto.init)(sd)
+    n2, p2, stats = jax.jit(jax.vmap(fast_forward_chunk(
+        proto, ms, superstep=4)))(nets, ps)
+    _trees_equal(ref, (n2, p2))
+
+    spec = MetricsSpec(stat_each_ms=4)
+    nets, ps = jax.vmap(proto.init)(sd)
+    m1 = jax.jit(jax.vmap(scan_chunk_metrics(proto, ms, spec)))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    m4 = jax.jit(jax.vmap(scan_chunk_metrics(proto, ms, spec,
+                                             superstep=4)))(nets, ps)
+    _trees_equal(ref, m4[:2])
+    # The interval series must attribute K-window counters to the same
+    # stat_each_ms rows the per-ms recorder fills (stat_each_ms % K == 0
+    # -> windows never straddle a row; last-write-wins columns agree at
+    # row boundaries and `samples` sums the window widths).
+    np.testing.assert_array_equal(np.asarray(m1[2].series),
+                                  np.asarray(m4[2].series))
+
+
+@pytest.mark.slow
+def test_superstep_k_ladder_handel_deep():
+    _ladder_check(_floor_handel(), 80, (2, 4, 8, 16))
+
+
+@pytest.mark.slow
+def test_superstep_k_ladder_handel_cardinal():
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   fast_path=10, horizon=64,
+                   network_latency_name="NetworkFixedLatency(16)",
+                   mode="cardinal")
+    _ladder_check(proto, 80, (2, 4, 8))
+
+
+@pytest.mark.slow
+def test_superstep_k_ladder_p2pflood():
+    from wittgenstein_tpu.models.p2pflood import P2PFlood
+    proto = P2PFlood(node_count=64, dead_node_count=6, peers_count=8,
+                     network_latency_name="NetworkFixedLatency(16)",
+                     delay_before_resent=1, delay_between_sends=1,
+                     horizon=2048)
+    _ladder_check(proto, 80, (2, 4, 8))
+
+
+@pytest.mark.slow
+def test_superstep_k_ladder_handeleth2():
+    from wittgenstein_tpu.models.handeleth2 import HandelEth2
+    proto = HandelEth2(node_count=64,
+                       network_latency_name="NetworkFixedLatency(16)",
+                       horizon=1024)
+    _ladder_check(proto, 80, (4, 8))
+
+
+@pytest.mark.slow
+def test_superstep_k_phase_specialized():
+    # lcm 20 with K=8 -> hints grouped over the 40-ms adjusted period.
+    proto = _floor_handel()
+    ref = _per_ms_reference(proto, 80)
+    sd = jnp.arange(2, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    got = jax.jit(jax.vmap(scan_chunk(proto, 80, t0_mod=0,
+                                      superstep=8)))(nets, ps)
+    _trees_equal(ref, got)
+
+
+def test_superstep_k_gate_self_send_protocols_capped():
+    """PingPong's witness pongs ITSELF (its own broadcast ping arrives
+    after 2 ms and the reply goes back to the ping's sender — reference
+    behavior), and a self-addressed unicast always takes exactly 1 ms,
+    so no latency floor can license K > 2: the gate must raise with the
+    may_self_send remedy, never silently change results."""
+    from wittgenstein_tpu.core.latency import NetworkFixedLatency
+    proto = PingPong(node_count=64, latency=NetworkFixedLatency(50))
+    assert unicast_floor_ms(proto) == 1
+    with pytest.raises(ValueError, match="may_self_send"):
+        scan_chunk(proto, 40, superstep=4)
+    # K = 2 stays universally valid for self-senders.
+    scan_chunk(proto, 40, superstep=2)
+    assert pick_superstep(proto, 40, t0=0) == 2
+
+
+def test_superstep_k_gate_floor_and_alignment():
+    # Default distance model floor is 2 -> K=8 exceeds the window proof.
+    proto = Handel(node_count=64, threshold=56, nodes_down=6, horizon=64)
+    assert unicast_floor_ms(proto) == 2
+    with pytest.raises(ValueError, match="latency_floor_ms"):
+        scan_chunk(proto, 40, superstep=8)
+    # floor 2 licenses K=3 (on a K-divisible horizon)
+    scan_chunk(Handel(node_count=64, threshold=56, nodes_down=6,
+                      horizon=66), 42, superstep=3)
+    proto16 = _floor_handel()
+    with pytest.raises(ValueError, match="multiple-of-4 chunk"):
+        scan_chunk(proto16, 42, superstep=4)
+    with pytest.raises(ValueError, match="entry time"):
+        scan_chunk(proto16, 40, t0_mod=2, superstep=4)
+    with pytest.raises(ValueError, match="divide the horizon"):
+        scan_chunk(_floor_handel(horizon=96), 40, superstep=5)
+
+
+@pytest.mark.slow
+def test_superstep_k_phase_specialized_misaligned_residue():
+    # Enter at t=24: K-aligned (24 % 8 == 0) but the schedule residue
+    # t0_mod = 24 % 20 = 4 is not — the hint block spans lcm_8 = 40 with
+    # 8 | 40, so the fused window must still be exact (`pick_superstep`
+    # returning 8 for this entry is what the residue-free lcm branch
+    # guarantees).
+    proto = _floor_handel()
+    assert pick_superstep(proto, 80, t0=24, lcm=20) == 8
+    sd = jnp.arange(2, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    nets, ps = jax.jit(jax.vmap(scan_chunk(proto, 24)))(nets, ps)
+    ref = jax.jit(jax.vmap(scan_chunk(proto, 80)))(nets, ps)
+    got = jax.jit(jax.vmap(scan_chunk(proto, 80, t0_mod=4,
+                                      superstep=8)))(nets, ps)
+    _trees_equal(ref, got)
+
+
+def test_superstep_k_gate_t0_mod_gcd():
+    """K not dividing schedule_lcm: `t0_mod` is a residue mod lcm=20, so
+    it pins the absolute entry time only mod gcd(K=8, lcm)=4.  A residue
+    outside that subgroup (t0_mod=2 -> entries 2, 22, 42, 62, ... are
+    2 or 6 mod 8) admits NO K-aligned absolute entry and must raise,
+    while t0_mod=4 admits t=24 and must pass the gate — the remaining
+    obligation is the caller's `pick_superstep(t0=...)` contract, which
+    a residue alone cannot decide."""
+    proto = _floor_handel()
+    assert proto.schedule_lcm == 20
+    with pytest.raises(ValueError, match="gcd"):
+        scan_chunk(proto, 80, t0_mod=2, superstep=8)
+    scan_chunk(proto, 80, t0_mod=4, superstep=8)
+
+
+def test_pick_superstep():
+    proto = _floor_handel()                   # floor 16, horizon 64
+    assert pick_superstep(proto, 80, t0=0) == 16
+    assert pick_superstep(proto, 40, t0=0) == 8
+    assert pick_superstep(proto, 40, t0=0, max_k=4) == 4
+    assert pick_superstep(proto, 40, t0=4) == 4     # entry alignment
+    assert pick_superstep(proto, 40, t0=1) == 1
+    assert pick_superstep(proto, 40, t0=None) == 1  # unknown entry
+    assert pick_superstep(proto, 40, t0=0, also_divides=10) == 2
+    # phase-specialized: lcm 20, K must keep chunk % lcm_k == 0
+    assert pick_superstep(proto, 40, t0=0, lcm=20) == 8   # lcm_8 = 40
+    assert pick_superstep(proto, 20, t0=0, lcm=20) == 4   # lcm_8 = 40 > 20
+    # K-aligned entries whose schedule-lcm residue is NOT K-aligned must
+    # keep the full window (t0=24 is 0 mod 8; hints repeat every
+    # lcm_8=40 and 8 | 40, so the entry residue adds no constraint —
+    # bit-identity at this exact entry:
+    # test_superstep_k_phase_specialized_misaligned_residue).
+    assert pick_superstep(proto, 80, t0=24, lcm=20) == 8
+    assert pick_superstep(proto, 80, t0=20, lcm=20) == 4  # 20 % 8 != 0
+    # distance floor 2 -> K <= 3 (horizon 66 admits both 2 and 3)
+    proto_d = Handel(node_count=64, threshold=56, nodes_down=6,
+                     horizon=66)
+    assert pick_superstep(proto_d, 40, t0=0) == 2
+    assert pick_superstep(proto_d, 42, t0=0) == 3

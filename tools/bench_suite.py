@@ -33,8 +33,33 @@ from wittgenstein_tpu.utils.measure import timed_chunks  # noqa: E402
 
 
 def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
-               superstep=1):
-    """Build the jitted step/init for one config and measure it."""
+               superstep=None):
+    """Build the jitted step/init for one config and measure it.
+
+    `superstep=None` honors the WTPU_SUPERSTEP override (int or "auto";
+    default 1 keeps the tracked configs comparable with their history);
+    the effective K — auto-picked and floor-gated like bench.py — is
+    recorded in the JSON line."""
+    import os
+
+    from wittgenstein_tpu.core.network import pick_superstep
+    if superstep is None:
+        raw = os.environ.get("WTPU_SUPERSTEP", "1")
+        if raw == "auto":
+            superstep = "auto"
+        else:
+            try:
+                superstep = max(1, int(raw))
+            except ValueError:
+                print(f"bench_suite: ignoring malformed "
+                      f"WTPU_SUPERSTEP={raw!r}; using 1", file=sys.stderr)
+                superstep = 1
+    if superstep == "auto" or superstep > 1:
+        superstep = pick_superstep(
+            proto, chunk, t0=0,
+            max_k=32 if superstep == "auto" else superstep,
+            lcm=getattr(proto, "schedule_lcm", None)
+            if t0_mod is not None else None)
     sc = scan_chunk(proto, chunk, t0_mod=t0_mod, superstep=superstep)
     if seeds is None:
         step = jax.jit(sc)
@@ -50,7 +75,7 @@ def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
     out = timed_chunks(step, init, steps, seeds or 1, chunk, check,
                        reps=reps)
     out.update(sim_ms=steps * chunk, batch=seeds or 1,
-               platform=jax.default_backend())
+               superstep=superstep, platform=jax.default_backend())
     # engine_metrics block (wittgenstein_tpu/obs; schema BENCH_NOTES.md):
     # an un-timed bit-identical instrumented pass — the timed reps above
     # stay on the uninstrumented engine.  WTPU_METRICS=0 skips (checked

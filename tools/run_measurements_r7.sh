@@ -1,0 +1,85 @@
+#!/bin/bash
+# Round-7 on-chip measurement session — run when .tpu_up appears.
+# ORDER IS THE POINT (VERDICT r4 #2): the official bench number is
+# captured FIRST, then the round's A/B (the superstep-K window ladder),
+# then the quiet-heavy configs that compose fast-forward with K.
+# Frontier probes are NOT here — they run from a separate shell, late
+# in the round, after everything else landed.
+#
+# Usage: nohup bash tools/run_measurements_r7.sh > reports/r7_onchip.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+R=reports
+mkdir -p "$R"
+stamp() { date -u +%H:%M:%S; }
+
+echo "=== r7 on-chip session start $(stamp)"
+
+# 1. OFFICIAL bench, batched default (superstep=2), reps=3 — the
+#    BENCH_r07 config.  Unchanged engine defaults, so this number is
+#    directly comparable with r6.  (First run also warms
+#    reports/jax_cache/.)
+echo "--- [1/6] official 2048x16 $(stamp)"
+timeout 3600 python bench.py 2>&1 | tee "$R/bench_r7_official.log"
+
+# 2. Superstep-K ladder at the official config on a FLOOR-RICH latency
+#    model (fixed 16 ms: floor+1 = 17 licenses every K here; the
+#    default distance model floors at 2 and caps the window at 3).
+#    WTPU_BENCH_BATCHED=0 keeps every rung on the vmapped scan engine,
+#    so the ladder isolates step_kms amortization from the seed-folding
+#    win; each line carries `superstep`, the two-point
+#    fixed_cost_est_us_per_ms calibration, and the engine-metrics
+#    block.  K=1 is the A side; expect the per-ms fixed-cost term to
+#    shrink ~K/2x versus the historical fused pair.
+#    WTPU_BENCH_CHUNK=240 on EVERY rung: an explicit K needs
+#    chunk % K == 0 (the gate refuses a mislabeled A/B — the default
+#    200 would crash the K=16 rung), 240 admits the whole ladder, and
+#    one shared chunk keeps the rungs comparable (240 is also a
+#    multiple of the schedule lcm 20, so phase specialization stays
+#    on everywhere).
+echo "--- [2/6] superstep-K ladder (vmapped, fixed-latency) $(stamp)"
+for K in 1 2 4 8 16; do
+  WTPU_SUPERSTEP=$K WTPU_BENCH_BATCHED=0 WTPU_BENCH_CHUNK=240 \
+    WTPU_BENCH_LATENCY='NetworkFixedLatency(16)' \
+    timeout 3600 python bench.py 2>&1 \
+    | tee "$R/bench_r7_ss${K}_vmapped.log"
+done
+
+# 3. Superstep-K ladder on the BATCHED seed-folded engine (the
+#    production default): K=2 is the r6 engine, K>=4 the new windows.
+echo "--- [3/6] superstep-K ladder (batched, fixed-latency) $(stamp)"
+for K in 2 4 8 16; do
+  WTPU_SUPERSTEP=$K WTPU_BENCH_BATCHED=1 WTPU_BENCH_CHUNK=240 \
+    WTPU_BENCH_LATENCY='NetworkFixedLatency(16)' \
+    timeout 3600 python bench.py 2>&1 \
+    | tee "$R/bench_r7_ss${K}_batched.log"
+done
+
+# 4. auto-pick sanity: WTPU_SUPERSTEP=auto must land on the largest
+#    valid K (16 here: chunk 200 % 16 != 0 -> 8; the JSON `superstep`
+#    field is the check) and never on an unsound one for the default
+#    distance model (expect 2).
+echo "--- [4/6] superstep auto-pick $(stamp)"
+WTPU_SUPERSTEP=auto WTPU_BENCH_LATENCY='NetworkFixedLatency(16)' \
+  timeout 3600 python bench.py 2>&1 | tee "$R/bench_r7_ssauto_fixed.log"
+WTPU_SUPERSTEP=auto timeout 3600 python bench.py 2>&1 \
+  | tee "$R/bench_r7_ssauto_distance.log"
+
+# 5. fast-forward x superstep composition on the quiet-heavy configs
+#    (PingPong/Dfinity self-send -> their provable window is K=2; the
+#    point is that FF and the fused window now compose on-path).
+echo "--- [5/6] quiet-heavy ff x superstep $(stamp)"
+WTPU_BENCH_PROTO=dfinity WTPU_BENCH_MS=4000 WTPU_FAST_FORWARD=1 \
+  WTPU_SUPERSTEP=2 timeout 1800 python bench.py 2>&1 \
+  | tee "$R/bench_r7_dfinity_ff_ss2.log"
+WTPU_BENCH_PROTO=pingpong WTPU_BENCH_NODES=1024 WTPU_FAST_FORWARD=1 \
+  WTPU_SUPERSTEP=2 timeout 1800 python bench.py 2>&1 \
+  | tee "$R/bench_r7_pingpong_ff_ss2.log"
+
+# 6. tracked-config suite with the auto window (BASELINE.md configs;
+#    the per-line `superstep` field records what each config proved).
+echo "--- [6/6] bench_suite auto superstep $(stamp)"
+WTPU_SUPERSTEP=auto timeout 7200 python tools/bench_suite.py 2>&1 \
+  | tee "$R/bench_suite_r7_ssauto.log"
+
+echo "=== r7 on-chip session done $(stamp)"

@@ -1,0 +1,87 @@
+"""Rule ``superstep_amortization`` — sort/scatter fixed cost per
+simulated millisecond in the compiled superstep.
+
+The engine's per-ms fixed cost — the sort-based ring binning, the
+scatter passes behind it, and the slot clears — is the dominant term in
+the op-latency-bound regime (BENCH_NOTES.md r3), and the whole point of
+the K-ms superstep (core/network.step_kms) is to amortize it: one sort +
+one scatter pass serve K simulated milliseconds.  This rule makes that
+amortization an enforced invariant instead of a hoped-for property: it
+counts the sort and scatter ops inside the compiled chunk's scan body,
+normalizes by the simulated milliseconds one body iteration advances
+(1 for the per-ms scan, 2 for the historical fused pair, K for a
+superstep-K target), and ratchets the per-ms figures in budgets.json.
+A regression — an engine change that sneaks a second sort into the
+window, or a protocol change that un-fuses the binning — fails the gate
+with the measured count.
+
+Metrics (budgeted per target, ratchet-down):
+  sort_ops_per_ms     — HLO ``sort`` ops per simulated ms;
+  scatter_ops_per_ms  — HLO ``scatter`` ops per simulated ms.
+
+Counts are summed across every scan-shaped while body (the same body
+set the carry_copy rule audits) and include the ops' fused forms (a
+``sort`` wrapped in a fusion still prints as a sort op in
+post-optimization CPU HLO).
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import hlo
+from .framework import Finding, Rule, register_rule
+
+#: one HLO op line, e.g. ``%x = (s32[80]...) sort(...)`` — tuple result
+#: types contain spaces, so match the opcode right before the paren.
+_OPLINE = re.compile(r"= .*?\b(sort|scatter)\(")
+
+
+def count_ops(target) -> dict:
+    """Raw (sort, scatter) op counts over the target's scan bodies."""
+    comps = hlo.parse_computations(target.hlo_text)
+    counts = {"sort": 0, "scatter": 0}
+    for body_name in hlo.scan_bodies(target.hlo_text):
+        for line in comps.get(body_name, "").splitlines():
+            m = _OPLINE.search(line)
+            if m and m.group(1) in counts:
+                counts[m.group(1)] += 1
+    return counts
+
+
+def ms_per_iteration(target) -> int:
+    """Simulated milliseconds one scan-body iteration advances: the
+    target's pinned superstep K (``+ssK`` targets carry it explicitly),
+    2 for the seed-folded batched engine's fused pair, else 1."""
+    k = getattr(target, "ms_per_iter", None)
+    if k:
+        return int(k)
+    return 2 if str(target.engine).startswith("batched") else 1
+
+
+def measure(target) -> dict:
+    counts = count_ops(target)
+    k = ms_per_iteration(target)
+    return {"sort_ops_per_ms": round(counts["sort"] / k, 4),
+            "scatter_ops_per_ms": round(counts["scatter"] / k, 4)}
+
+
+@register_rule
+class SuperstepAmortizationRule(Rule):
+    name = "superstep_amortization"
+    scope = "protocol"
+    budgeted_metrics = ("sort_ops_per_ms", "scatter_ops_per_ms")
+
+    def run(self, target, budget):
+        if not hlo.scan_bodies(target.hlo_text):
+            return [Finding(rule=self.name, target=target.name,
+                            severity="warning",
+                            message="no scan-shaped while body found in "
+                                    "the compiled superstep")]
+        k = ms_per_iteration(target)
+        metrics = measure(target)
+        return [Finding(rule=self.name, target=target.name,
+                        severity="info", metric=m, value=v,
+                        message=f"{m}={v} (scan body advances {k} ms "
+                                "per iteration)")
+                for m, v in metrics.items()]

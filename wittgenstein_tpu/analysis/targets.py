@@ -270,6 +270,57 @@ FFM_SUFFIX = "+ffmetrics"
 #: batched fused-pair engine requires it), 4 rows over the CHUNK=8 ms.
 _METRICS_EACH_MS = 2
 
+#: Superstep-K targets (PR 4): the fused K-ms window engine
+#: (core/network.step_kms / batched twin) compiled at a pinned K on a
+#: floor-rich latency model, so the `superstep_amortization` budgets pin
+#: the amortized sort/scatter counts per simulated ms.  Dfinity
+#: self-sends (committee addressing includes the sender), so its max
+#: provable window is the universal K = 2; the no-self-send protocols
+#: get K = 4 (CHUNK = 8 keeps one full window pair per scan body).
+SS_PROTOCOLS = {
+    "Handel+ss4": ("Handel", 4),
+    "P2PFlood+ss4": ("P2PFlood", 4),
+    "Dfinity+ss2": ("Dfinity", 2),
+}
+
+#: floor-rich latency override for the K > 2 targets (floor 8 >= K - 1)
+_SS_LATENCY = "NetworkFixedLatency(8)"
+
+
+def _ss_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name, k = SS_PROTOCOLS[name]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.batched import scan_chunk_batched
+        from ..core.network import scan_chunk
+
+        if base_name == "Handel":
+            proto = _handel(network_latency_name=_SS_LATENCY)
+        elif base_name == "P2PFlood":
+            from ..models.p2pflood import P2PFlood
+            proto = P2PFlood(
+                node_count=64, dead_node_count=6, peers_count=8,
+                delay_before_resent=1, delay_between_sends=1,
+                network_latency_name=_SS_LATENCY)
+        else:
+            proto = _registry()[base_name]()
+        try:
+            base = scan_chunk_batched(proto, chunk, superstep=k)
+            engine = f"batched+ss{k}"
+        except ValueError:
+            base = jax.vmap(scan_chunk(proto, chunk, superstep=k))
+            engine = f"vmapped+ss{k}"
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, engine
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    t.ms_per_iter = k
+    return t
+
 
 def _metrics_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     base_name = name[:-len(METRICS_SUFFIX)]
@@ -352,11 +403,14 @@ def target_names() -> tuple:
     return tuple(sorted(_registry()) +
                  sorted(f"{n}{FF_SUFFIX}" for n in FF_PROTOCOLS) +
                  sorted(f"{n}{METRICS_SUFFIX}" for n in METRICS_PROTOCOLS) +
-                 sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS))
+                 sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS) +
+                 sorted(SS_PROTOCOLS))
 
 
 def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
+    if name in SS_PROTOCOLS:
+        return _ss_target(name)
     if name.endswith(FFM_SUFFIX):
         if name[:-len(FFM_SUFFIX)] not in FFM_PROTOCOLS:
             raise KeyError(

@@ -145,11 +145,14 @@ def _batched_inbox(cfg: EngineConfig, net: NetState, t):
     return Inbox(data=uc_data, src=uc_src, valid=uc_valid), nodes
 
 
-def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
-                     plane_barrier=True):
-    """Batched twin of network.step_2ms (seed-folded mailbox machinery;
+def step_kms_batched(protocol, net: NetState, pstate, k: int,
+                     hints_k=None, plane_barrier=True):
+    """Batched twin of network.step_kms (seed-folded mailbox machinery;
     vmapped protocol steps and routing).  Preconditions: spill_cap == 0,
-    bcast_slots == 0, per-seed times all equal and even.
+    bcast_slots == 0, per-seed times all equal and ≡ 0 (mod K), K valid
+    per `network.superstep_ok` — the K-window soundness argument is
+    `step_kms`'s (no in-window consumption below the latency floor),
+    broadcast-free by this engine's scope.
 
     `plane_barrier=False` disables the read-write ordering barrier (the
     same-process A/B knob — results are bit-identical either way, per
@@ -157,15 +160,22 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
     only changes whether XLA can update the planes in place)."""
     cfg, model = protocol.cfg, protocol.latency
     assert cfg.spill_cap == 0 and cfg.bcast_slots == 0
+    if hints_k is not None and len(hints_k) != k:
+        raise ValueError(f"hints_k must have {k} entries, got "
+                         f"{len(hints_k)}")
     r = net.box_count.shape[0]
     t = net.time[0]
 
-    inbox0, nodes = _batched_inbox(cfg, net, t)
-    net = net.replace(nodes=nodes)
-    inbox1, nodes = _batched_inbox(cfg, net, t + 1)
-    net = net.replace(nodes=nodes)
+    inboxes = []
+    for i in range(k):
+        # `t + i if i else t`: keeps the i == 0 trace free of a dead
+        # `t + 0` eqn (the jaxpr_eqns budgets pin the K == 2 program
+        # at exactly the historical step_2ms_batched op count).
+        ib, nodes = _batched_inbox(cfg, net, t + i if i else t)
+        net = net.replace(nodes=nodes)
+        inboxes.append(ib)
 
-    # Order every later plane WRITE after both inbox READS by threading
+    # Order every later plane WRITE after all K inbox READS by threading
     # the planes through one optimization_barrier with the inbox values.
     # Without this, XLA's copy-insertion cannot prove the scatters run
     # after the slices whenever a phase-hinted step's outbox is
@@ -178,8 +188,8 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
     # with it on or off
     # (tests/test_batched.py::test_plane_barrier_bit_identity).
     if plane_barrier:
-        (inbox0, inbox1, bd, bs, bz, bc) = jax.lax.optimization_barrier(
-            (inbox0, inbox1, net.box_data, net.box_src, net.box_size,
+        (inboxes, bd, bs, bz, bc) = jax.lax.optimization_barrier(
+            (inboxes, net.box_data, net.box_src, net.box_size,
              net.box_count))
         net = net.replace(box_data=bd, box_src=bs, box_size=bz,
                           box_count=bc)
@@ -190,41 +200,59 @@ def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
             return protocol.step(ps, nodes_r, inbox_r, tt, key)
         return protocol.step(ps, nodes_r, inbox_r, tt, key, hints=hints)
 
-    pstate, nodes, out0 = jax.vmap(
-        lambda ps, nd, ib, sd: pstep(ps, nd, ib, sd, t, hints2[0]))(
-        pstate, net.nodes, inbox0, net.seed)
-    net = net.replace(nodes=nodes)
-    pstate, nodes, out1 = jax.vmap(
-        lambda ps, nd, ib, sd: pstep(ps, nd, ib, sd, t + 1, hints2[1]))(
-        pstate, net.nodes, inbox1, net.seed)
-    net = net.replace(nodes=nodes)
+    outs = []
+    for i in range(k):
+        hint = None if hints_k is None else hints_k[i]
+        pstate, nodes, out = jax.vmap(
+            lambda ps, nd, ib, sd, tt=(t + i if i else t), hh=hint:
+            pstep(ps, nd, ib, sd, tt, hh))(
+            pstate, net.nodes, inboxes[i], net.seed)
+        net = net.replace(nodes=nodes)
+        outs.append(out)
 
     h = t % cfg.horizon
     n = cfg.n
     net = net.replace(box_count=jax.lax.dynamic_update_slice(
-        net.box_count, jnp.zeros((r, 2, n), jnp.int32), (0, h, 0)))
+        net.box_count, jnp.zeros((r, k, n), jnp.int32), (0, h, 0)))
 
     # Routing per seed (vmapped — elementwise + latency model), then ONE
-    # folded bin for both ms across all seeds.
+    # folded bin for all K ms across all seeds.
     def route(net_r, out_r, tt):
         return _route_unicast(cfg, model, net_r, out_r, tt)
 
-    net, b0, _ = jax.vmap(lambda nr, orr: route(nr, orr, t))(net, out0)
-    net, b1, _ = jax.vmap(lambda nr, orr: route(nr, orr, t + 1))(net, out1)
-    src = jnp.concatenate([b0[0], b1[0]], axis=1)
-    dest = jnp.concatenate([b0[1], b1[1]], axis=1)
-    arrival = jnp.concatenate([b0[2], b1[2]], axis=1)
-    payload = jnp.concatenate([b0[3], b1[3]], axis=1)
-    size = jnp.concatenate([b0[4], b1[4]], axis=1)
-    valid = jnp.concatenate([b0[5], b1[5]], axis=1)
-    n_clamped = (jnp.sum(b0[6], axis=1) +
-                 jnp.sum(b1[6], axis=1)).astype(jnp.int32)
+    batches = []
+    for i, out in enumerate(outs):
+        net, b, _ = jax.vmap(
+            lambda nr, orr, tt=(t + i if i else t):
+            route(nr, orr, tt))(net, out)
+        batches.append(b)
+    terms = [jnp.sum(b[6], axis=1) for b in batches]
+    n_clamped = terms[0]
+    for tm in terms[1:]:
+        n_clamped = n_clamped + tm
+    n_clamped = n_clamped.astype(jnp.int32)
+    src = jnp.concatenate([b[0] for b in batches], axis=1)
+    dest = jnp.concatenate([b[1] for b in batches], axis=1)
+    arrival = jnp.concatenate([b[2] for b in batches], axis=1)
+    payload = jnp.concatenate([b[3] for b in batches], axis=1)
+    size = jnp.concatenate([b[4] for b in batches], axis=1)
+    valid = jnp.concatenate([b[5] for b in batches], axis=1)
     net, n_dropped = _batched_bin(cfg, net, t, src, dest, arrival,
                                   payload, size, valid)
     net = net.replace(dropped=net.dropped + n_dropped,
                       clamped=net.clamped + n_clamped,
-                      time=net.time + 2)
+                      time=net.time + k)
     return net, pstate
+
+
+def step_2ms_batched(protocol, net: NetState, pstate, hints2=(None, None),
+                     plane_barrier=True):
+    """The K == 2 seed-folded superstep (`step_kms_batched`) — kept as a
+    named entry point, like `network.step_2ms`: K == 2 needs no latency
+    floor and no self-send declaration."""
+    return step_kms_batched(protocol, net, pstate, 2,
+                            hints_k=list(hints2),
+                            plane_barrier=plane_barrier)
 
 
 def _next_work_batched(protocol, net: NetState, pstate, t):
@@ -248,30 +276,30 @@ def _next_work_batched(protocol, net: NetState, pstate, t):
     return jnp.maximum(jnp.minimum(nxt, proto_next), t).astype(jnp.int32)
 
 
-def fast_forward_chunk_batched(protocol, ms: int, plane_barrier=True):
+def fast_forward_chunk_batched(protocol, ms: int, plane_barrier=True,
+                               superstep: int = 2):
     """Quiet-window fast-forwarding for the seed-folded superstep
-    engine: a `lax.while_loop` whose body is one `step_2ms_batched` pass
-    followed by a batch-min oracle jump, floored to EVEN offsets so
-    every loop entry satisfies the fused pair's even-entry-time contract
-    (an odd oracle target lands one quiet ms early — sound, one extra
-    no-op pair at worst).  Bit-identical to `scan_chunk_batched`
-    (tests/test_fast_forward.py); preconditions are the batched engine's
-    plus `network.fast_forward_ok`.  Returns ``run(net, pstate) ->
-    (net, pstate, stats)`` with the same skip accounting as
-    `network.fast_forward_chunk`."""
-    # Shared gate first (spill-free + no phase hints — the remedies live
-    # in network.check_chunk_config), then the batched engine's own
-    # narrower preconditions.
-    check_chunk_config(protocol, ms, fast_forward=True)
-    if (ms % 2 or protocol.cfg.bcast_slots or not superstep_ok(protocol)):
-        raise ValueError("fast_forward_chunk_batched needs an even chunk "
-                         "and a spill-free, broadcast-free, superstep-"
-                         "eligible protocol (core/batched.py scope)")
+    engine: a `lax.while_loop` whose body is one `step_kms_batched` pass
+    followed by a batch-min oracle jump, floored to K-ALIGNED offsets so
+    every loop entry satisfies the fused window's entry-time contract
+    (an unaligned oracle target lands up to K-1 quiet ms early — sound,
+    one extra no-op window at worst).  Bit-identical to
+    `scan_chunk_batched` (tests/test_fast_forward.py); preconditions are
+    the batched engine's plus `network.fast_forward_ok`.  Returns
+    ``run(net, pstate) -> (net, pstate, stats)`` with the same skip
+    accounting as `network.fast_forward_chunk`."""
+    # Shared gate first (spill-free + no phase hints + the K-window
+    # proof — the remedies live in network.check_chunk_config), then the
+    # batched engine's own narrower preconditions.
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    _check_batched_scope(protocol, ms, superstep)
     if not fast_forward_ok(protocol):
         raise ValueError("fast_forward_chunk_batched needs a protocol "
                          "implementing next_action_time (without it no "
                          "window is provably quiet and the loop would "
                          "degenerate to a slower dense scan)")
+    k = superstep
 
     def run(net, pstate):
         t_end = net.time[0] + ms
@@ -281,12 +309,12 @@ def fast_forward_chunk_batched(protocol, ms: int, plane_barrier=True):
 
         def body(carry):
             net, ps, skipped, jumps = carry
-            net, ps = step_2ms_batched(protocol, net, ps,
+            net, ps = step_kms_batched(protocol, net, ps, k,
                                        plane_barrier=plane_barrier)
             t1 = net.time[0]
             nw = jnp.clip(_next_work_batched(protocol, net, ps, t1),
                           t1, t_end)
-            dt = (nw - t1) - (nw - t1) % 2        # keep entry times even
+            dt = (nw - t1) - (nw - t1) % k    # keep entry times K-aligned
             net = net.replace(time=net.time + dt)
             return (net, ps, skipped + dt,
                     jumps + (dt > 0).astype(jnp.int32))
@@ -299,38 +327,51 @@ def fast_forward_chunk_batched(protocol, ms: int, plane_barrier=True):
     return run
 
 
+def _check_batched_scope(protocol, ms, superstep):
+    """The batched engine's own preconditions, layered on the shared
+    gate: broadcast-free (the seed-folded mailbox machinery has no
+    broadcast table path) and a K-aligned chunk."""
+    if (superstep < 2 or ms % superstep or protocol.cfg.spill_cap
+            or protocol.cfg.bcast_slots
+            or not superstep_ok(protocol, superstep)):
+        raise ValueError(
+            f"the batched engine needs a chunk that is a multiple of "
+            f"superstep={superstep} (>= 2; got chunk {ms}) and a "
+            "spill-free, broadcast-free, superstep-eligible protocol "
+            "(core/batched.py scope; see network.check_chunk_config for "
+            "the per-constraint remedies)")
+
+
 def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True,
-                       fast_forward=False):
-    """Batched twin of scan_chunk(superstep=2) for vmap-batched state
-    (leaves [R, ...]).  Same phase-specialization contract; chunk must
-    be even and a multiple of the (even-adjusted) schedule lcm when
-    t0_mod is given.  `plane_barrier` — see `step_2ms_batched`.
-    `fast_forward=True` swaps the dense scan for the quiet-window while
-    loop (`fast_forward_chunk_batched`, stats dropped); incompatible
-    with t0_mod for the same reason as `network.scan_chunk`."""
+                       fast_forward=False, superstep: int = 2):
+    """Batched twin of scan_chunk(superstep=K) for vmap-batched state
+    (leaves [R, ...]); K defaults to the universally-valid 2.  Same
+    phase-specialization contract; chunk must be K-aligned and a
+    multiple of the (K-adjusted) schedule lcm when t0_mod is given.
+    `plane_barrier` — see `step_kms_batched`.  `fast_forward=True` swaps
+    the dense scan for the quiet-window while loop
+    (`fast_forward_chunk_batched`, stats dropped); incompatible with
+    t0_mod for the same reason as `network.scan_chunk`."""
+    k = superstep
     if fast_forward:
-        check_chunk_config(protocol, ms, t0_mod=t0_mod, fast_forward=True)
+        check_chunk_config(protocol, ms, t0_mod=t0_mod, superstep=k,
+                           fast_forward=True)
         base_ff = fast_forward_chunk_batched(protocol, ms,
-                                             plane_barrier=plane_barrier)
+                                             plane_barrier=plane_barrier,
+                                             superstep=k)
 
         def run_ff(net, pstate):
             net, pstate, _ = base_ff(net, pstate)
             return net, pstate
 
         return run_ff
-    if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
-            or not superstep_ok(protocol)):
-        raise ValueError("scan_chunk_batched needs an even chunk and a "
-                         "spill-free, broadcast-free, superstep-eligible "
-                         "protocol")
-    if t0_mod is not None and t0_mod % 2:
-        raise ValueError(f"scan_chunk_batched needs an even entry time "
-                         f"(t0_mod={t0_mod}) — same contract as "
-                         "scan_chunk(superstep=2)")
+    check_chunk_config(protocol, ms, t0_mod=t0_mod, superstep=k)
+    _check_batched_scope(protocol, ms, k)
     lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
         else None
-    if lcm and lcm % 2:
-        lcm *= 2
+    if lcm and lcm % k:
+        import math
+        lcm = lcm * k // math.gcd(lcm, k)
     if lcm:
         if ms % lcm:
             raise ValueError(f"chunk {ms} not a multiple of lcm {lcm}")
@@ -342,9 +383,9 @@ def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True,
         def run_spec(net, pstate):
             def body(carry, _):
                 net, ps = carry
-                for i in range(0, len(hints), 2):
-                    net, ps = step_2ms_batched(
-                        protocol, net, ps, hints2=(hints[i], hints[i + 1]),
+                for i in range(0, len(hints), k):
+                    net, ps = step_kms_batched(
+                        protocol, net, ps, k, hints_k=hints[i:i + k],
                         plane_barrier=plane_barrier)
                 return (net, ps), ()
             (net, pstate), _ = jax.lax.scan(body, (net, pstate),
@@ -355,9 +396,9 @@ def scan_chunk_batched(protocol, ms: int, t0_mod=None, plane_barrier=True,
 
     def run(net, pstate):
         def body(carry, _):
-            return step_2ms_batched(protocol, *carry,
+            return step_kms_batched(protocol, *carry, k,
                                     plane_barrier=plane_barrier), ()
-        (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms // 2)
+        (net2, p2), _ = jax.lax.scan(body, (net, pstate), length=ms // k)
         return net2, p2
 
     return run

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import stats as stats_mod
-from .network import scan_chunk, superstep_ok
+from .network import pick_superstep, scan_chunk
 
 
 def enable_persistent_cache(cache_dir=None):
@@ -72,21 +72,29 @@ def cont_until_done(net, pstate):
     return jnp.any(live & (net.nodes.done_at == 0))
 
 
-def _freeze_chunk(protocol, chunk, cont):
+def _freeze_chunk(protocol, chunk, cont, t0=0):
     """Jitted: advance every run by `chunk` ms, keeping stopped runs frozen
-    at their stop-time state."""
+    at their stop-time state.  `t0` is the runs' ACTUAL entry time (read
+    from the initialized state, not assumed 0)."""
 
-    # Every run's time is a multiple of `chunk` at chunk boundaries
-    # (frozen runs stop exactly on one), so when `chunk` is also a
-    # multiple of the protocol's static schedule lcm the phase-specialized
-    # scan applies to every run (bit-identical — tests/test_phase_hints.py).
-    # Entry times at chunk boundaries are even whenever `chunk` is even,
-    # so the fused super-step (step_2ms — also bit-identical,
-    # tests/test_superstep.py) applies under the same alignment argument.
+    # Every run's time is t0 + a multiple of `chunk` at chunk boundaries
+    # (frozen runs stop exactly on one), so when `chunk` is a multiple
+    # of the protocol's static schedule lcm the phase-specialized scan
+    # applies to every run at phase ``t0 % lcm`` (bit-identical —
+    # tests/test_phase_hints.py).  The fused superstep applies under the
+    # same alignment argument: ALL alignment decisions — chunk length,
+    # entry time, schedule compatibility — route through the shared
+    # K-aware gate (`pick_superstep`/`check_chunk_config`), so an entry
+    # time that is not K-aligned demotes to a smaller window instead of
+    # silently fusing across a misaligned boundary (the historical
+    # chunk-parity-only gate missed exactly that —
+    # tests/test_harness.py::test_odd_entry_time_demotes_superstep).
     lcm = getattr(protocol, "schedule_lcm", None)
-    ss = 2 if (chunk % 2 == 0 and superstep_ok(protocol)) else 1
+    use_spec = bool(lcm and chunk % lcm == 0)
+    ss = pick_superstep(protocol, chunk, t0=t0,
+                        lcm=lcm if use_spec else None)
     one_chunk = scan_chunk(protocol, chunk,
-                           t0_mod=0 if (lcm and chunk % lcm == 0) else None,
+                           t0_mod=(t0 % lcm) if use_spec else None,
                            superstep=ss)
 
     @jax.jit
@@ -226,7 +234,12 @@ class _BatchDriver:
             if len(devices) > 1 or explicit:
                 (self.nets, self.ps, self.stopped, self.stopped_at,
                  self.seeds) = _shard_seed_axis(trees, devices)
-        self._chunk_all = _freeze_chunk(protocol, chunk, self.cont)
+        # The runs' ACTUAL entry time (a protocol's init may start the
+        # clock anywhere) — the superstep/phase alignment decisions in
+        # _freeze_chunk are made against it, never assumed.
+        import numpy as np
+        t0 = int(np.asarray(jax.device_get(self.nets.time)).reshape(-1)[0])
+        self._chunk_all = _freeze_chunk(protocol, chunk, self.cont, t0=t0)
         self._fail_on_drop = fail_on_drop
         self._where = where
 

@@ -11,6 +11,21 @@ otherwise ``max(1, extraLatency[src] + extraLatency[dst] + extended)``
 Models are plain Python objects holding jnp constants; they hash by identity
 and are closed over statically by the jitted step, so switching models means
 one recompile — never dynamic dispatch inside the kernel.
+
+The latency-floor contract (`latency_floor_ms`): each model may expose a
+``latency_floor_ms() -> int`` returning a CONSERVATIVE, provable lower
+bound F >= 1 on ``full_latency(model, nodes, src, dst, delta)`` over all
+DISTINCT node pairs (src != dst), all positions/cities the builders can
+produce, all deltas, and any ``extra_latency >= 0``.  Same-node sends are
+excluded — `full_latency` short-circuits them to 1 ms regardless of the
+model, which is why the engine's superstep gate additionally requires a
+protocol that never unicasts to itself before trusting a floor > 1
+(core/network.check_chunk_config).  The contract is one-sided: returning
+too LOW only costs superstep-K opportunity; returning higher than an
+achievable latency would let `step_kms` fuse a window a message arrives
+inside, silently corrupting results — when in doubt return 1.  Soundness
+is property-tested against sampled latencies in
+tests/test_latency.py::test_latency_floor_is_sound.
 """
 
 from __future__ import annotations
@@ -47,6 +62,9 @@ class NetworkNoLatency:
     def extended(self, nodes, src, dst, delta):
         return jnp.ones_like(delta)
 
+    def latency_floor_ms(self):
+        return 1
+
     def __repr__(self):
         return self.name
 
@@ -61,6 +79,10 @@ class NetworkFixedLatency:
 
     def extended(self, nodes, src, dst, delta):
         return jnp.full_like(delta, self.fixed)
+
+    def latency_floor_ms(self):
+        # extended == fixed everywhere; extra_latency >= 0 only adds.
+        return self.fixed
 
     def __repr__(self):
         return self.name
@@ -77,6 +99,9 @@ class NetworkUniformLatency:
     def extended(self, nodes, src, dst, delta):
         return ((delta.astype(jnp.float32) / 99.0) *
                 self.max_latency).astype(jnp.int32)
+
+    def latency_floor_ms(self):
+        return 1                        # delta == 0 -> extended == 0
 
     def __repr__(self):
         return self.name
@@ -96,6 +121,13 @@ class NetworkLatencyByDistanceWJitter:
         fixed = miles * 0.022 + 4.862
         jitter = gpd_inverse(delta.astype(jnp.float32) / 100.0)
         return ((fixed + jitter) * 0.5).astype(jnp.int32)
+
+    def latency_floor_ms(self):
+        # dist >= 0 and the Pareto jitter's infimum is its location
+        # (gpd_inverse(0) == -0.3): extended >= int((4.862 - 0.3)/2) == 2
+        # even for co-located nodes.
+        return max(1, int((4.862 + float(gpd_inverse(jnp.float32(0.0))))
+                          * 0.5))
 
     def __repr__(self):
         return self.name
@@ -147,6 +179,9 @@ class AwsRegionNetworkLatency:
         lat = jnp.maximum(1, self.rtt[r1, r2] // 2 + jitter)
         return jnp.where(r1 == r2, 1, lat)
 
+    def latency_floor_ms(self):
+        return 1                        # same-region distinct pairs: 1 ms
+
     def __repr__(self):
         return self.name
 
@@ -184,6 +219,10 @@ class MeasuredNetworkLatency:
 
     def extended(self, nodes, src, dst, delta):
         return self.table[delta]
+
+    def latency_floor_ms(self):
+        # Exhaustive min over the finite delta space (the table).
+        return max(1, int(np.asarray(self.table).min()))
 
     def __repr__(self):
         return self.name
@@ -224,6 +263,13 @@ class NetworkLatencyByCity:
         half = 0.5 * self.rtt[nodes.city[src], nodes.city[dst]]
         return jnp.maximum(1, jnp.round(half)).astype(jnp.int32)
 
+    def latency_floor_ms(self):
+        # Exhaustive min over the finite (c1, c2) pair space, through the
+        # same rounding expression (monotone, so min commutes).  Distinct
+        # nodes in one city hit the matrix DIAGONAL, so it is included.
+        return max(1, int(np.maximum(
+            1, np.round(0.5 * np.asarray(self.rtt))).min()))
+
     def __repr__(self):
         return self.name
 
@@ -239,6 +285,15 @@ class NetworkLatencyByCityWJitter(NetworkLatencyByCity):
         raw = gpd_inverse(delta.astype(jnp.float32) / 100.0)
         raw = raw + jnp.where(c1 == c2, 10.0, self.rtt[c1, c2])
         return jnp.maximum(1, jnp.round(0.5 * raw)).astype(jnp.int32)
+
+    def latency_floor_ms(self):
+        # Same-city pairs use the 10 ms constant; cross-city pairs the
+        # OFF-diagonal matrix entries.  Jitter infimum = location (-0.3).
+        m = np.asarray(self.rtt).astype(np.float64)
+        off = m + np.eye(m.shape[0]) * np.float64(1 << 30)
+        rtt_min = min(10.0, float(off.min()))
+        jit0 = float(gpd_inverse(jnp.float32(0.0)))
+        return max(1, int(np.round(0.5 * (rtt_min + jit0))))
 
     def __repr__(self):
         return self.name
@@ -259,6 +314,9 @@ class IC3NetworkLatency:
                               350 // 2], jnp.int32)
         idx = jnp.searchsorted(bounds, position)
         return halves[jnp.minimum(idx, 5)]
+
+    def latency_floor_ms(self):
+        return 92 // 2                  # min of the halved percentile table
 
     def __repr__(self):
         return self.name
@@ -298,6 +356,17 @@ def full_latency(model, nodes, src, dst, delta):
     return jnp.where(src == dst, jnp.ones_like(lat), lat)
 
 
+def latency_floor_ms(model) -> int:
+    """The model's provable distinct-pair latency floor (see the module
+    docstring contract), or the universal floor of 1 when the model does
+    not implement the method — unknown/custom models never license a
+    superstep window they cannot prove."""
+    fn = getattr(model, "latency_floor_ms", None)
+    if fn is None:
+        return 1
+    return max(1, int(fn()))
+
+
 class MathisNetworkThroughput:
     """Size-dependent delay from the TCP Mathis equation
     (core/NetworkThroughput.java:14-57): one-way latency from the wrapped
@@ -323,6 +392,11 @@ class MathisNetworkThroughput:
         return jnp.where(msg_size < self.MSS, st,
                          slow.astype(jnp.int32).astype(jnp.float32)
                          ).astype(jnp.int32)
+
+    def latency_floor_ms(self):
+        # delay >= st == the wrapped model's full latency (transfer time
+        # only adds), so the wrapped floor carries over.
+        return latency_floor_ms(self.latency_model)
 
     def __repr__(self):
         return self.name

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import prng
-from .latency import full_latency
+from .latency import full_latency, latency_floor_ms
 from .protocol import FAR_FUTURE
 from .state import EngineConfig, Inbox, NetState, Outbox
 
@@ -73,6 +73,63 @@ def broadcast_arrivals(cfg: EngineConfig, model, net: NetState, nodes):
     return arrival, ok, raw_lat != lat
 
 
+def _bcast_inbox(cfg: EngineConfig, model, net: NetState, t):
+    """Broadcast half of the time-t inbox: the per-(record, dest)
+    arrival recompute of `broadcast_arrivals`, shaped for delivery.
+    Returns ``(bc_data [N, B, F], bc_src [N, B], bc_size [N, B],
+    bc_valid [N, B], n_clamped)``."""
+    nodes = net.nodes
+    n, b = cfg.n, cfg.bcast_slots
+    arrival, bc_ok, clamped = broadcast_arrivals(cfg, model, net, nodes)
+    bc_hit = bc_ok & (arrival == t) & (~nodes.down[None, :])     # [B, N]
+    bc_valid = jnp.transpose(bc_hit)                             # [N, B]
+    bc_data = jnp.broadcast_to(net.bc_payload[None, :, :],
+                               (n, b, cfg.payload_words))
+    bc_src = jnp.broadcast_to(net.bc_src[None, :], (n, b))
+    bc_size = jnp.broadcast_to(net.bc_size[None, :], (n, b))
+    # Broadcast deliveries whose true latency outran the ring (counted
+    # once, at their clamped delivery ms).
+    n_clamped = jnp.sum(bc_hit & clamped).astype(jnp.int32)
+    return bc_data, bc_src, bc_size, bc_valid, n_clamped
+
+
+def _unicast_inbox_window(cfg: EngineConfig, net: NetState, t, k: int):
+    """Read K consecutive unicast inbox slices as ONE contiguous window.
+
+    Requires ``t % k == 0`` with ``k`` dividing the horizon (so rows
+    ``t % horizon .. t % horizon + k - 1`` never wrap) — the `step_kms`
+    entry contract.  Returns ``(uc_data [K, N, C, F], uc_src [K, N, C],
+    uc_size [K, N, C], uc_valid [K, N, C])`` with the same per-ms
+    validity the per-ms slice computes (delivery-time down/partition
+    checks are static across the window: `step_kms` requires a protocol
+    that does not mutate liveness)."""
+    nodes = net.nodes
+    n, c, f = cfg.n, cfg.inbox_cap, cfg.payload_words
+    p, ns = cfg.box_split, cfg.split_n
+    h = t % cfg.horizon
+    base = h * (ns * c)
+
+    def rd(plane):
+        return jax.lax.dynamic_slice(plane, (base,),
+                                     (k * ns * c,)).reshape(k, ns, c)
+
+    def rd_all(planes):
+        if p == 1:
+            return rd(planes[0])
+        return jnp.concatenate([rd(pl) for pl in planes], axis=1)
+
+    uc_data = jnp.stack(
+        [rd_all(net.box_data[fi * p:(fi + 1) * p]) for fi in range(f)],
+        axis=-1)                                    # [K, N, C, F]
+    uc_src = rd_all(net.box_src)
+    uc_size = rd_all(net.box_size)
+    cnt = jax.lax.dynamic_slice(net.box_count, (h, 0), (k, n))   # [K, N]
+    uc_valid = jnp.arange(c)[None, None, :] < cnt[:, :, None]
+    deliver_ok = (~nodes.down[None, :, None]) & (
+        nodes.partition[uc_src] == nodes.partition[None, :, None])
+    return uc_data, uc_src, uc_size, uc_valid & deliver_ok
+
+
 def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     """Assemble the time-t inbox and bump receive counters.
 
@@ -81,7 +138,7 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
     bumped per delivered message (:611-612).
     """
     nodes = net.nodes
-    n, c, b, f = cfg.n, cfg.inbox_cap, cfg.bcast_slots, cfg.payload_words
+    c, b, f = cfg.inbox_cap, cfg.bcast_slots, cfg.payload_words
     p, ns = cfg.box_split, cfg.split_n
     h = t % cfg.horizon
 
@@ -121,13 +178,8 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
         return inbox, nodes, jnp.asarray(0, jnp.int32)
 
     # --- broadcast recompute: which records arrive at exactly t? ---
-    arrival, bc_ok, clamped = broadcast_arrivals(cfg, model, net, nodes)
-    bc_valid = bc_ok & (arrival == t) & (~nodes.down[None, :])   # [B, N]
-    bc_valid = jnp.transpose(bc_valid)                           # [N, B]
-    bc_data = jnp.broadcast_to(net.bc_payload[None, :, :],
-                               (n, b, cfg.payload_words))
-    bc_src = jnp.broadcast_to(net.bc_src[None, :], (n, b))
-    bc_size = jnp.broadcast_to(net.bc_size[None, :], (n, b))
+    bc_data, bc_src, bc_size, bc_valid, n_clamped = _bcast_inbox(
+        cfg, model, net, t)
 
     inbox = Inbox(
         data=jnp.concatenate([uc_data, bc_data], axis=1),
@@ -140,9 +192,6 @@ def build_inbox(cfg: EngineConfig, model, net: NetState, t):
               jnp.sum(jnp.where(bc_valid, bc_size, 0), 1)).astype(jnp.int32)
     nodes = nodes.replace(msg_received=nodes.msg_received + recv,
                           bytes_received=nodes.bytes_received + rbytes)
-    # Broadcast deliveries whose true latency outran the ring (counted once,
-    # at their clamped delivery ms).
-    n_clamped = jnp.sum(jnp.transpose(bc_valid) & clamped).astype(jnp.int32)
     return inbox, nodes, n_clamped
 
 
@@ -154,10 +203,11 @@ def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
     within a (ms, dest) group + the current fill count gives each message
     its slot.  `dest` must already be clipped to [0, n); arrivals must lie
     within the ring: rel = arrival - t in [1, horizon-1] for the per-ms
-    path, or [2, horizon] for the fused `step_2ms` path — rel == horizon
-    lands in the row t % horizon, which is valid ONLY because step_2ms
-    clears both consumed rows BEFORE binning (do not reorder).  Returns
-    (net', n_dropped) — entries that found their (ms, dest) cell full.
+    path, or [K, horizon + K - 2] for the fused `step_kms` path — rel >=
+    horizon lands in one of the rows t % horizon .. t % horizon + K - 2,
+    which is valid ONLY because step_kms clears all K consumed rows
+    BEFORE binning (do not reorder).  Returns (net', n_dropped) —
+    entries that found their (ms, dest) cell full.
     """
     n, c = cfg.n, cfg.inbox_cap
     m = src.shape[0]
@@ -410,87 +460,144 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
     return net.replace(time=t + 1), pstate
 
 
-def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
-    """Advance TWO milliseconds in one fused engine pass.
+def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None):
+    """Advance K milliseconds in one fused engine pass — the superstep.
 
-    Bit-identical to two `step_ms` calls (tests/test_superstep.py), because
-    the engine's minimum latency is 1 ms: a send at t arrives no earlier
-    than t+2, so nothing produced inside the pair can be consumed inside
-    the pair.  That licenses:
+    Bit-identical to K `step_ms` calls (tests/test_superstep.py) whenever
+    the latency model provably never delivers a unicast in fewer than
+    ``F = latency_floor_ms()`` milliseconds and ``K <= F + 1`` (the
+    classic lookahead/conservative-window argument from parallel DES): a
+    unicast sent at window ms t+i arrives no earlier than t+i+1+F >=
+    t+K, so nothing produced inside the window can be consumed inside
+    the window.  Self-sends bypass the model (full_latency pins
+    src == dst to 1 ms), so a floor above 1 is only usable for protocols
+    that declare ``may_self_send = False``.  That licenses:
 
-      * both inbox slices read up-front (one contiguous 2-slot window —
-        sends at t cannot land at t+1);
-      * ONE sort-based binning over both steps' outboxes (keyed on
-        (rel, dest) with rel relative to t, spanning [2, horizon]; batch
-        order inside a (ms, dest) cell equals the sequential order the
-        per-ms path produces, so slots are identical);
-      * both consumed ring slots cleared with one 2-row update.
+      * all K unicast inbox slices read up-front as ONE contiguous
+        K-row window (`_unicast_inbox_window`);
+      * ONE sort-based binning over all K outboxes (keyed on
+        (rel, dest) with rel relative to t, spanning [K, horizon+K-2];
+        batch order inside a (ms, dest) cell equals the sequential
+        order the per-ms path produces, so slots are identical);
+      * all K consumed ring slots cleared with one K-row update.
 
-    This halves the engine's per-ms fixed cost (sorts, scatter passes,
+    This cuts the engine's per-ms fixed cost (sorts, scatter passes,
     slices, clears) — the op-latency-bound regime's dominant term
-    (BENCH_NOTES.md r3).  Broadcast-table ordering is preserved exactly
-    (retire(t) .. enqueue(t), retire(t+1), enqueue(t+1) — records
-    expiring at t+1 contribute no arrivals at t or t+1, so the up-front
-    inbox reads are unaffected).
+    (BENCH_NOTES.md r3) — by ~K/2x over the historical 2-ms fusion.
 
-    Requirements (enforced by `scan_chunk(superstep=2)`): spill_cap == 0,
-    horizon even, entry time even.
+    Broadcasts are NOT window-fused: their table evolves and their
+    arrivals are recomputed per-ms-exactly inside the window
+    (retire(t+i) -> deliver(t+i) -> step -> enqueue(t+i)), because a
+    sendAll reaches its own sender in 1 ms and would otherwise land
+    inside any K > 2 window.  The broadcast recompute is elementwise
+    [B, N] work — none of the sort/scatter fixed cost being amortized —
+    so per-ms exactness there costs nothing extra.
+
+    Requirements (enforced by `check_chunk_config`): spill_cap == 0,
+    K divides the horizon, entry time ≡ 0 (mod K), K <= floor + 1 via
+    `unicast_floor_ms`, and a protocol that does not mutate liveness.
     """
+    if hints_k is not None and len(hints_k) != k:
+        raise ValueError(f"hints_k must have {k} entries, got "
+                         f"{len(hints_k)}")
+    if k == 1:
+        return step_ms(protocol, net, pstate,
+                       hints=None if hints_k is None else hints_k[0])
     cfg, model = protocol.cfg, protocol.latency
     if cfg.spill_cap > 0:
-        raise ValueError("step_2ms requires spill_cap == 0 (spill drain "
+        raise ValueError("step_kms requires spill_cap == 0 (spill drain "
                          "is inherently per-ms)")
     t = net.time
     if cfg.bcast_slots > 0:
         net = _retire_broadcasts(cfg, net, t)
 
-    inbox0, nodes, cl0 = build_inbox(cfg, model, net, t)
-    net = net.replace(nodes=nodes, clamped=net.clamped + cl0)
-    inbox1, nodes, cl1 = build_inbox(cfg, model, net, t + 1)
-    net = net.replace(nodes=nodes, clamped=net.clamped + cl1)
+    # All K unicast slices + their receive counters up-front (counters
+    # are write-only to the protocol step, so the early bump is
+    # unobservable — the step_2ms precedent).
+    uc_data, uc_src, uc_size, uc_valid = _unicast_inbox_window(
+        cfg, net, t, k)
+    recv = jnp.sum(uc_valid, axis=(0, 2)).astype(jnp.int32)
+    rbytes = jnp.sum(jnp.where(uc_valid, uc_size, 0),
+                     axis=(0, 2)).astype(jnp.int32)
+    net = net.replace(nodes=net.nodes.replace(
+        msg_received=net.nodes.msg_received + recv,
+        bytes_received=net.nodes.bytes_received + rbytes))
 
-    key0 = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
-    key1 = jax.random.fold_in(jax.random.PRNGKey(net.seed), t + 1)
-    if hints2[0] is None:
-        pstate, nodes, out0 = protocol.step(pstate, net.nodes, inbox0, t,
-                                            key0)
-    else:
-        pstate, nodes, out0 = protocol.step(pstate, net.nodes, inbox0, t,
-                                            key0, hints=hints2[0])
-    net = net.replace(nodes=nodes)
-    if hints2[1] is None:
-        pstate, nodes, out1 = protocol.step(pstate, net.nodes, inbox1,
-                                            t + 1, key1)
-    else:
-        pstate, nodes, out1 = protocol.step(pstate, net.nodes, inbox1,
-                                            t + 1, key1, hints=hints2[1])
-    net = net.replace(nodes=nodes)
+    outs = []
+    for i in range(k):
+        ti = t + i if i else t      # no dead `t + 0` eqn in the trace
+        if i > 0 and cfg.bcast_slots > 0:
+            net = _retire_broadcasts(cfg, net, ti)
+        if cfg.bcast_slots > 0:
+            bc_data, bc_src, bc_size, bc_valid, n_cl = _bcast_inbox(
+                cfg, model, net, ti)
+            recv_b = jnp.sum(bc_valid, 1).astype(jnp.int32)
+            rb_b = jnp.sum(jnp.where(bc_valid, bc_size, 0),
+                           1).astype(jnp.int32)
+            net = net.replace(
+                nodes=net.nodes.replace(
+                    msg_received=net.nodes.msg_received + recv_b,
+                    bytes_received=net.nodes.bytes_received + rb_b),
+                clamped=net.clamped + n_cl)
+            inbox = Inbox(
+                data=jnp.concatenate([uc_data[i], bc_data], axis=1),
+                src=jnp.concatenate([uc_src[i], bc_src], axis=1),
+                valid=jnp.concatenate([uc_valid[i], bc_valid], axis=1))
+        else:
+            inbox = Inbox(data=uc_data[i], src=uc_src[i],
+                          valid=uc_valid[i])
+        key = jax.random.fold_in(jax.random.PRNGKey(net.seed), ti)
+        h_i = None if hints_k is None else hints_k[i]
+        if h_i is None:
+            pstate, nodes, out = protocol.step(pstate, net.nodes, inbox,
+                                               ti, key)
+        else:
+            pstate, nodes, out = protocol.step(pstate, net.nodes, inbox,
+                                               ti, key, hints=h_i)
+        net = net.replace(nodes=nodes)
+        outs.append(out)
+        if cfg.bcast_slots > 0:
+            net = enqueue_broadcast(cfg, net, out, ti)
 
-    # Clear both consumed slots in one 2-row window (h even, no wrap).
+    # Clear all K consumed slots in one K-row window (h ≡ 0 mod K and
+    # K | horizon: no wrap).
     h = t % cfg.horizon
     net = net.replace(box_count=jax.lax.dynamic_update_slice(
-        net.box_count, jnp.zeros((2, cfg.n), jnp.int32), (h, 0)))
+        net.box_count, jnp.zeros((k, cfg.n), jnp.int32), (h, 0)))
 
-    # Route both outboxes (latency draws keyed on each step's own t),
-    # then bin them together: one sort + one scatter pass for two ms.
-    net, b0, _ = _route_unicast(cfg, model, net, out0, t)
-    net, b1, _ = _route_unicast(cfg, model, net, out1, t + 1)
-    src = jnp.concatenate([b0[0], b1[0]])
-    dest = jnp.concatenate([b0[1], b1[1]])
-    arrival = jnp.concatenate([b0[2], b1[2]])
-    payload = jnp.concatenate([b0[3], b1[3]])
-    size = jnp.concatenate([b0[4], b1[4]])
-    valid = jnp.concatenate([b0[5], b1[5]])
-    n_clamped = (jnp.sum(b0[6]) + jnp.sum(b1[6])).astype(jnp.int32)
+    # Route every outbox (latency draws keyed on each step's own ms),
+    # then bin them together: one sort + one scatter pass for K ms.
+    batches = []
+    for i, out in enumerate(outs):
+        net, b, _ = _route_unicast(cfg, model, net, out,
+                                   t + i if i else t)
+        batches.append(b)
+    terms = [jnp.sum(b[6]) for b in batches]
+    n_clamped = terms[0]
+    for tm in terms[1:]:
+        n_clamped = n_clamped + tm
+    n_clamped = n_clamped.astype(jnp.int32)
+    src = jnp.concatenate([b[0] for b in batches])
+    dest = jnp.concatenate([b[1] for b in batches])
+    arrival = jnp.concatenate([b[2] for b in batches])
+    payload = jnp.concatenate([b[3] for b in batches])
+    size = jnp.concatenate([b[4] for b in batches])
+    valid = jnp.concatenate([b[5] for b in batches])
     net, n_dropped = _bin_into_ring(cfg, net, t, src, dest, arrival,
                                     payload, size, valid)
     net = net.replace(dropped=net.dropped + n_dropped,
                       clamped=net.clamped + n_clamped)
-    if cfg.bcast_slots > 0:
-        net = enqueue_broadcast(cfg, net, out0, t)
-        net = _retire_broadcasts(cfg, net, t + 1)
-        net = enqueue_broadcast(cfg, net, out1, t + 1)
-    return net.replace(time=t + 2), pstate
+    return net.replace(time=t + k), pstate
+
+
+def step_2ms(protocol, net: NetState, pstate, hints2=(None, None)):
+    """Advance TWO milliseconds in one fused engine pass — the K == 2
+    superstep (`step_kms`), kept as a named entry point because K == 2
+    is the universally-valid fusion: the engine's minimum latency of
+    1 ms is itself the floor (a send at t arrives no earlier than t+2),
+    so no latency-model floor and no self-send declaration is needed.
+    """
+    return step_kms(protocol, net, pstate, 2, hints_k=list(hints2))
 
 
 def split_spec(example, threshold=1 << 20):
@@ -534,13 +641,34 @@ def split_donate_jit(fn, treedef, big_idx):
     return call
 
 
-def superstep_ok(protocol) -> bool:
-    """True iff `step_2ms` is valid for this protocol (the chunk length
-    and entry time must additionally be even — per-call properties the
-    caller checks).  The single shared eligibility predicate: scan_chunk
-    raises on violations, Runner/harness demote to the per-ms path."""
+def unicast_floor_ms(protocol) -> int:
+    """The provable lower bound on any of this protocol's unicast
+    delivery latencies — the term that licenses a K-ms superstep window
+    (K <= floor + 1, `step_kms`).
+
+    `full_latency` pins src == dst sends to 1 ms REGARDLESS of the
+    latency model, so the model's `latency_floor_ms` only applies to
+    protocols that declare ``may_self_send = False`` (an audited promise
+    that step() never emits a unicast with dest == src).  The default —
+    no declaration — is the conservative 1: every protocol then still
+    gets the universally-valid K == 2 fusion, never an unsound K."""
+    if getattr(protocol, "may_self_send", True):
+        return 1
+    return latency_floor_ms(protocol.latency)
+
+
+def superstep_ok(protocol, superstep: int = 2) -> bool:
+    """True iff `step_kms` with this K is valid for this protocol (the
+    chunk length and entry time must additionally be K-aligned —
+    per-call properties the caller checks).  The single shared
+    eligibility predicate: scan_chunk raises on violations,
+    Runner/harness demote to the largest valid K (`pick_superstep`)."""
     cfg = protocol.cfg
-    return (cfg.spill_cap == 0 and cfg.horizon % 2 == 0
+    return (cfg.spill_cap == 0
+            and superstep >= 1
+            and cfg.horizon % superstep == 0
+            and superstep < cfg.horizon
+            and superstep <= unicast_floor_ms(protocol) + 1
             and not getattr(protocol, "mutates_liveness", False))
 
 
@@ -558,14 +686,28 @@ def fast_forward_ok(protocol) -> bool:
 def check_chunk_config(protocol, ms, t0_mod=None, superstep=1,
                        fast_forward=False):
     """The shared eligibility gate for the engine chunk variants — plain
-    scan, fused superstep=2, phase-specialized, fast-forward.
+    scan, fused superstep-K, phase-specialized, fast-forward.
     `scan_chunk` and the fast-forward builders (including the batched
     ones) route through it so each shared constraint and its remedy are
     stated in one place; the batched engine layers its own narrower
-    preconditions (broadcast-free, even chunk) on top."""
+    preconditions (broadcast-free) on top.  The gate RAISES — it never
+    silently changes results; drivers that want automatic demotion pick
+    through `pick_superstep` before building.
+
+    One obligation is structurally out of the gate's reach: the chunk
+    builder never sees the ABSOLUTE entry time, so with superstep K it
+    can verify K-alignment only as far as `t0_mod` (a residue mod the
+    schedule lcm) pins it — completely when K | lcm, only mod
+    gcd(K, lcm) otherwise, and not at all without phase specialization.
+    Entering a superstep-K chunk at a time that is not a multiple of K
+    is a CONTRACT VIOLATION the compiled window cannot detect (the
+    K-row ring reads/clears land on the wrong rows); callers that know
+    t0 must route through `pick_superstep(t0=...)`, which checks the
+    absolute alignment (all in-tree drivers do)."""
     cfg = protocol.cfg
-    if superstep not in (1, 2):
-        raise ValueError(f"superstep must be 1 or 2, got {superstep}")
+    if not isinstance(superstep, int) or superstep < 1:
+        raise ValueError(f"superstep must be a positive int, got "
+                         f"{superstep!r}")
     if fast_forward:
         if t0_mod is not None:
             raise ValueError(
@@ -583,35 +725,124 @@ def check_chunk_config(protocol, ms, t0_mod=None, superstep=1,
                 "buffer every ms, so a skipped window could miss a "
                 "re-injection. Use a horizon that covers the latency "
                 "tail instead of spill, or run without fast_forward")
-    if superstep == 2:
-        if fast_forward:
+    if superstep >= 2:
+        k = superstep
+        even = "an even" if k == 2 else f"a multiple-of-{k}"
+        if cfg.spill_cap > 0:
             raise ValueError(
-                "fast_forward + superstep=2 is not supported in "
-                "scan_chunk (the fused pair would straddle jump "
-                "targets); use core/batched.fast_forward_chunk_batched "
-                "for the fused+fast-forward engine, or superstep=1 here")
-        # step_2ms preconditions (see its docstring).  Entry-time
-        # evenness cannot be checked statically for t0_mod=None callers;
-        # every in-tree driver enters at an even time (init time=0, even
-        # chunks), and the phase-specialized path checks t0_mod below.
-        if not superstep_ok(protocol) or ms % 2:
+                f"superstep={k} needs spill_cap == 0 (got "
+                f"{cfg.spill_cap}): the spill drain is inherently "
+                "per-ms. Fix: size the horizon for the latency tail "
+                "instead of spill, or fall back to superstep=1")
+        if getattr(protocol, "mutates_liveness", False):
             raise ValueError(
-                f"superstep=2 needs spill_cap == 0 (got {cfg.spill_cap}), "
-                f"an even horizon (got {cfg.horizon}), an even chunk "
-                f"(got {ms}), and a protocol whose step() does not mutate "
-                "node liveness (the second ms's inbox is built before the "
-                "first ms's step runs). Fix: make the chunk length even "
-                "(or pad the horizon to even), or fall back to "
-                "superstep=1 for this protocol/config")
-        if t0_mod is not None and t0_mod % 2:
+                f"superstep={k} needs a protocol whose step() does not "
+                "mutate node liveness (every inbox validity check in the "
+                "window is evaluated against window-entry down/partition "
+                "state). Fix: superstep=1 for this protocol")
+        if cfg.horizon % k or k >= cfg.horizon:
             raise ValueError(
-                f"superstep=2 needs an even entry time (t0_mod={t0_mod})."
-                " Fix: enter on an even chunk boundary (an even t0_mod — "
-                "in-tree drivers start at time 0 and use even chunks; "
-                "burn one odd-length superstep=1 chunk first to realign),"
-                " or keep superstep=1 for this chunk. (allow_unaligned "
-                "only relaxes the schedule-lcm length check, not entry "
-                "parity — it cannot fix this one.)")
+                f"superstep={k} needs K to divide the horizon with room "
+                f"to spare (horizon {cfg.horizon}): the K consumed ring "
+                "rows are read and cleared as one contiguous window. "
+                f"Fix: pad the horizon to a multiple of {k} (at least "
+                f"{2 * k}), or lower K")
+        floor = unicast_floor_ms(protocol)
+        if k > floor + 1:
+            self_send = getattr(protocol, "may_self_send", True)
+            why = (
+                "the protocol has not declared may_self_send = False, "
+                "and a self-addressed unicast always arrives in exactly "
+                "1 ms (full_latency pins src == dst), so only the "
+                "universal K = 2 window is provable"
+                if self_send else
+                f"{protocol.latency!r} proves latency_floor_ms() = "
+                f"{floor}, and a unicast sent at the window's first ms "
+                f"can arrive {floor + 1} ms later — inside any window "
+                f"longer than {floor + 1}")
+            raise ValueError(
+                f"superstep={k} exceeds the provable quiet window: {why}."
+                f" Fix: use superstep <= {floor + 1}, switch to a latency"
+                " model with a floor >= K-1 ms (e.g. NetworkFixedLatency,"
+                " EthScanNetworkLatency), or — if step() provably never "
+                "emits a unicast with dest == src — declare "
+                "may_self_send = False on the protocol")
+        if ms % k:
+            raise ValueError(
+                f"superstep={k} needs {even} chunk (got {ms}): the scan "
+                f"advances in fused {k}-ms windows. Fix: make the chunk "
+                f"length a multiple of {k}, or fall back to a smaller "
+                "superstep for this chunk")
+        if t0_mod is not None:
+            # `t0_mod` is a residue mod the schedule lcm, so it pins the
+            # absolute entry time only mod gcd(K, lcm) — that is the
+            # provable part.  When K | lcm the check is complete
+            # (t0 % K == t0_mod % K); otherwise K-alignment of the
+            # ABSOLUTE entry time cannot be decided from t0_mod at all
+            # and remains the caller's contract (`pick_superstep` sees
+            # the real t0 and verifies it for the in-tree drivers; a
+            # misaligned entry would make the K-row ring window
+            # read/clear the wrong rows with no runtime error).
+            import math
+            sched = getattr(protocol, "schedule_lcm", None)
+            g = math.gcd(k, sched) if sched else k
+            if t0_mod % g:
+                raise ValueError(
+                    f"superstep={k} needs {even} entry time "
+                    f"(t0_mod={t0_mod} is not 0 mod "
+                    f"gcd(K, schedule_lcm)={g}, so NO absolute entry "
+                    f"time can satisfy both time % lcm == {t0_mod} and "
+                    f"the K-aligned window contract): the window's ring "
+                    "rows are read as one K-aligned block. Fix: enter "
+                    "on a K-aligned chunk boundary (in-tree drivers "
+                    f"start at time 0 and use multiple-of-{k} chunks; "
+                    "burn one unaligned superstep=1 chunk first to "
+                    "realign), or keep superstep=1 for this chunk. "
+                    "(allow_unaligned only relaxes the schedule-lcm "
+                    "length check, not entry alignment — it cannot fix "
+                    "this one.)")
+
+
+#: Default upper bound for auto-picked superstep windows: past ~32 the
+#: amortized fixed cost is already < 1/32 of its per-ms value while the
+#: unrolled window body keeps growing compile time linearly.
+AUTO_SUPERSTEP_MAX = 32
+
+
+def pick_superstep(protocol, ms, t0=None, max_k: int = AUTO_SUPERSTEP_MAX,
+                   also_divides=None, lcm=None) -> int:
+    """The largest K for which `step_kms` is provably exact for chunks
+    of `ms` entered at absolute time `t0` (and every later boundary
+    ``t0 + j*ms`` — `ms % K == 0` keeps the alignment invariant across
+    chunk reuse).  ``t0=None`` (entry time unknown, e.g. a traced
+    value) conservatively returns 1.  `also_divides` adds a caller
+    divisibility constraint (the obs interval: a K window must never
+    straddle a `stat_each_ms` row); `lcm` adds the phase-specialized
+    scan's constraints (chunk a multiple of the K-adjusted schedule
+    lcm, K-aligned entry phase).  Never raises — this is the demotion
+    half of the gate; `check_chunk_config` is the raising half."""
+    import math
+
+    ms = int(ms)
+    best = 1
+    for k in range(2, min(int(max_k), ms) + 1):
+        if ms % k or (t0 is None or int(t0) % k):
+            continue
+        if also_divides is not None and also_divides % k:
+            continue
+        if lcm:
+            # Only the chunk length constrains the phase-specialized
+            # scan: its hint block spans lcm_k and k | lcm_k, so the
+            # `t0 % k == 0` check above already K-aligns every window
+            # start regardless of the entry's schedule residue (a
+            # residue-based re-check here would demote K=8 for e.g.
+            # t0=24, lcm=20 — a perfectly valid aligned entry).
+            lcm_k = lcm * k // math.gcd(lcm, k)
+            if ms % lcm_k:
+                continue
+        if superstep_ok(protocol, k):
+            best = k
+    return best
 
 
 def next_work(protocol, net: NetState, pstate, t):
@@ -669,7 +900,8 @@ def _jump(cfg: EngineConfig, net: NetState, dt, t2):
     return net.replace(time=net.time + dt)
 
 
-def fast_forward_chunk(protocol, ms: int, seed_axis: bool = False):
+def fast_forward_chunk(protocol, ms: int, seed_axis: bool = False,
+                       superstep: int = 1):
     """Quiet-window fast-forwarding chunk: advance exactly `ms`
     simulated milliseconds as one `lax.while_loop` that runs a full
     `step_ms` body only on milliseconds that can contain work and jumps
@@ -691,9 +923,17 @@ def fast_forward_chunk(protocol, ms: int, seed_axis: bool = False):
     accounting that makes a fast-forward speedup attributable
     (`bench.py` reports both).  `scan_chunk(fast_forward=True)` wraps
     this and drops the stats for interface-compatible callers.
+
+    ``superstep=K`` runs the loop body as one fused `step_kms` window
+    (jump to the next work, then advance in K-aligned supersteps): jump
+    offsets are floored to multiples of K so every loop entry satisfies
+    the superstep's alignment contract — an unaligned oracle target
+    lands up to K-1 quiet ms early, which is sound (those ms are no-op
+    steps the window simply executes).
     """
-    check_chunk_config(protocol, ms, fast_forward=True)
-    cfg = protocol.cfg
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    cfg, k = protocol.cfg, superstep
 
     def run(net, pstate):
         t0 = net.time[0] if seed_axis else net.time
@@ -707,19 +947,21 @@ def fast_forward_chunk(protocol, ms: int, seed_axis: bool = False):
             net, ps, skipped, jumps = carry
             if seed_axis:
                 net, ps = jax.vmap(
-                    lambda n_, p_: step_ms(protocol, n_, p_))(net, ps)
+                    lambda n_, p_: step_kms(protocol, n_, p_, k))(net, ps)
                 t1 = net.time[0]
                 nw = jnp.min(jax.vmap(
                     lambda n_, p_: next_work(protocol, n_, p_, t1))(
                     net, ps))
             else:
-                net, ps = step_ms(protocol, net, ps)
+                net, ps = step_kms(protocol, net, ps, k)
                 t1 = net.time
                 nw = next_work(protocol, net, ps, t1)
-            nw = jnp.clip(nw, t1, t_end)
-            net = _jump(cfg, net, nw - t1, nw)
-            return (net, ps, skipped + (nw - t1),
-                    jumps + (nw > t1).astype(jnp.int32))
+            dt = jnp.clip(nw, t1, t_end) - t1
+            if k > 1:
+                dt = dt - dt % k          # keep entry times K-aligned
+            net = _jump(cfg, net, dt, t1 + dt)
+            return (net, ps, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
 
         z = jnp.asarray(0, jnp.int32)
         net, pstate, skipped, jumps = jax.lax.while_loop(
@@ -762,16 +1004,22 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
     ``allow_unaligned=True`` (the sub-lcm tail is unrolled after the
     block scan); the next chunk's t0_mod is then ``(t0_mod + ms) % lcm``.
 
+    ``superstep=K`` advances in fused K-ms engine windows (`step_kms` —
+    bit-identical, tests/test_superstep.py) when the K-aware gate
+    (`check_chunk_config`) proves the window: K <= the protocol's
+    unicast latency floor + 1, K | horizon, K | chunk, K-aligned entry.
+
     ``fast_forward=True`` swaps the dense scan for the quiet-window
     `lax.while_loop` engine (`fast_forward_chunk` — bit-identical,
     tests/test_fast_forward.py), dropping the skip statistics; callers
-    that want them use `fast_forward_chunk` directly.  Incompatible with
-    `t0_mod`/`superstep=2` (see `check_chunk_config` for the remedies).
+    that want them use `fast_forward_chunk` directly.  Composes with
+    `superstep` (K-aligned jumps) but not with `t0_mod` (see
+    `check_chunk_config` for the remedy).
     """
     check_chunk_config(protocol, ms, t0_mod=t0_mod, superstep=superstep,
                        fast_forward=fast_forward)
     if fast_forward:
-        base_ff = fast_forward_chunk(protocol, ms)
+        base_ff = fast_forward_chunk(protocol, ms, superstep=superstep)
 
         def run_ff(net, pstate):
             net, pstate, _ = base_ff(net, pstate)
@@ -780,8 +1028,10 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
         return run_ff
     lcm = getattr(protocol, "schedule_lcm", None) if t0_mod is not None \
         else None
-    if lcm and superstep == 2 and lcm % 2:
-        lcm *= 2                    # pair hints across an even super-period
+    if lcm and superstep > 1 and lcm % superstep:
+        # Group hints across a K-aligned super-period.
+        import math
+        lcm = lcm * superstep // math.gcd(lcm, superstep)
     if lcm:
         if ms % lcm and not allow_unaligned:
             raise ValueError(
@@ -799,10 +1049,11 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
         def run_spec(net, pstate):
             def body(carry, _):
                 net, ps = carry
-                if superstep == 2:
-                    for i in range(0, len(hints), 2):
-                        net, ps = step_2ms(protocol, net, ps,
-                                           hints2=(hints[i], hints[i + 1]))
+                if superstep > 1:
+                    for i in range(0, len(hints), superstep):
+                        net, ps = step_kms(
+                            protocol, net, ps, superstep,
+                            hints_k=hints[i:i + superstep])
                 else:
                     for h in hints:
                         net, ps = step_ms(protocol, net, ps, hints=h)
@@ -816,15 +1067,15 @@ def scan_chunk(protocol, ms: int, t0_mod=None, allow_unaligned=False,
 
         return run_spec
 
-    if superstep == 2:
-        def run2(net, pstate):
+    if superstep > 1:
+        def run_k(net, pstate):
             def body(carry, _):
-                return step_2ms(protocol, *carry), ()
+                return step_kms(protocol, *carry, superstep), ()
             (net2, p2), _ = jax.lax.scan(body, (net, pstate),
-                                         length=ms // 2)
+                                         length=ms // superstep)
             return net2, p2
 
-        return run2
+        return run_k
 
     def run(net, pstate):
         def body(carry, _):
@@ -884,16 +1135,15 @@ class Runner:
         self._metrics = metrics
         self._ff_raw = []           # per-chunk device stats dicts
         self.metrics_carries = []
-        # superstep=2 fuses engine work across ms pairs (step_2ms,
-        # bit-identical).  Applied per chunk only when the chunk length
-        # and the entry time are even and the config allows it; otherwise
-        # that chunk silently runs the per-ms path (results identical).
-        # The fast-forward and instrumented engines advance per ms.
-        if superstep == 2 and (not superstep_ok(protocol)
-                               or self._fast_forward
-                               or metrics is not None):
-            superstep = 1
-        self._superstep = superstep
+        # superstep=K fuses engine work across K-ms windows (step_kms,
+        # bit-identical); the requested value is an UPPER BOUND — each
+        # chunk runs the largest K <= it that `pick_superstep` proves
+        # for the chunk length, entry time and config (a chunk that
+        # proves nothing silently runs the per-ms path, results
+        # identical).  "auto" lifts the bound to the engine default.
+        if superstep == "auto":
+            superstep = AUTO_SUPERSTEP_MAX
+        self._superstep = int(superstep)
 
     def _chunk_fn(self, ms, superstep=1):
         key = (ms, superstep)
@@ -901,12 +1151,15 @@ class Runner:
             if self._metrics is not None and self._fast_forward:
                 from ..obs.engine import fast_forward_chunk_metrics
                 base = fast_forward_chunk_metrics(self.protocol, ms,
-                                                  self._metrics)
+                                                  self._metrics,
+                                                  superstep=superstep)
             elif self._metrics is not None:
                 from ..obs.engine import scan_chunk_metrics
-                base = scan_chunk_metrics(self.protocol, ms, self._metrics)
+                base = scan_chunk_metrics(self.protocol, ms, self._metrics,
+                                          superstep=superstep)
             elif self._fast_forward:
-                base = fast_forward_chunk(self.protocol, ms)
+                base = fast_forward_chunk(self.protocol, ms,
+                                          superstep=superstep)
             else:
                 base = scan_chunk(self.protocol, ms, superstep=superstep)
             if self._donate == "big":
@@ -961,18 +1214,24 @@ class Runner:
             self._split = split_spec((net, pstate),
                                      self._donate_threshold)
         ms = int(ms)
-        # Per-chunk superstep eligibility: even chunk + (statically
-        # checkable) even entry time; a tracer entry time conservatively
-        # falls back to the per-ms path.  The entry-time readback blocks
-        # on the previous chunk, so it only happens when superstep is
-        # actually enabled — the default path keeps fully async dispatch.
+        # Per-chunk superstep eligibility: K-aligned chunk + (statically
+        # checkable) K-aligned entry time; a tracer entry time
+        # conservatively falls back to the per-ms path.  The entry-time
+        # readback blocks on the previous chunk, so it only happens when
+        # superstep is actually enabled — the default path keeps fully
+        # async dispatch.
         t_entry = None
-        if self._superstep == 2 and not isinstance(net.time,
+        if self._superstep >= 2 and not isinstance(net.time,
                                                    jax.core.Tracer):
             t_entry = int(jax.device_get(net.time).reshape(-1)[0])
+        stat_ms = (self._metrics.stat_each_ms
+                   if self._metrics is not None else None)
         def eff(chunk_ms, t0):
-            return 2 if (self._superstep == 2 and chunk_ms % 2 == 0
-                         and t0 is not None and t0 % 2 == 0) else 1
+            if self._superstep < 2:
+                return 1
+            return pick_superstep(self.protocol, chunk_ms, t0=t0,
+                                  max_k=self._superstep,
+                                  also_divides=stat_ms)
         if self.chunk_limit and ms > self.chunk_limit:
             # n_chunks equal pieces + one remainder piece at most: two
             # compiled programs for any length.

@@ -112,6 +112,12 @@ class _TickScaled:
         ms = self.inner.extended(nodes, src, dst, delta)
         return -(-ms // self.tick_ms)
 
+    def latency_floor_ms(self):
+        # Ceil-scaling is monotone, so the wrapped floor ceil-divides
+        # through (core/latency.py contract; >= 1 either way).
+        from ..core.latency import latency_floor_ms
+        return max(1, -(-latency_floor_ms(self.inner) // self.tick_ms))
+
     def __repr__(self):
         return self.name
 

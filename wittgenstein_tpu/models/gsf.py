@@ -88,6 +88,10 @@ class GSFState:
 class GSFSignature(LevelMixin):
     """Parameters mirror GSFSignatureParameters (GSFSignature.java:27-107)."""
 
+    # Dests come from sibling-half level peer sets — never self
+    # (core/network.unicast_floor_ms).
+    may_self_send = False
+
     def __init__(self, node_count=1024, threshold=None, pairing_time=3,
                  timeout_per_level_ms=50, period_duration_ms=10,
                  accelerated_calls_count=10, nodes_down=0,

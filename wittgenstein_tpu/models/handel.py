@@ -131,6 +131,12 @@ class Handel(LevelMixin, StaticScheduleMixin):
     (models/handel_cardinal.py, SCALE.md): same protocol semantics under
     count-based per-level aggregation, no O(N^2) state."""
 
+    # Every unicast dest comes from a level peer set — the SIBLING half
+    # of the node's 2^l-aligned block (models/_levels.py), which never
+    # contains the node itself — so the latency model's floor licenses
+    # superstep windows beyond 2 (core/network.unicast_floor_ms).
+    may_self_send = False
+
     def __new__(cls, *args, mode="exact", **kwargs):
         if cls is Handel and mode == "cardinal":
             from .handel_cardinal import HandelCardinal

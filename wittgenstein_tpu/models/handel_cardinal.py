@@ -109,6 +109,10 @@ class HandelCardinal(LevelMixin, StaticScheduleMixin):
     exact-mode scale switches (emission is always hashed, there is no
     snapshot pool)."""
 
+    # Dests come from sibling-half level peer sets — never self
+    # (core/network.unicast_floor_ms).
+    may_self_send = False
+
     def __init__(self, node_count=2048, threshold=None, pairing_time=3,
                  level_wait_time=50, extra_cycle=10,
                  dissemination_period_ms=10, fast_path=10, nodes_down=0,

@@ -104,6 +104,10 @@ class HandelEth2State:
 class HandelEth2(LevelMixin):
     """Parameters mirror HandelEth2Parameters (:5-69)."""
 
+    # Dests come from sibling-half level peer sets — never self
+    # (core/network.unicast_floor_ms).
+    may_self_send = False
+
     def __init__(self, node_count=64, pairing_time=3, level_wait_time=100,
                  period_duration_ms=50, nodes_down=0,
                  node_builder_name=None, network_latency_name=None,

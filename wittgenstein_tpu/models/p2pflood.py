@@ -43,6 +43,10 @@ class P2PFloodState:
 class P2PFlood:
     """Parameters mirror P2PFlood.P2PFloodParameters (P2PFlood.java:46-110)."""
 
+    # Every dest comes from the p2p peer graph, which skips self
+    # (core/p2p.build_peer_graph) — core/network.unicast_floor_ms.
+    may_self_send = False
+
     def __init__(self, node_count=100, dead_node_count=10,
                  delay_before_resent=50, msg_count=1, msg_to_receive=None,
                  peers_count=10, delay_between_sends=30,

@@ -117,6 +117,10 @@ class P2PHandelState:
 class P2PHandel:
     """Parameters mirror P2PHandelParameters (P2PHandel.java:37-112)."""
 
+    # Every dest comes from the p2p peer graph, which skips self
+    # (core/p2p.build_peer_graph) — core/network.unicast_floor_ms.
+    may_self_send = False
+
     def __init__(self, signing_node_count=100, relaying_node_count=20,
                  threshold=99, connection_count=40, pairing_time=100,
                  sigs_send_period=1000, double_aggregate_strategy=True,

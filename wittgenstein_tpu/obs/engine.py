@@ -4,7 +4,7 @@ seed-folded pair in `core/batched`.
 
 Each builder returns the uninstrumented engine's result tuple with a
 `MetricsCarry` appended; the simulation dataflow is the SAME functions
-(`step_ms`, `step_2ms_batched`, the oracle, the jump) — the recorder
+(`step_kms`, `step_kms_batched`, the oracle, the jump) — the recorder
 only reads the carried state between steps, which is what the
 bit-identity tests in tests/test_obs.py pin.  The instrumented dense
 path runs the per-ms engine (superstep=1); every engine variant is
@@ -22,9 +22,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.batched import step_2ms_batched
+from ..core.batched import step_kms_batched
 from ..core.network import (check_chunk_config, fast_forward_ok, next_work,
-                            step_ms, superstep_ok, _jump)
+                            step_kms, step_ms, superstep_ok, _jump)
 from .plane import init_metrics, record_jump, record_step
 from .spec import MetricsSpec
 
@@ -36,28 +36,53 @@ def step_ms_metrics(protocol, spec: MetricsSpec, net, pstate, mc):
     return net, pstate, record_step(spec, mc, net)
 
 
-def scan_chunk_metrics(protocol, ms: int, spec: MetricsSpec):
+def _check_superstep_interval(spec: MetricsSpec, superstep: int):
+    """K-window recording samples at window boundaries, so a window must
+    never straddle a `stat_each_ms` row — the counter attribution the
+    interval recorder promises."""
+    if superstep > 1 and spec.stat_each_ms % superstep:
+        raise ValueError(
+            f"the superstep={superstep} engine advances in fused "
+            f"{superstep}-ms windows, so stat_each_ms must be a multiple "
+            f"of it (got {spec.stat_each_ms}) — a straddling interval "
+            "would sample mid-window state the fused step never "
+            "materializes. Fix: pick stat_each_ms divisible by the "
+            "superstep, or a smaller superstep")
+
+
+def scan_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
+                       superstep: int = 1):
     """Returns ``run(net, pstate) -> (net, pstate, MetricsCarry)``
-    advancing `ms` milliseconds as one per-ms `lax.scan` with the
-    recorder in the carry — the instrumented twin of
-    `scan_chunk(protocol, ms)`."""
-    check_chunk_config(protocol, ms)
+    advancing `ms` milliseconds as one `lax.scan` with the recorder in
+    the carry — the instrumented twin of
+    `scan_chunk(protocol, ms, superstep=K)`.  K-window bodies record
+    once per window with ``n_steps=K`` (sampling granularity is the
+    window; `stat_each_ms` must be a multiple of K so rows never
+    straddle one — same convention as the batched fused-pair engine)."""
+    check_chunk_config(protocol, ms, superstep=superstep)
+    _check_superstep_interval(spec, superstep)
+    k = superstep
 
     def run(net, pstate):
         mc = init_metrics(spec, ms, net.time)
 
         def body(carry, _):
-            return step_ms_metrics(protocol, spec, *carry), ()
+            if k == 1:
+                return step_ms_metrics(protocol, spec, *carry), ()
+            net, ps, mc = carry
+            net, ps = step_kms(protocol, net, ps, k)
+            return (net, ps, record_step(spec, mc, net, n_steps=k)), ()
 
         (net2, p2, mc), _ = jax.lax.scan(body, (net, pstate, mc),
-                                         length=ms)
+                                         length=ms // k)
         return net2, p2, mc
 
     return run
 
 
 def fast_forward_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
-                               seed_axis: bool = False):
+                               seed_axis: bool = False,
+                               superstep: int = 1):
     """Instrumented twin of `fast_forward_chunk`: returns
     ``run(net, pstate) -> (net, pstate, stats, MetricsCarry)``.  Jumps
     land in the `ff_skipped_ms`/`ff_jumps` columns of their origin
@@ -65,9 +90,12 @@ def fast_forward_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
     ``samples == 0`` (host-side forward fill — exact, since a skipped
     ms is a no-op step).  ``seed_axis=True`` mirrors the engine's
     vmap-batched mode: per-seed recorders (series ``[R, T, K]``),
-    lockstep rows."""
-    check_chunk_config(protocol, ms, fast_forward=True)
-    cfg = protocol.cfg
+    lockstep rows.  ``superstep=K`` fuses the loop body into K-ms
+    windows with K-aligned jumps, recording once per window."""
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    _check_superstep_interval(spec, superstep)
+    cfg, k = protocol.cfg, superstep
 
     def run(net, pstate):
         t0 = net.time[0] if seed_axis else net.time
@@ -86,27 +114,30 @@ def fast_forward_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
             net, ps, mc, skipped, jumps = carry
             if seed_axis:
                 net, ps = jax.vmap(
-                    lambda n_, p_: step_ms(protocol, n_, p_))(net, ps)
-                mc = jax.vmap(lambda m_, n_: record_step(spec, m_, n_))(
+                    lambda n_, p_: step_kms(protocol, n_, p_, k))(net, ps)
+                mc = jax.vmap(
+                    lambda m_, n_: record_step(spec, m_, n_, n_steps=k))(
                     mc, net)
                 t1 = net.time[0]
                 nw = jnp.min(jax.vmap(
                     lambda n_, p_: next_work(protocol, n_, p_, t1))(
                     net, ps))
             else:
-                net, ps = step_ms(protocol, net, ps)
-                mc = record_step(spec, mc, net)
+                net, ps = step_kms(protocol, net, ps, k)
+                mc = record_step(spec, mc, net, n_steps=k)
                 t1 = net.time
                 nw = next_work(protocol, net, ps, t1)
-            nw = jnp.clip(nw, t1, t_end)
-            net = _jump(cfg, net, nw - t1, nw)
+            dt = jnp.clip(nw, t1, t_end) - t1
+            if k > 1:
+                dt = dt - dt % k          # keep entry times K-aligned
+            net = _jump(cfg, net, dt, t1 + dt)
             if seed_axis:
                 mc = jax.vmap(
-                    lambda m_: record_jump(spec, m_, t1, nw - t1))(mc)
+                    lambda m_: record_jump(spec, m_, t1, dt))(mc)
             else:
-                mc = record_jump(spec, mc, t1, nw - t1)
-            return (net, ps, mc, skipped + (nw - t1),
-                    jumps + (nw > t1).astype(jnp.int32))
+                mc = record_jump(spec, mc, t1, dt)
+            return (net, ps, mc, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
 
         z = jnp.asarray(0, jnp.int32)
         net, pstate, mc, skipped, jumps = jax.lax.while_loop(
@@ -117,42 +148,43 @@ def fast_forward_chunk_metrics(protocol, ms: int, spec: MetricsSpec,
     return run
 
 
-def _check_batched(protocol, ms: int, spec: MetricsSpec):
-    if (ms % 2 or protocol.cfg.spill_cap or protocol.cfg.bcast_slots
-            or not superstep_ok(protocol)):
-        raise ValueError("the batched metrics builders need an even chunk "
+def _check_batched(protocol, ms: int, spec: MetricsSpec,
+                   superstep: int = 2):
+    if (superstep < 2 or ms % superstep or protocol.cfg.spill_cap
+            or protocol.cfg.bcast_slots
+            or not superstep_ok(protocol, superstep)):
+        raise ValueError("the batched metrics builders need a chunk that "
+                         f"is a multiple of superstep={superstep} (>= 2) "
                          "and a spill-free, broadcast-free, superstep-"
                          "eligible protocol (core/batched.py scope)")
-    if spec.stat_each_ms % 2:
-        raise ValueError(
-            f"the batched engine advances in fused 2-ms pairs, so "
-            f"stat_each_ms must be even (got {spec.stat_each_ms}) — an "
-            "odd interval would straddle a pair and sample mid-pair "
-            "state that the fused step never materializes")
+    _check_superstep_interval(spec, superstep)
 
 
 def scan_chunk_batched_metrics(protocol, ms: int, spec: MetricsSpec,
-                               plane_barrier: bool = True):
+                               plane_barrier: bool = True,
+                               superstep: int = 2):
     """Instrumented twin of `scan_chunk_batched`: per-seed recorders
-    over the seed-folded fused engine; each `step_2ms_batched` pass
-    records once with ``n_steps=2`` (sampling granularity is the fused
-    pair — `stat_each_ms` must be even, so rows never straddle one)."""
-    _check_batched(protocol, ms, spec)
+    over the seed-folded fused engine; each `step_kms_batched` pass
+    records once with ``n_steps=K`` (sampling granularity is the fused
+    window — `stat_each_ms` must be a multiple of K, so rows never
+    straddle one)."""
+    _check_batched(protocol, ms, spec, superstep)
+    k = superstep
 
     def run(net, pstate):
         mc0 = jax.vmap(lambda t: init_metrics(spec, ms, t))(net.time)
 
         def body(carry, _):
             net, ps, mc = carry
-            net, ps = step_2ms_batched(protocol, net, ps,
+            net, ps = step_kms_batched(protocol, net, ps, k,
                                        plane_barrier=plane_barrier)
             mc = jax.vmap(
-                lambda m_, n_: record_step(spec, m_, n_, n_steps=2))(
+                lambda m_, n_: record_step(spec, m_, n_, n_steps=k))(
                 mc, net)
             return (net, ps, mc), ()
 
         (net2, p2, mc), _ = jax.lax.scan(body, (net, pstate, mc0),
-                                         length=ms // 2)
+                                         length=ms // k)
         return net2, p2, mc
 
     return run
@@ -160,17 +192,20 @@ def scan_chunk_batched_metrics(protocol, ms: int, spec: MetricsSpec,
 
 def fast_forward_chunk_batched_metrics(protocol, ms: int,
                                        spec: MetricsSpec,
-                                       plane_barrier: bool = True):
+                                       plane_barrier: bool = True,
+                                       superstep: int = 2):
     """Instrumented twin of `fast_forward_chunk_batched` (batch-min
-    oracle, even-aligned jumps): returns ``run(net, pstate) ->
+    oracle, K-aligned jumps): returns ``run(net, pstate) ->
     (net, pstate, stats, MetricsCarry)`` with per-seed recorders."""
-    check_chunk_config(protocol, ms, fast_forward=True)
-    _check_batched(protocol, ms, spec)
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    _check_batched(protocol, ms, spec, superstep)
     if not fast_forward_ok(protocol):
         raise ValueError("fast_forward_chunk_batched_metrics needs a "
                          "protocol implementing next_action_time — same "
                          "precondition as the uninstrumented engine")
     from ..core.batched import _next_work_batched
+    k = superstep
 
     def run(net, pstate):
         t_end = net.time[0] + ms
@@ -181,15 +216,15 @@ def fast_forward_chunk_batched_metrics(protocol, ms: int,
 
         def body(carry):
             net, ps, mc, skipped, jumps = carry
-            net, ps = step_2ms_batched(protocol, net, ps,
+            net, ps = step_kms_batched(protocol, net, ps, k,
                                        plane_barrier=plane_barrier)
             mc = jax.vmap(
-                lambda m_, n_: record_step(spec, m_, n_, n_steps=2))(
+                lambda m_, n_: record_step(spec, m_, n_, n_steps=k))(
                 mc, net)
             t1 = net.time[0]
             nw = jnp.clip(_next_work_batched(protocol, net, ps, t1),
                           t1, t_end)
-            dt = (nw - t1) - (nw - t1) % 2        # keep entry times even
+            dt = (nw - t1) - (nw - t1) % k    # keep entry times K-aligned
             net = net.replace(time=net.time + dt)
             mc = jax.vmap(lambda m_: record_jump(spec, m_, t1, dt))(mc)
             return (net, ps, mc, skipped + dt,

@@ -222,10 +222,27 @@ class ShardedRunner:
             lat = lat + extra_all[src_g] + extra_all[dst_g]
         return jnp.maximum(1, lat) * (src_g != dst_g) + (src_g == dst_g)
 
-    def step_fn(self):
-        """Returns the shard_map'ed single-ms step."""
+    def step_fn(self, superstep: int = 1):
+        """Returns the shard_map'ed step: one simulated ms (default), or
+        one fused K-ms superstep window.
+
+        The K generalization mirrors `core/network.step_kms`: the local
+        ring rows are untouched inside the window (K <= the protocol's
+        unicast latency floor + 1, gated by the caller through
+        `check_chunk_config`), so the window runs K local inbox reads
+        and protocol steps with per-ms-exact broadcast interleaving,
+        then ONE K-row slot clear, ONE outbox split with per-ms ranks
+        (cross-shard drop semantics stay exactly per-ms: each origin ms
+        keeps its own xcap sub-bucket), ONE `all_to_all` ICI exchange —
+        the sharded engine's per-ms fixed cost — and ONE sort+scatter
+        bin of the received window (reordered origin-ms-major so same-
+        (ms, dest) slot order matches the sequential path bit-for-bit).
+        Messages carry their origin-ms offset through the exchange so
+        the receiver keys each latency draw on the origin ms, exactly
+        as the per-ms path does."""
         cfg, lcfg, S = self.protocol.cfg, self.lcfg, self.n_shards
         nl, k, xcap = self.n_local, cfg.out_deg, self.xcap
+        K = superstep
         proto = self.protocol
         fw = cfg.payload_words
 
@@ -251,47 +268,124 @@ class ShardedRunner:
             else:
                 tables = None
             snet = snet.replace(net=net)
-            net = net.replace(bc_active=net.bc_active & (
-                (t - net.bc_time) < cfg.horizon))
-            inbox, nodes = self._local_inbox(snet.replace(net=net), t,
-                                             part_all, extra_all, tables)
-            key = jax.random.fold_in(jax.random.PRNGKey(net.seed), t)
             gids0 = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
             step = getattr(proto, "step_sharded", None)
-            if step is not None:
-                # Shard-aware protocols receive their GLOBAL node ids.
-                pstate, nodes, out = step(pstate, nodes, inbox, t, key,
-                                          gids0)
-            else:
-                pstate, nodes, out = proto.step(pstate, nodes, inbox, t, key)
-            net = net.replace(nodes=nodes,
-                              box_count=net.box_count.at[
-                                  t % cfg.horizon].set(0))
 
-            # ---- split outbox by destination shard ----
-            # Width may be narrower than cfg.out_deg (Outbox.slot0): the
-            # latency key below stays on the full-width slot id.
-            ke = out.dest.shape[1]
-            m = nl * ke
-            gids = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
-            src_g = jnp.repeat(gids, ke)
-            dest = out.dest.reshape(m)
-            payload = out.payload.reshape(m, fw)
-            size = out.size.reshape(m)
-            delay = out.delay.reshape(m)
-            want = (dest >= 0) & (~nodes.down[jnp.arange(m) // ke])
+            # ---- K protocol steps: per-ms local inbox reads (the local
+            # ring is untouched inside the window — binning is deferred)
+            # with per-ms-exact broadcast retire/deliver/enqueue ----
+            parts = []          # per-ms flattened outbox batches
+            for i in range(K):
+                ti = t + i
+                net = net.replace(bc_active=net.bc_active & (
+                    (ti - net.bc_time) < cfg.horizon))
+                inbox, nodes = self._local_inbox(snet.replace(net=net), ti,
+                                                 part_all, extra_all,
+                                                 tables)
+                key = jax.random.fold_in(jax.random.PRNGKey(net.seed), ti)
+                if step is not None:
+                    # Shard-aware protocols receive their GLOBAL node ids.
+                    pstate, nodes, out = step(pstate, nodes, inbox, ti,
+                                              key, gids0)
+                else:
+                    pstate, nodes, out = proto.step(pstate, nodes, inbox,
+                                                    ti, key)
+                # Width may be narrower than cfg.out_deg (Outbox.slot0):
+                # the latency key below stays on the full-width slot id.
+                ke = out.dest.shape[1]
+                m = nl * ke
+                dest_i = out.dest.reshape(m)
+                size_i = out.size.reshape(m)
+                want_i = (dest_i >= 0) & (~nodes.down[jnp.arange(m) // ke])
+                # counters for attempted sends (parity w/ enqueue_unicast)
+                sent = nodes.msg_sent.at[jnp.arange(m) // ke].add(
+                    want_i.astype(jnp.int32))
+                sbytes = nodes.bytes_sent.at[jnp.arange(m) // ke].add(
+                    jnp.where(want_i, size_i, 0))
+                nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
+                net = net.replace(nodes=nodes)
+                parts.append((
+                    jnp.repeat(gids0, ke),              # global src ids
+                    dest_i,
+                    out.payload.reshape(m, fw),
+                    size_i,
+                    out.delay.reshape(m),
+                    # Global stable message index (src_g * out_deg + slot
+                    # id): the single-chip engine keys its latency delta
+                    # on exactly this (enqueue_unicast), so carrying it
+                    # through the exchange keeps jittered models
+                    # bit-identical to the unsharded run.
+                    jnp.repeat(gids0, ke) * k + out.slot0 +
+                    jnp.arange(m, dtype=jnp.int32) % ke,
+                    jnp.full((m,), i, jnp.int32),       # origin-ms offset
+                    want_i,
+                ))
+                # ---- broadcasts: replicated table, all shards agree ----
+                req = out.bcast & (~nodes.down)
+                # gather every shard's requests (replicated result)
+                req_all = jax.lax.all_gather(req, "sp").reshape(-1)
+                pl_all = jax.lax.all_gather(out.bcast_payload,
+                                            "sp").reshape(cfg.n, fw)
+                sz_all = jax.lax.all_gather(out.bcast_size,
+                                            "sp").reshape(-1)
+                gout = empty_outbox(cfg).replace(
+                    bcast=req_all, bcast_payload=pl_all, bcast_size=sz_all)
+                # reuse the single-chip broadcast allocator on a stub net
+                # (bc_* fields are global); counters from it are per-
+                # GLOBAL-node, so apply the local slice separately
+                gnet2 = net_mod.enqueue_broadcast(
+                    EngineConfig(n=cfg.n, horizon=cfg.horizon,
+                                 inbox_cap=cfg.inbox_cap,
+                                 payload_words=fw, out_deg=cfg.out_deg,
+                                 bcast_slots=cfg.bcast_slots),
+                    net.replace(nodes=jax.tree.map(
+                        lambda x: jnp.zeros((cfg.n,) + x.shape[1:],
+                                            x.dtype),
+                        net.nodes)), gout, ti)
+                bsent = net.nodes.msg_sent + jnp.where(
+                    req, cfg.n, 0).astype(jnp.int32)
+                bbytes = net.nodes.bytes_sent + jnp.where(
+                    req, out.bcast_size * cfg.n, 0)
+                net = net.replace(
+                    nodes=net.nodes.replace(msg_sent=bsent,
+                                            bytes_sent=bbytes),
+                    bc_active=gnet2.bc_active, bc_src=gnet2.bc_src,
+                    bc_time=gnet2.bc_time, bc_payload=gnet2.bc_payload,
+                    bc_size=gnet2.bc_size, bc_seed=gnet2.bc_seed,
+                    bc_dropped=gnet2.bc_dropped)
+
+            # ---- ONE K-row slot clear (entry time ≡ 0 mod K: no wrap) --
+            net = net.replace(box_count=jax.lax.dynamic_update_slice(
+                net.box_count, jnp.zeros((K, nl), jnp.int32),
+                (t % cfg.horizon, 0)))
+
+            # ---- split the window's outboxes by destination shard ----
+            # Rank per (dest-shard, ORIGIN MS) group: each origin ms
+            # keeps its own xcap sub-bucket, so cross-shard drop
+            # semantics stay exactly per-ms whatever K is.
+            src_g = jnp.concatenate([p[0] for p in parts])
+            dest = jnp.concatenate([p[1] for p in parts])
+            payload = jnp.concatenate([p[2] for p in parts])
+            size = jnp.concatenate([p[3] for p in parts])
+            delay = jnp.concatenate([p[4] for p in parts])
+            midx = jnp.concatenate([p[5] for p in parts])
+            toff = jnp.concatenate([p[6] for p in parts])
+            want = jnp.concatenate([p[7] for p in parts])
+            ma = src_g.shape[0]
             dshard = jnp.clip(dest, 0, cfg.n - 1) // nl
-            # rank within destination-shard group
             order = jnp.argsort(jnp.where(want, dshard, S), stable=True)
             ds_s = jnp.where(want, dshard, S)[order]
-            idx = jnp.arange(m, dtype=jnp.int32)
-            new_grp = (ds_s != jnp.roll(ds_s, 1)).at[0].set(True)
+            to_s = toff[order]
+            idx = jnp.arange(ma, dtype=jnp.int32)
+            new_grp = ((ds_s != jnp.roll(ds_s, 1)) |
+                       (to_s != jnp.roll(to_s, 1))).at[0].set(True)
             rank = idx - jax.lax.cummax(jnp.where(new_grp, idx, 0))
             ok_s = (ds_s < S) & (rank < xcap)
-            slot = jnp.where(ok_s, ds_s * xcap + rank, S * xcap)
-            # bucket fields [S * xcap, ...]
+            slot = jnp.where(ok_s, (ds_s * K + to_s) * xcap + rank,
+                             S * K * xcap)
+            # bucket fields [S * K * xcap, ...]
             def scatter(vals, fill):
-                buf = jnp.full((S * xcap,) + vals.shape[1:], fill,
+                buf = jnp.full((S * K * xcap,) + vals.shape[1:], fill,
                                vals.dtype)
                 return buf.at[slot].set(vals[order], mode="drop")
             b_src = scatter(src_g, -1)
@@ -299,39 +393,40 @@ class ShardedRunner:
             b_payload = scatter(payload, 0)
             b_size = scatter(size, 0)
             b_delay = scatter(delay, 0)
-            # Global stable message index (src_g * out_deg + slot id): the
-            # single-chip engine keys its latency delta on exactly this
-            # (enqueue_unicast), so carrying it through the exchange keeps
-            # jittered models bit-identical to the unsharded run.
-            b_midx = scatter(src_g * k + out.slot0 + idx % ke, 0)
+            b_midx = scatter(midx, 0)
+            b_toff = scatter(toff, 0)
             xdrop = jnp.sum((ds_s < S) & ~ok_s).astype(jnp.int32)
 
-            # counters for attempted sends (parity with enqueue_unicast)
-            sent = nodes.msg_sent.at[jnp.arange(m) // ke].add(
-                want.astype(jnp.int32))
-            sbytes = nodes.bytes_sent.at[jnp.arange(m) // ke].add(
-                jnp.where(want, size, 0))
-            net = net.replace(nodes=nodes.replace(msg_sent=sent,
-                                                  bytes_sent=sbytes))
-
-            # ---- the ICI exchange: all_to_all over 'sp' ----
+            # ---- the ICI exchange: ONE all_to_all for the window ----
             def xc(x):
                 return jax.lax.all_to_all(
-                    x.reshape((S, xcap) + x.shape[1:])[None],
+                    x.reshape((S, K * xcap) + x.shape[1:])[None],
                     "sp", split_axis=1, concat_axis=1)[0].reshape(
-                    (S * xcap,) + x.shape[1:])
-            r_src = xc(b_src)
-            r_dest = xc(b_dest)
-            r_payload = xc(b_payload)
-            r_size = xc(b_size)
-            r_delay = xc(b_delay)
-            r_midx = xc(b_midx)
+                    (S * K * xcap,) + x.shape[1:])
+
+            # Origin-ms-major reorder of the received window: the per-ms
+            # path bins ms i's messages before ms i+1's whatever their
+            # source shard, and the stable binning sort below preserves
+            # input order within a (rel, dest) group — so the input must
+            # be (ms, shard, rank)-ordered for bit-identical slots.
+            def omm(x):
+                return x.reshape((S, K, xcap) + x.shape[1:]).swapaxes(
+                    0, 1).reshape((S * K * xcap,) + x.shape[1:])
+
+            r_src = omm(xc(b_src))
+            r_dest = omm(xc(b_dest))
+            r_payload = omm(xc(b_payload))
+            r_size = omm(xc(b_size))
+            r_delay = omm(xc(b_delay))
+            r_midx = omm(xc(b_midx))
+            r_toff = omm(xc(b_toff))
 
             # ---- enqueue received into the local ring ----
             dl = jnp.clip(r_dest - snet.shard_id * nl, 0, nl - 1)
-            seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
-            # latency keyed by the global flat message index — the same
-            # draw enqueue_unicast makes on one chip
+            # latency keyed by the global flat message index AND the
+            # message's origin ms — the same draw enqueue_unicast makes
+            # on one chip at that ms
+            seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t + r_toff)
             delta = prng.uniform_delta(seed_t, r_midx)
             lat = self._bc_latency(snet, jnp.maximum(r_src, 0),
                                    jnp.where(r_dest >= 0, r_dest, 0),
@@ -349,8 +444,8 @@ class ShardedRunner:
             # __init__).
             n_clamped = jnp.sum(ok & (raw_total != total)).astype(jnp.int32)
             net = net.replace(clamped=net.clamped + n_clamped)
-            arrival = t + 1 + total
-            mx = S * xcap
+            arrival = t + r_toff + 1 + total
+            mx = S * K * xcap
             big = jnp.int32(0x7FFFFFFF)
             rel_k = jnp.where(ok, arrival - t, big)
             d_k = jnp.where(ok, dl, big)
@@ -388,40 +483,9 @@ class ShardedRunner:
             dropped = net.dropped + jnp.sum((rel_s < big) & ~ok2).astype(
                 jnp.int32)
 
-            # ---- broadcasts: replicated table, all shards agree ----
-            req = out.bcast & (~nodes.down)
-            # gather every shard's requests (replicated result)
-            req_all = jax.lax.all_gather(req, "sp").reshape(-1)
-            pl_all = jax.lax.all_gather(out.bcast_payload, "sp").reshape(
-                cfg.n, fw)
-            sz_all = jax.lax.all_gather(out.bcast_size, "sp").reshape(-1)
-            gout = empty_outbox(cfg).replace(
-                bcast=req_all, bcast_payload=pl_all, bcast_size=sz_all)
-            # reuse the single-chip broadcast allocator on a stub net
-            gnet = net.replace(nodes=net.nodes)  # bc_* fields are global
-            # counters from enqueue_broadcast are per-GLOBAL-node; apply to
-            # the local slice only
-            pre_sent = net.nodes.msg_sent
-            gnet2 = net_mod.enqueue_broadcast(
-                EngineConfig(n=cfg.n, horizon=cfg.horizon,
-                             inbox_cap=cfg.inbox_cap,
-                             payload_words=fw, out_deg=cfg.out_deg,
-                             bcast_slots=cfg.bcast_slots),
-                net.replace(nodes=jax.tree.map(
-                    lambda x: jnp.zeros((cfg.n,) + x.shape[1:], x.dtype),
-                    net.nodes)), gout, t)
-            lreq = req
-            bsent = pre_sent + jnp.where(lreq, cfg.n, 0).astype(jnp.int32)
-            bbytes = net.nodes.bytes_sent + jnp.where(
-                lreq, out.bcast_size * cfg.n, 0)
             net = net.replace(
-                nodes=net.nodes.replace(msg_sent=bsent, bytes_sent=bbytes),
-                bc_active=gnet2.bc_active, bc_src=gnet2.bc_src,
-                bc_time=gnet2.bc_time, bc_payload=gnet2.bc_payload,
-                bc_size=gnet2.bc_size, bc_seed=gnet2.bc_seed,
-                bc_dropped=gnet2.bc_dropped,
                 box_data=box_data, box_src=box_src, box_size=box_size,
-                box_count=box_count, dropped=dropped, time=t + 1)
+                box_count=box_count, dropped=dropped, time=t + K)
             return snet.replace(net=net, xdropped=snet.xdropped + xdrop), \
                 pstate
 
@@ -490,25 +554,53 @@ class ShardedRunner:
                 jnp.sum(net.clamped) + jnp.sum(snet.xdropped))
         return {k: v.astype(jnp.int32) for k, v in out.items()}
 
-    def run_ms(self, snet, pstate, ms: int, metrics=None):
+    def run_ms(self, snet, pstate, ms: int, metrics=None,
+               superstep: int = 1):
         """Advance `ms` milliseconds.  ``metrics`` (an
         `obs.MetricsSpec`) additionally records the global-aggregate
         interval series on device and returns ``(snet, pstate,
         MetricsCarry)`` — the sharded twin of
-        `obs.engine.scan_chunk_metrics`."""
+        `obs.engine.scan_chunk_metrics`.
+
+        ``superstep=K`` advances in fused K-ms windows (one ICI
+        exchange, one sort/scatter bin and one slot clear per window —
+        `step_fn(superstep=K)`, bit-identical); gated by the shared
+        K-aware eligibility check plus an entry-time alignment read
+        (blocks on in-flight work only when a superstep is requested)."""
+        from ..core.network import check_chunk_config
+
         ms = int(ms)
+        check_chunk_config(self.protocol, ms, superstep=superstep)
+        if superstep > 1:
+            if metrics is not None and metrics.stat_each_ms % superstep:
+                raise ValueError(
+                    f"superstep={superstep} windows record at window "
+                    f"boundaries: stat_each_ms ({metrics.stat_each_ms}) "
+                    "must be a multiple of the superstep")
+            t_entry = int(np.asarray(
+                jax.device_get(snet.net.time)).reshape(-1)[0])
+            if t_entry % superstep:
+                raise ValueError(
+                    f"superstep={superstep} needs a K-aligned entry time "
+                    f"(run is at t={t_entry}). Fix: advance "
+                    f"{superstep - t_entry % superstep} ms with "
+                    "superstep=1 first, or keep chunk lengths multiples "
+                    "of the superstep from t=0")
         if not hasattr(self, "_jits"):
             self._jits = {}
-            self._step = self.step_fn()
-        key = (ms, metrics)
+            self._steps = {}
+        if superstep not in self._steps:
+            self._steps[superstep] = self.step_fn(superstep=superstep)
+        key = (ms, metrics, superstep)
         if key not in self._jits:
-            step = self._step
+            step = self._steps[superstep]
             if metrics is None:
                 @jax.jit
                 def run(sn, ps):
                     def body(carry, _):
                         return step(*carry), ()
-                    (sn2, ps2), _ = jax.lax.scan(body, (sn, ps), length=ms)
+                    (sn2, ps2), _ = jax.lax.scan(body, (sn, ps),
+                                                 length=ms // superstep)
                     return sn2, ps2
             else:
                 from ..obs.plane import init_metrics, record
@@ -521,10 +613,11 @@ class ShardedRunner:
                         sn, ps, mc = carry
                         sn, ps = step(sn, ps)
                         mc = record(metrics, mc, sn.net.time[0] - 1,
-                                    self._metric_values(metrics, sn))
+                                    self._metric_values(metrics, sn),
+                                    n_steps=superstep)
                         return (sn, ps, mc), ()
                     (sn2, ps2, mc), _ = jax.lax.scan(body, (sn, ps, mc0),
-                                                     length=ms)
+                                                     length=ms // superstep)
                     return sn2, ps2, mc
 
             self._jits[key] = run
@@ -553,6 +646,10 @@ class RingForward:
     """Shard-local protocol: every node sends its id to (id + stride) % N
     each ms; nodes accumulate what they receive.  Exercises cross-shard
     unicast routing + the broadcast path (node 0 broadcasts at t == 0)."""
+
+    # dest = (id + stride) % N with stride % N != 0 in every in-tree
+    # config — never self (core/network.unicast_floor_ms).
+    may_self_send = False
 
     def __init__(self, n=64, stride=9, latency=10, horizon=64):
         self.node_count = n
