@@ -22,7 +22,12 @@ node width, is the lever (skip-rate governs the win — SCALE.md).
 Every emitted line carries an `engine_metrics` block (wittgenstein_tpu/
 obs — on-device per-interval telemetry from an un-timed bit-identical
 instrumented pass; schema in BENCH_NOTES.md).  WTPU_METRICS=0 skips it;
-WTPU_METRICS_EACH_MS / WTPU_METRICS_SEEDS size it.
+WTPU_METRICS_EACH_MS / WTPU_METRICS_SEEDS size it.  WTPU_TRACE=1 adds a
+`trace` block from an un-timed flight-recorder pass (message-level
+event counts + truncation accounting; schema in BENCH_NOTES.md r9);
+WTPU_TRACE_CAP sizes the ring — an over-small capacity (< 1 row per
+simulated ms) REFUSES loudly instead of emitting a silently truncated
+trace, mirroring the invalid-superstep refusal.
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -130,6 +135,73 @@ def _maybe_engine_metrics(res, proto, seeds, total_ms, fast_forward=False):
     if os.environ.get("WTPU_METRICS", "1") != "0":
         res["engine_metrics"] = _collect_engine_metrics(
             proto, seeds, total_ms, fast_forward=fast_forward)
+    return _maybe_engine_trace(res, proto, total_ms,
+                               fast_forward=fast_forward)
+
+
+def _collect_engine_trace(proto, total_ms, cap, fast_forward=False):
+    """Un-timed flight-recorder pass for the JSON line's `trace` block
+    (wittgenstein_tpu/obs/trace.py; schema in BENCH_NOTES.md r9).
+
+    Single seed, the dense traced engine (or its fast-forward twin
+    under WTPU_FAST_FORWARD=1): runs AFTER the timed reps — the
+    measured hot path stays the uninstrumented engine (`trace_zero_cost`
+    rule) and the traced pass is bit-identical on the trajectory
+    (tests/test_trace.py), so the block describes the same run the
+    bench timed.  Never raises: a failed pass reports itself in the
+    block (the CAPACITY refusal happens earlier, in `_check_trace_cap`
+    before the timed reps, and does raise)."""
+    try:
+        from wittgenstein_tpu.obs import TraceFrame, TraceSpec, trace_block
+        from wittgenstein_tpu.obs.trace import (fast_forward_chunk_trace,
+                                                scan_chunk_trace)
+        from wittgenstein_tpu.core.network import fast_forward_ok
+
+        spec = TraceSpec(capacity=cap)
+        ms = total_ms
+        net, ps = proto.init(jnp.asarray(0, jnp.int32))
+        if fast_forward and fast_forward_ok(proto):
+            run = jax.jit(fast_forward_chunk_trace(proto, ms, spec))
+            *_, tc = run(net, ps)
+        else:
+            run = jax.jit(scan_chunk_trace(proto, ms, spec))
+            _, _, tc = run(net, ps)
+        frame = TraceFrame.from_carry(spec, tc)
+        return trace_block(frame, extra={"trace_seeds": 1})
+    except Exception as e:      # noqa: BLE001 — the bench line must emit
+        print(f"bench: flight-recorder pass failed: {type(e).__name__}: "
+              f"{e!s:.300}", file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e!s:.200}"}
+
+
+def _check_trace_cap(total_ms):
+    """The PR-4 invalid-K pattern: refuse loudly rather than emit a
+    mislabeled artifact — a ring smaller than one event row per
+    simulated ms is guaranteed to truncate from the first busy stretch,
+    and a benchmark line carrying a near-empty `trace` block would read
+    as "this run was quiet" when it wasn't.  Called BEFORE the timed
+    reps (both values are known up front) so an invalid env pair fails
+    in milliseconds instead of after a whole timed session."""
+    if os.environ.get("WTPU_TRACE") != "1":
+        return
+    cap = _int_env("WTPU_TRACE_CAP", 1 << 16)
+    if cap < total_ms:
+        raise ValueError(
+            f"WTPU_TRACE=1 with WTPU_TRACE_CAP={cap} over {total_ms} "
+            f"simulated ms cannot hold even one event row per ms: the "
+            "ring would truncate silently from the first busy interval. "
+            f"Fix: raise WTPU_TRACE_CAP to >= {total_ms} (the default "
+            "65536 fits most bench spans), lower WTPU_BENCH_MS, or drop "
+            "WTPU_TRACE")
+
+
+def _maybe_engine_trace(res, proto, total_ms, fast_forward=False):
+    if os.environ.get("WTPU_TRACE") != "1":
+        return res
+    _check_trace_cap(total_ms)
+    res["trace"] = _collect_engine_trace(
+        proto, total_ms, _int_env("WTPU_TRACE_CAP", 1 << 16),
+        fast_forward=fast_forward)
     return res
 
 
@@ -372,6 +444,7 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     step, init, steps, check, proto, eff_ss = _handel_setup(
         n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
+    _check_trace_cap(steps * chunk)
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res["superstep"] = eff_ss
     res.update(_fixed_cost_estimate(n, seeds, chunk, mode, horizon,
@@ -403,6 +476,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     step, init, steps, check, proto, eff_ss = _handel_setup(
         n, seed_batch, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
+    _check_trace_cap(steps * chunk)
 
     # compile + warm one chunk
     nets, ps = init(0)
@@ -485,6 +559,7 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
         step = jax.jit(jax.vmap(scan_chunk(proto, chunk,
                                            superstep=eff_ss)))
     steps = max(1, -(-sim_ms // chunk))
+    _check_trace_cap(steps * chunk)
 
     def init(seed0=0):
         return jax.vmap(proto.init)(

@@ -1,0 +1,361 @@
+"""The event flight recorder (wittgenstein_tpu/obs/trace.py).
+
+Invariants, per the package contract:
+
+  * trace-ON is simulation-bit-identical: the full (NetState, pstate)
+    pytree after a traced chunk equals the uninstrumented engine's —
+    dense scan (PingPong, Handel exact + cardinal, Dfinity), the
+    superstep-K window engine, the batched twin, the fast-forward while
+    loop (whose skip stats must also match), and the sharded runner;
+  * events carry their EXACT origin ms inside fused K windows: the
+    K ∈ {2, 4} trace rings are bit-identical to the K = 1 ring (Handel
+    fast; P2PFlood in the slow battery), including events at ms that
+    are not multiples of K;
+  * the stream is semantically exact: deliveries pair with earlier
+    sends, kinds/slots decode correctly, and a full ring announces
+    itself (cursor pins at capacity, `dropped` counts the loss) instead
+    of truncating silently.
+
+Protocol configs mirror tests/test_obs.py / test_superstep.py so the
+compiles share the suite's persistent-cache entries where possible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.batched import scan_chunk_batched
+from wittgenstein_tpu.core.network import (Runner, fast_forward_chunk,
+                                           scan_chunk)
+from wittgenstein_tpu.obs import (EVENTS, TraceFrame, TraceSpec,
+                                  fast_forward_chunk_trace,
+                                  scan_chunk_batched_trace,
+                                  scan_chunk_trace, trace_block,
+                                  trace_to_perfetto)
+from wittgenstein_tpu.obs.trace import KIND
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _protocols():
+    from wittgenstein_tpu.models.dfinity import Dfinity
+    from wittgenstein_tpu.models.handel import Handel
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    return {
+        "Handel": lambda: Handel(
+            node_count=64, threshold=56, nodes_down=6, pairing_time=4,
+            dissemination_period_ms=20, level_wait_time=50, fast_path=10),
+        "HandelCardinal": lambda: Handel(
+            node_count=64, threshold=56, nodes_down=6, pairing_time=4,
+            dissemination_period_ms=20, fast_path=10, mode="cardinal"),
+        "Dfinity": lambda: Dfinity(block_producers_count=10,
+                                   attesters_count=10,
+                                   attesters_per_round=10),
+        "PingPong": lambda: PingPong(node_count=64),
+    }
+
+
+def _floor_handel():
+    """test_superstep.py's floor-rich Handel: fixed 16 ms latency
+    licenses the K ∈ {2, 4} window ladder."""
+    from wittgenstein_tpu.models.handel import Handel
+    return Handel(node_count=64, threshold=56, nodes_down=6,
+                  pairing_time=4, dissemination_period_ms=20,
+                  level_wait_time=50, fast_path=10, horizon=64,
+                  network_latency_name="NetworkFixedLatency(16)")
+
+
+# ------------------------------------------------------------------ ON
+
+
+@pytest.mark.parametrize("name", ["PingPong", "Handel", "HandelCardinal",
+                                  "Dfinity"])
+def test_trace_on_bit_identical_dense(name):
+    proto = _protocols()[name]()
+    ms, seeds = 160, 2
+    spec = TraceSpec(capacity=1 << 15)
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(jax.vmap(scan_chunk(proto, ms)))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, tc = jax.jit(jax.vmap(scan_chunk_trace(proto, ms, spec)))(
+        nets, ps)
+    _trees_equal(ref, (net2, ps2))
+    frame = TraceFrame.from_carry(spec, tc)
+    assert frame.dropped == 0
+    assert frame.counts().get("deliver", 0) > 0
+
+
+def test_trace_on_bit_identical_batched_engine():
+    proto = _protocols()["Handel"]()
+    ms, seeds = 80, 2
+    spec = TraceSpec(capacity=1 << 15)
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(scan_chunk_batched(proto, ms))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, tc = jax.jit(scan_chunk_batched_trace(proto, ms, spec))(
+        nets, ps)
+    _trees_equal(ref, (net2, ps2))
+    assert TraceFrame.from_carry(spec, tc).n_events > 0
+
+
+def test_trace_fast_forward_bit_identical_and_jump_events():
+    proto = _protocols()["PingPong"]()
+    ms, seeds = 320, 2
+    spec = TraceSpec(capacity=4096)
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(fast_forward_chunk(proto, ms, seed_axis=True))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, stats, tc = jax.jit(
+        fast_forward_chunk_trace(proto, ms, spec, seed_axis=True))(
+        nets, ps)
+    _trees_equal(ref[:2], (net2, ps2))
+    jumps = int(np.asarray(stats["jump_count"]))
+    assert int(np.asarray(stats["skipped_ms"])) == \
+        int(np.asarray(ref[2]["skipped_ms"])) > 0
+    frame = TraceFrame.from_carry(spec, tc)
+    # per-seed lockstep rings: every seed records the shared jumps, and
+    # each jump's aux sums to the shared skipped-ms accounting
+    ffj = frame.events[frame.column("kind") == KIND["ff_jump"]]
+    assert ffj.shape[0] == seeds * jumps
+    assert ffj[:, 5].sum() == seeds * int(np.asarray(stats["skipped_ms"]))
+
+
+# -------------------------------------------------- superstep origin ms
+
+
+def _k_trace_ladder(proto, ms, ks, cap=1 << 14):
+    """The satellite pin: the K-window trace ring must equal the K=1
+    ring BIT FOR BIT — same events, same per-ms order, same origin
+    times — and the stream must contain events at ms that are not
+    multiples of K (so the pin actually exercises in-window origins)."""
+    spec = TraceSpec(capacity=cap)
+    net, ps = proto.init(0)
+    ref = jax.jit(scan_chunk_trace(proto, ms, spec))(net, ps)
+    times = np.asarray(ref[2].buf[:int(ref[2].cursor), 0])
+    assert times.size > 0
+    for k in ks:
+        assert (times % k != 0).any(), \
+            f"no event off the K={k} window grid — vacuous pin"
+        net, ps = proto.init(0)
+        got = jax.jit(scan_chunk_trace(proto, ms, spec, superstep=k))(
+            net, ps)
+        _trees_equal(ref, got)
+
+
+def test_trace_superstep_origin_ms_handel():
+    _k_trace_ladder(_floor_handel(), 40, (2, 4))
+
+
+@pytest.mark.slow
+def test_trace_superstep_origin_ms_p2pflood():
+    from wittgenstein_tpu.models.p2pflood import P2PFlood
+    proto = P2PFlood(node_count=64, dead_node_count=6, peers_count=8,
+                     network_latency_name="NetworkFixedLatency(16)",
+                     delay_before_resent=1, delay_between_sends=1,
+                     horizon=2048)
+    _k_trace_ladder(proto, 40, (2, 4), cap=1 << 16)
+
+
+# --------------------------------------------------------- semantics
+
+
+def test_trace_event_semantics_pingpong():
+    proto = _protocols()["PingPong"]()
+    spec = TraceSpec(capacity=4096)
+    net, ps = proto.init(0)
+    _, _, tc = jax.jit(scan_chunk_trace(proto, 200, spec))(net, ps)
+    frame = TraceFrame.from_carry(spec, tc)
+    rows = frame.rows()
+
+    # the first event is the witness's sendAll(Ping) at t == 0
+    assert rows[0] == {"time_ms": 0, "kind": "send", "src": 0,
+                      "dst": -1, "payload_bytes": 1, "aux": -1}
+    # every unicast delivery pairs with an EARLIER send to that (src ->
+    # dst); broadcast deliveries decode with aux >= inbox_cap
+    sends, got_bc = set(), 0
+    for r in rows:
+        if r["kind"] == "send":
+            sends.add((r["src"], r["dst"]))
+        elif r["kind"] == "deliver":
+            if r["aux"] >= proto.cfg.inbox_cap:
+                got_bc += 1             # broadcast slot
+                assert r["src"] == 0    # only the witness sendAlls
+            else:
+                assert (r["src"], r["dst"]) in sends or \
+                    (r["src"], -1) in sends, r
+    assert got_bc > 0
+    assert "drop" not in frame.counts()
+
+    # host-side views: window + node filter + format
+    w = frame.window(0, 1)
+    assert w.n_events >= 1 and (w.column("time_ms") == 0).all()
+    node7 = frame.filter(node=7)
+    assert all(r["src"] == 7 or r["dst"] == 7 for r in node7.rows())
+    assert "send" in frame.format(limit=5)
+
+
+def test_trace_node_filter_and_event_subset():
+    proto = _protocols()["PingPong"]()
+    # only node 0..8 events, only deliveries
+    spec = TraceSpec(capacity=1024, events=("deliver",),
+                     node_filter=(0, 8))
+    net, ps = proto.init(0)
+    _, _, tc = jax.jit(scan_chunk_trace(proto, 200, spec))(net, ps)
+    frame = TraceFrame.from_carry(spec, tc)
+    assert frame.n_events > 0
+    assert set(frame.counts()) == {"deliver"}
+    src, dst = frame.column("src"), frame.column("dst")
+    assert (((src >= 0) & (src < 8)) | ((dst >= 0) & (dst < 8))).all()
+
+
+def test_trace_spec_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        TraceSpec(capacity=0)
+    with pytest.raises(ValueError, match="unknown events"):
+        TraceSpec(events=("deliver", "nope"))
+    with pytest.raises(ValueError, match="node_filter"):
+        TraceSpec(node_filter=(5, 5))
+    # canonical ordering regardless of the order passed
+    spec = TraceSpec(events=("drop", "send", "deliver"))
+    assert spec.events == ("send", "deliver", "drop")
+    assert spec.enabled("send") and not spec.enabled("ff_jump")
+
+
+def test_trace_capacity_truncation_is_loud():
+    proto = _protocols()["PingPong"]()
+    spec = TraceSpec(capacity=16)
+    net, ps = proto.init(0)
+    net2, ps2, tc = jax.jit(scan_chunk_trace(proto, 200, spec))(net, ps)
+    # the simulation itself is unperturbed by a full ring
+    net0, ps0 = proto.init(0)
+    _trees_equal(jax.jit(scan_chunk(proto, 200))(net0, ps0), (net2, ps2))
+    assert int(tc.cursor) == 16             # pinned at capacity
+    frame = TraceFrame.from_carry(spec, tc)
+    assert frame.dropped > 0
+    blk = trace_block(frame)
+    assert blk["truncated"] is True and blk["dropped"] == frame.dropped
+    assert "truncated" in frame.format()
+
+
+# ------------------------------------------------------------ drivers
+
+
+def test_runner_trace_and_report():
+    proto = _protocols()["PingPong"]()
+    spec = TraceSpec(capacity=2048)
+    r0 = Runner(proto)
+    net, ps = proto.init(0)
+    ref = r0.run_ms(net, ps, 200)
+
+    r1 = Runner(proto, fast_forward=True, trace=spec)
+    net, ps = proto.init(0)
+    out = r1.run_ms(net, ps, 100)
+    out = r1.run_ms(*out, 100)                  # chunked: rings stitch
+    _trees_equal(ref, out)
+    frame = r1.trace_frame()
+    st = r1.trace_stats()
+    assert st["events"] == frame.n_events > 0
+    assert st["dropped"] == 0
+    rep = r1.run_report(out[0], wall_s=0.25)
+    assert f"trace events={st['events']}" in rep
+    assert "TRUNCATED" not in rep
+    # one plane per pass
+    from wittgenstein_tpu.obs import MetricsSpec
+    with pytest.raises(ValueError, match="run the chunk twice"):
+        Runner(proto, metrics=MetricsSpec(), trace=spec)
+
+    # a clipped ring announces itself in the report
+    r2 = Runner(proto, trace=TraceSpec(capacity=8))
+    net, ps = proto.init(0)
+    out2 = r2.run_ms(net, ps, 200)
+    _trees_equal(ref, out2)
+    assert "TRUNCATED" in r2.run_report(out2[0])
+
+
+def test_sharded_runner_trace_twin():
+    from jax.sharding import Mesh
+    from wittgenstein_tpu.parallel.sharded import RingForward, ShardedRunner
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    proto = RingForward(n=64, stride=9, latency=10)
+    runner = ShardedRunner(proto, mesh)
+    spec = TraceSpec(capacity=256)
+    snet, ps = runner.init(3)
+    snet, ps, tc = runner.run_ms(snet, ps, 24, trace=spec)
+    # the traced run didn't perturb the simulation
+    snet2, ps2 = runner.init(3)
+    snet2, ps2 = runner.run_ms(snet2, ps2, 24)
+    _trees_equal((snet, ps), (snet2, ps2))
+    frame = TraceFrame.from_carry(spec, tc)    # per-shard rings merged
+    nodes = runner.gather_nodes(snet)
+    c = frame.counts()
+    # 5 rounds x 64 unicast sends + node 0's sendAll request; every
+    # delivery the counters saw is an event (dst = GLOBAL node id)
+    assert c["send"] == 5 * 64 + 1
+    assert c["deliver"] == int(nodes.msg_received.sum())
+    assert int(frame.column("dst").max()) >= 48     # beyond shard 0
+    times = frame.column("time_ms")
+    assert (np.diff(times) >= 0).all()              # merged onto one axis
+
+
+def test_capture_trace_helper_and_perfetto():
+    from wittgenstein_tpu.core.harness import capture_trace
+
+    proto = _protocols()["PingPong"]()
+    spec = TraceSpec(capacity=1024)
+    frame, net, ps = capture_trace(proto, 120, spec)
+    assert frame.n_events > 0 and frame.dropped == 0
+    assert int(np.asarray(net.time)) == 120
+
+    trace = trace_to_perfetto(frame)
+    evs = trace["traceEvents"]
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in evs)
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert len(xs) == frame.n_events
+    # simulated clock convention shared with the metrics exporter:
+    # 1 sim-ms -> 1000 trace-us
+    by_name = {e["name"] for e in xs}
+    assert by_name <= set(EVENTS)
+    assert xs[0]["ts"] == int(frame.events[0, 0]) * 1000
+    import json
+    json.dumps(trace)
+
+
+# ------------------------------------------------------------- rules
+
+
+def test_trace_zero_cost_rule_catches_dead_instrumentation():
+    from wittgenstein_tpu.analysis.rules_trace import TraceZeroCostRule
+    from wittgenstein_tpu.analysis.targets import AnalysisTarget
+
+    def plain_chunk(x, y):
+        def body(c, _):
+            return (c[0] + 1, c[1] * 2), ()
+        c, _ = jax.lax.scan(body, (x, y), length=3)
+        return c
+
+    rule = TraceZeroCostRule()
+    args = (jnp.zeros((4,), jnp.int32), jnp.ones((4,), jnp.float32))
+    clean = AnalysisTarget.from_fn("fake", plain_chunk, args)
+    fs = rule.run(clean, {})
+    vals = {f.metric: f.value for f in fs if f.metric}
+    assert vals["carry_extra_leaves"] == 0
+    assert not [f for f in fs if f.severity == "error"]
+
+    # an uninstrumented build labeled as a trace target = a silently-
+    # dead flight recorder, which must be an error
+    dead = AnalysisTarget.from_fn("fake+trace", plain_chunk, args)
+    errs = [f for f in rule.run(dead, {}) if f.severity == "error"]
+    assert errs and "silently dead" in errs[0].message

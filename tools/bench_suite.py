@@ -176,22 +176,61 @@ def bench_dfinity():
     return run_config(proto, seeds, 120_000, 2000, check)
 
 
+def bench_trace_smoke():
+    """Flight-recorder smoke stage (PR 5): a tiny PingPong capture at a
+    deliberately small ring, full decode + Perfetto round-trip — the
+    whole trace path (tap -> ring -> TraceFrame -> exporter) exercised
+    end to end in seconds, so a decoder or exporter regression surfaces
+    in the suite instead of during a debugging session.  The capacity
+    is sized to the span (no truncation expected; `dropped` is asserted
+    and reported either way)."""
+    from wittgenstein_tpu.core.harness import capture_trace
+    from wittgenstein_tpu.models.pingpong import PingPong
+    from wittgenstein_tpu.obs import (TraceSpec, trace_block,
+                                      trace_to_perfetto)
+
+    proto = PingPong(node_count=64)
+    spec = TraceSpec(capacity=1024)
+    frame, net, ps = capture_trace(proto, 120, spec)
+    blk = trace_block(frame)
+    assert blk["events"] > 0, "trace smoke recorded nothing"
+    assert not blk["truncated"], blk
+    # decode round-trip: formatted listing + per-kind counts agree
+    assert len(frame.rows()) == blk["events"]
+    perfetto = trace_to_perfetto(frame)     # in-memory render
+    n_slices = sum(1 for e in perfetto["traceEvents"]
+                   if e.get("ph") == "X")
+    assert n_slices == blk["events"], (n_slices, blk["events"])
+    json.dumps(blk)                         # one-line-JSON embeddable
+    return {"metric": "trace_smoke_events", "value": blk["events"],
+            "unit": "events", "perfetto_slices": n_slices, **blk,
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
     "sanfermin_32768n": bench_sanfermin,
     "dfinity_10k_validators": bench_dfinity,
+    "trace_smoke": bench_trace_smoke,
 }
+
+# Stages whose metric is not a throughput number: the error path must
+# emit the SAME metric name as the success path, or a consumer keying
+# on it never sees the failure line.
+METRIC_NAMES = {"trace_smoke": "trace_smoke_events"}
 
 
 def main():
     names = sys.argv[1:] or list(CONFIGS)
     for name in names:
+        metric = METRIC_NAMES.get(name, f"{name}_agg_sim_ms_per_sec")
         try:
             res = CONFIGS[name]()
-            res = {"metric": f"{name}_agg_sim_ms_per_sec", **res}
+            if "metric" not in res:
+                res = {"metric": metric, **res}
         except Exception as e:                  # noqa: BLE001 — per-config
-            res = {"metric": f"{name}_agg_sim_ms_per_sec",
+            res = {"metric": metric,
                    "error": f"{type(e).__name__}: {e!s:.300}"}
         print(json.dumps(res), flush=True)
 

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-8 on-chip measurement session — run when .tpu_up appears.
+# ORDER IS THE POINT (VERDICT r4 #2): the official bench number first,
+# then this round's additions (the flight-recorder trace plane + the
+# first-divergence triage), then the deferred pallas VMEM cost-model
+# validation (ADVICE r5 item 2, on-chip half).
+#
+# Usage: nohup bash tools/run_measurements_r8.sh > reports/r8_onchip.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+R=reports
+mkdir -p "$R"
+stamp() { date -u +%H:%M:%S; }
+
+echo "=== r8 on-chip session start $(stamp)"
+
+# 1. OFFICIAL bench, unchanged engine defaults (batched superstep=2,
+#    metrics block on, trace OFF — the hot path must stay the
+#    uninstrumented engine; `trace_zero_cost` pins that claim on CPU
+#    HLO, this rep pins the wall-clock side).  Directly comparable
+#    with r7.
+echo "--- [1/6] official 2048x16 $(stamp)"
+timeout 3600 python bench.py 2>&1 | tee "$R/bench_r8_official.log"
+
+# 2. Trace-plane overhead A/B at the official config: the same run
+#    with the un-timed flight-recorder pass appended (WTPU_TRACE=1).
+#    The timed reps must match [1] within noise — the traced pass runs
+#    AFTER them; the JSON line gains the `trace` block (schema
+#    BENCH_NOTES r9).  Capacity sized to the span: 2048n Handel sends
+#    a lot per ms; 1<<22 rows = 96 MB of int32 ring on-chip.
+echo "--- [2/6] trace block at the official config $(stamp)"
+WTPU_TRACE=1 WTPU_TRACE_CAP=$((1 << 22)) timeout 3600 python bench.py \
+  2>&1 | tee "$R/bench_r8_trace.log"
+
+# 3. Quiet-heavy traced captures (ff engine + ff_jump events): the
+#    configs where the event stream is small and the jump accounting
+#    is the story.
+echo "--- [3/6] quiet-heavy traced ff $(stamp)"
+WTPU_BENCH_PROTO=pingpong WTPU_BENCH_NODES=1024 WTPU_FAST_FORWARD=1 \
+  WTPU_TRACE=1 timeout 1800 python bench.py 2>&1 \
+  | tee "$R/bench_r8_pingpong_ff_trace.log"
+WTPU_BENCH_PROTO=dfinity WTPU_BENCH_MS=4000 WTPU_FAST_FORWARD=1 \
+  WTPU_TRACE=1 timeout 1800 python bench.py 2>&1 \
+  | tee "$R/bench_r8_dfinity_ff_trace.log"
+
+# 4. First-divergence triage ON CHIP: the one-command repro, both as a
+#    clean gate (dense vs batched K=4 must exit 0 = bit-identical on
+#    real hardware, not just the CPU suite) and with the tracer
+#    printing a window (pingpong dense vs ff).
+echo "--- [4/6] divergence bisector on-chip $(stamp)"
+timeout 1800 python tools/divergence.py --proto handel --nodes 2048 \
+  --ms 400 --a superstep=1 --b superstep=4,batched \
+  --latency 'NetworkFixedLatency(16)' 2>&1 \
+  | tee "$R/divergence_r8_handel_k4.log"
+timeout 1800 python tools/divergence.py --proto pingpong --nodes 1024 \
+  --ms 600 --a superstep=1 --b fast_forward 2>&1 \
+  | tee "$R/divergence_r8_pingpong_ff.log"
+
+# 5. Pallas VMEM cost-model validation (ADVICE r5 item 2, ON-CHIP
+#    half; the host-side gate — _pick_block raise/warn — shipped in
+#    PR 1/PR 5).  tools/pallas_validate_tpu.py compiles the merge /
+#    score / gsf kernels at ladder block sizes and records the
+#    requested scoped-vmem stack vs the merge_row_bytes /
+#    score_row_bytes / gsf_merge_row_bytes models; a model that
+#    underestimates shows up here as a Mosaic OOM the host gate
+#    (on_over="warn" leg) predicted would fit.
+echo "--- [5/6] pallas VMEM model validation $(stamp)"
+timeout 3600 python tools/pallas_validate_tpu.py 2>&1 \
+  | tee "$R/pallas_validate_r8.log"
+
+# 6. Tracked-config suite incl. the trace smoke stage (decode +
+#    Perfetto round-trip on-chip).
+echo "--- [6/6] bench_suite (with trace smoke) $(stamp)"
+timeout 7200 python tools/bench_suite.py 2>&1 \
+  | tee "$R/bench_suite_r8.log"
+
+echo "=== r8 on-chip session done $(stamp)"

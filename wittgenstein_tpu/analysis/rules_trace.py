@@ -1,0 +1,74 @@
+"""Rule ``trace_zero_cost`` — the flight recorder may never silently
+tax an untraced build, and may never silently die.
+
+Sibling of `metrics_zero_cost` (rules_metrics.py), for the EVENT plane
+(wittgenstein_tpu/obs/trace.py).  The contract is two-sided:
+
+  * trace-OFF builds carry ZERO recorder residue.  The engine's `tap`
+    hook defaults to None — a plain Python branch, so the
+    uninstrumented program is the historical one BY CONSTRUCTION; this
+    rule makes that structural claim an enforced ratchet: the chunk's
+    outermost scan/while carry width over the state leaf count
+    (`carry_extra_leaves`) is measured on every pre-existing target and
+    budgeted at its known instrumentation (0 for dense targets, the
+    fast-forward skip counters for `+ff`, the MetricsCarry leaves for
+    `+metrics` — all already pinned by the metrics rule's budgets), so
+    a tap accidentally left threaded into a production builder fails
+    the gate with the measured width;
+  * a ``+trace`` target whose loop carry does NOT widen by the
+    `TraceCarry` leaves (buf + cursor + dropped = 3) has a silently-
+    dead recorder — an error, not a budget.
+"""
+
+from __future__ import annotations
+
+from .framework import Finding, Rule, register_rule
+from .rules_metrics import _count_eqns, _loop_carry_widths
+
+#: TraceCarry contributes this many pytree leaves (buf, cursor, dropped).
+_TRACE_CARRY_LEAVES = 3
+
+#: analysis target-name suffix of the flight-recorder builds
+TRACE_SUFFIX = "+trace"
+
+
+@register_rule
+class TraceZeroCostRule(Rule):
+    name = "trace_zero_cost"
+    scope = "protocol"
+    budgeted_metrics = ("carry_extra_leaves", "jaxpr_eqns")
+
+    def run(self, target, budget):
+        import jax
+
+        n_state = len(jax.tree.leaves(target.args))
+        loops = _loop_carry_widths(target.jaxpr.jaxpr)
+        if not loops:
+            return [Finding(
+                rule=self.name, target=target.name, severity="warning",
+                message="no top-level scan/while loop in the traced "
+                        "chunk — carry-residue check has nothing to "
+                        "measure")]
+        prim, carry = max(loops, key=lambda pc: pc[1])
+        extra = carry - n_state
+        findings = [
+            Finding(rule=self.name, target=target.name, severity="info",
+                    metric="carry_extra_leaves", value=extra,
+                    message=f"{prim} carry holds {carry} vars for "
+                            f"{n_state} state leaves "
+                            f"(carry_extra_leaves={extra})"),
+            Finding(rule=self.name, target=target.name, severity="info",
+                    metric="jaxpr_eqns",
+                    value=_count_eqns(target.jaxpr.jaxpr),
+                    message="total jaxpr equations in the compiled "
+                            "chunk"),
+        ]
+        if (target.name.endswith(TRACE_SUFFIX)
+                and extra < _TRACE_CARRY_LEAVES):
+            findings.append(Finding(
+                rule=self.name, target=target.name, severity="error",
+                message=f"traced target carries only {extra} extra loop "
+                        f"vars (< {_TRACE_CARRY_LEAVES}: the TraceCarry "
+                        "leaves) — the flight recorder is silently dead "
+                        "in this build"))
+        return findings

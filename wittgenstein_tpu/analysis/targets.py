@@ -270,6 +270,44 @@ FFM_SUFFIX = "+ffmetrics"
 #: batched fused-pair engine requires it), 4 rows over the CHUNK=8 ms.
 _METRICS_EACH_MS = 2
 
+#: Protocols whose flight-recorder builds (obs/trace.py) are audited
+#: alongside the uninstrumented engines under "<name>+trace": the
+#: traced chunk is a different compiled program — its host-sync
+#: profile, carry copies and carry width are gated separately, and the
+#: `trace_zero_cost` rule asserts the recorder is actually LIVE there
+#: (carry widens by the TraceCarry leaves) while every OTHER target's
+#: carry width proves trace-OFF zero residue.  One broadcast protocol
+#: (PingPong — exercises the bc-deliver/retire observation) and the
+#: flagship (Handel).
+TRACE_PROTOCOLS = ("PingPong", "Handel")
+TRACE_SUFFIX = "+trace"
+
+#: pinned ring capacity for the trace targets: small (the rule checks
+#: structure, not volume) but big enough that the CHUNK=8 window never
+#: truncates.
+_TRACE_CAP = 256
+
+
+def _trace_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(TRACE_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..obs.trace import TraceSpec, scan_chunk_trace
+
+        proto = _registry()[base_name]()
+        spec = TraceSpec(capacity=_TRACE_CAP)
+        base = jax.vmap(scan_chunk_trace(proto, chunk, spec))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+trace"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -404,6 +442,7 @@ def target_names() -> tuple:
                  sorted(f"{n}{FF_SUFFIX}" for n in FF_PROTOCOLS) +
                  sorted(f"{n}{METRICS_SUFFIX}" for n in METRICS_PROTOCOLS) +
                  sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS) +
+                 sorted(f"{n}{TRACE_SUFFIX}" for n in TRACE_PROTOCOLS) +
                  sorted(SS_PROTOCOLS))
 
 
@@ -411,6 +450,12 @@ def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
     if name in SS_PROTOCOLS:
         return _ss_target(name)
+    if name.endswith(TRACE_SUFFIX):
+        if name[:-len(TRACE_SUFFIX)] not in TRACE_PROTOCOLS:
+            raise KeyError(
+                f"unknown trace target {name!r}; known: "
+                f"{sorted(f'{n}{TRACE_SUFFIX}' for n in TRACE_PROTOCOLS)}")
+        return _trace_target(name)
     if name.endswith(FFM_SUFFIX):
         if name[:-len(FFM_SUFFIX)] not in FFM_PROTOCOLS:
             raise KeyError(

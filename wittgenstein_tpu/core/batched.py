@@ -19,6 +19,15 @@ Bit-equality with `jax.vmap(scan_chunk(...))` is asserted in
 tests/test_batched.py: the folded scatter writes the same cells in the
 same deterministic order (the per-seed sort keys and ranks are
 unchanged; seeds never collide since the fold offsets by seed stride).
+
+Observability: the flight-recorder twin of `scan_chunk_batched` is
+`obs.trace.scan_chunk_batched_trace` — it runs the VMAPPED window
+engine with per-ms taps (the folded scatter is a layout optimization;
+the bit-equality above is exactly what makes the vmapped traced
+trajectory the one this engine computes), so there is no tap parameter
+on `step_kms_batched` itself.  The metrics twin
+(`obs.engine.scan_chunk_batched_metrics`) does wrap the folded engine
+directly — it only reads state between windows.
 """
 
 from __future__ import annotations

@@ -364,6 +364,36 @@ def progress_per_time_on_device(protocol, run_count=1, max_time=20_000,
     return MetricsFrame.from_carry(spec, mc), nets, ps
 
 
+def capture_trace(protocol, ms: int, spec=None, seed=0,
+                  fast_forward=False, superstep=1):
+    """One-command flight-recorder capture: run `ms` simulated
+    milliseconds of `protocol` from a fresh `seed` with the event trace
+    plane on (wittgenstein_tpu/obs/trace.py) and return
+    ``(TraceFrame, net, pstate)`` — the decoded message-level event
+    stream plus the final state (bit-identical to an untraced run).
+
+    The README "Observability" workflow entry point: from here
+    `frame.format()` prints the timeline, `obs.trace_to_perfetto(frame,
+    path)` renders it, and a truncated ring announces itself through
+    ``frame.dropped``."""
+    from ..obs.decode import TraceFrame
+    from ..obs.trace import (TraceSpec, fast_forward_chunk_trace,
+                             scan_chunk_trace)
+
+    enable_persistent_cache()
+    spec = spec or TraceSpec()
+    net, pstate = protocol.init(jnp.asarray(seed, jnp.int32))
+    if fast_forward:
+        run = jax.jit(fast_forward_chunk_trace(protocol, int(ms), spec,
+                                               superstep=superstep))
+        net, pstate, _, tc = run(net, pstate)
+    else:
+        run = jax.jit(scan_chunk_trace(protocol, int(ms), spec,
+                                       superstep=superstep))
+        net, pstate, tc = run(net, pstate)
+    return TraceFrame.from_carry(spec, tc), net, pstate
+
+
 def progress_per_time(protocol, run_count=1, max_time=20_000,
                       stat_each_ms=10, stats_getters=(), cont_if=None,
                       first_seed=0, fail_on_drop=True, devices=None):

@@ -424,7 +424,7 @@ def enqueue_broadcast(cfg: EngineConfig, net: NetState, out: Outbox, t):
     )
 
 
-def step_ms(protocol, net: NetState, pstate, hints=None):
+def step_ms(protocol, net: NetState, pstate, hints=None, tap=None):
     """Advance the simulation by exactly one millisecond (pure, jittable).
 
     `hints` is an optional static phase-hint dict (see `scan_chunk`): when
@@ -433,9 +433,22 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
     traced at all — the tensor analogue of the reference's empty-ms
     skip in nextMessage (Network.java:533-570), where a ms with no events
     costs nothing.
+
+    `tap` is the trace plane's observation hook (wittgenstein_tpu/obs/
+    trace.py): a callable invoked twice per simulated ms during TRACING —
+    ``tap(t, net, None)`` at ms entry (before retire/drain/delivery, so
+    the tap can read the ms's ring row, spill drain set and pre-retire
+    broadcast table as pure functions of the carried state) and
+    ``tap(t, net, out)`` right after the protocol step (the outbox is the
+    only per-message send information that never reaches the state).  The
+    default ``tap=None`` traces ZERO extra operations — the uninstrumented
+    program is bit-for-bit the historical one (the `trace_zero_cost` /
+    `metrics_zero_cost` lints pin its carry width and op count).
     """
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
+    if tap is not None:
+        tap(t, net, None)
     if cfg.bcast_slots > 0:
         net = _retire_broadcasts(cfg, net, t)
     if cfg.spill_cap > 0:
@@ -450,6 +463,8 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
         pstate, nodes, out = protocol.step(pstate, net.nodes, inbox, t, key,
                                            hints=hints)
     net = net.replace(nodes=nodes)
+    if tap is not None:
+        tap(t, net, out)
 
     # Clear the consumed slot, then route new sends (their arrivals are
     # >= t+2, so they can never land in the slot just cleared).
@@ -460,7 +475,8 @@ def step_ms(protocol, net: NetState, pstate, hints=None):
     return net.replace(time=t + 1), pstate
 
 
-def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None):
+def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None,
+             tap=None):
     """Advance K milliseconds in one fused engine pass — the superstep.
 
     Bit-identical to K `step_ms` calls (tests/test_superstep.py) whenever
@@ -496,18 +512,34 @@ def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None):
     Requirements (enforced by `check_chunk_config`): spill_cap == 0,
     K divides the horizon, entry time ≡ 0 (mod K), K <= floor + 1 via
     `unicast_floor_ms`, and a protocol that does not mutate liveness.
+
+    `tap` is the trace plane's observation hook (see `step_ms`): it
+    fires per SIMULATED ms inside the window — entry tap before each
+    ms's broadcast retire, post tap right after its protocol step — so
+    every recorded event carries its exact origin ms, never the window
+    start (K-vs-1 trace equality pinned in tests/test_trace.py).
     """
     if hints_k is not None and len(hints_k) != k:
         raise ValueError(f"hints_k must have {k} entries, got "
                          f"{len(hints_k)}")
     if k == 1:
         return step_ms(protocol, net, pstate,
-                       hints=None if hints_k is None else hints_k[0])
+                       hints=None if hints_k is None else hints_k[0],
+                       tap=tap)
     cfg, model = protocol.cfg, protocol.latency
     if cfg.spill_cap > 0:
         raise ValueError("step_kms requires spill_cap == 0 (spill drain "
                          "is inherently per-ms)")
     t = net.time
+    # Entry tap for the window's FIRST ms: before retire, matching the
+    # per-ms path's observation point.  Later ms tap inside the loop —
+    # their ring rows are untouched until the window's deferred clear,
+    # and in-window sends arrive >= t+K (the window soundness proof),
+    # so each per-ms entry observation reads exactly the state the
+    # per-ms engine would show it (tests/test_trace.py pins the K-vs-1
+    # trace equality).
+    if tap is not None:
+        tap(t, net, None)
     if cfg.bcast_slots > 0:
         net = _retire_broadcasts(cfg, net, t)
 
@@ -526,6 +558,8 @@ def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None):
     outs = []
     for i in range(k):
         ti = t + i if i else t      # no dead `t + 0` eqn in the trace
+        if i > 0 and tap is not None:
+            tap(ti, net, None)
         if i > 0 and cfg.bcast_slots > 0:
             net = _retire_broadcasts(cfg, net, ti)
         if cfg.bcast_slots > 0:
@@ -556,6 +590,8 @@ def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None):
                                                ti, key, hints=h_i)
         net = net.replace(nodes=nodes)
         outs.append(out)
+        if tap is not None:
+            tap(ti, net, out)
         if cfg.bcast_slots > 0:
             net = enqueue_broadcast(cfg, net, out, ti)
 
@@ -1112,7 +1148,7 @@ class Runner:
 
     def __init__(self, protocol, donate="auto", chunk_limit=10_000,
                  donate_threshold=1 << 20, superstep=1,
-                 fast_forward=False, metrics=None):
+                 fast_forward=False, metrics=None, trace=None):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
@@ -1133,8 +1169,23 @@ class Runner:
         # `metrics_carries` (device arrays — no sync); `metrics_frame()`
         # fetches and stitches them.
         self._metrics = metrics
+        # trace (an obs.TraceSpec) swaps in the flight-recorder chunk
+        # builders (obs/trace.py — bit-identical trajectory); each
+        # chunk's TraceCarry lands in `trace_carries` (device arrays —
+        # no sync); `trace_frame()` decodes, `trace_stats()` surfaces
+        # the truncation accounting (`run_report` prints it so a
+        # clipped ring can never pass silently).
+        if metrics is not None and trace is not None:
+            raise ValueError(
+                "Runner(metrics=..., trace=...) is not supported in one "
+                "pass: the two planes are separate carries and their "
+                "builders do not compose yet. Fix: run the chunk twice "
+                "(both planes are bit-identical on the trajectory), or "
+                "pick the one you are debugging with")
+        self._trace = trace
         self._ff_raw = []           # per-chunk device stats dicts
         self.metrics_carries = []
+        self.trace_carries = []
         # superstep=K fuses engine work across K-ms windows (step_kms,
         # bit-identical); the requested value is an UPPER BOUND — each
         # chunk runs the largest K <= it that `pick_superstep` proves
@@ -1157,6 +1208,15 @@ class Runner:
                 from ..obs.engine import scan_chunk_metrics
                 base = scan_chunk_metrics(self.protocol, ms, self._metrics,
                                           superstep=superstep)
+            elif self._trace is not None and self._fast_forward:
+                from ..obs.trace import fast_forward_chunk_trace
+                base = fast_forward_chunk_trace(self.protocol, ms,
+                                                self._trace,
+                                                superstep=superstep)
+            elif self._trace is not None:
+                from ..obs.trace import scan_chunk_trace
+                base = scan_chunk_trace(self.protocol, ms, self._trace,
+                                        superstep=superstep)
             elif self._fast_forward:
                 base = fast_forward_chunk(self.protocol, ms,
                                           superstep=superstep)
@@ -1170,14 +1230,16 @@ class Runner:
         return self._jits[key]
 
     def _call_chunk(self, fn, net, pstate):
-        """Run one chunk and stash the fast-forward stats / metrics
-        carry its builder returns beyond ``(net, pstate)``."""
+        """Run one chunk and stash the fast-forward stats / metrics /
+        trace carry its builder returns beyond ``(net, pstate)``."""
         out = fn(net, pstate)
         net, pstate = out[0], out[1]
         if self._fast_forward:
             self._ff_raw.append(out[2])
         if self._metrics is not None:
             self.metrics_carries.append(out[-1])
+        if self._trace is not None:
+            self.trace_carries.append(out[-1])
         return net, pstate
 
     def ff_stats(self):
@@ -1202,6 +1264,43 @@ class Runner:
         from ..obs.export import MetricsFrame
         return MetricsFrame.from_carries(self._metrics,
                                          self.metrics_carries)
+
+    def trace_frame(self):
+        """Host-side `obs.TraceFrame` stitched from every chunk's event
+        ring, or None when tracing was off/never ran."""
+        if self._trace is None or not self.trace_carries:
+            return None
+        from ..obs.decode import TraceFrame
+        return TraceFrame.from_carries(self._trace, self.trace_carries)
+
+    def trace_stats(self):
+        """Flight-recorder truncation accounting across every chunk
+        this Runner ran, or None when tracing was off/never ran: total
+        recorded events, the per-chunk ring high-water mark, capacity,
+        and the dropped-event count a silently clipped trace would
+        otherwise hide.  Forces a device sync (host ints)."""
+        if self._trace is None or not self.trace_carries:
+            return None
+        import numpy as np
+        cursors = [np.asarray(jax.device_get(tc.cursor),
+                              dtype=np.int64).reshape(-1)
+                   for tc in self.trace_carries]
+        dropped = sum(int(np.asarray(jax.device_get(tc.dropped),
+                                     dtype=np.int64).sum())
+                      for tc in self.trace_carries)
+        return {"events": int(sum(c.sum() for c in cursors)),
+                "high_water": int(max(c.max() for c in cursors)),
+                "capacity": self._trace.capacity,
+                "dropped": dropped}
+
+    def run_report(self, net, wall_s=None):
+        """One-line run summary (utils/profiling.run_report) carrying
+        this Runner's quiet-window skip accounting AND the trace
+        truncation counters — a clipped event ring shows up in bench
+        output instead of passing silently."""
+        from ..utils.profiling import run_report
+        return run_report(net, wall_s, ff=self.ff_stats(),
+                          trace=self.trace_stats())
 
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:

@@ -30,12 +30,29 @@ Two hard invariants (tests/test_obs.py, analysis `metrics_zero_cost`):
     import this package, and the `metrics_zero_cost` lint pins their
     scan-carry width and jaxpr op count so the plane can never silently
     tax the hot path.
+
+The EVENT plane (`trace`, `decode`, `diff` — PR 5) answers the
+question the metrics plane cannot: "which message, when, to whom".  A
+`TraceSpec(capacity, events, node_filter)` compiles a fixed-shape
+``[cap, 6]`` int32 event ring into the engine chunk through the
+`step_ms`/`step_kms` tap hook (per-ms exact inside fused K windows),
+under the SAME two-sided contract (trace-ON bit-identical,
+tests/test_trace.py; trace-OFF zero residue, analysis
+`trace_zero_cost`).  On top of it `obs/diff.py` + `tools/divergence.py`
+bisect the first state divergence between any two engine-variant
+configurations down to the exact (ms, pytree leaf, element) and print
+the decoded trace window around it from both runs.
 """
 
+from .decode import TraceFrame, trace_block  # noqa: F401
 from .engine import (fast_forward_chunk_batched_metrics,  # noqa: F401
                      fast_forward_chunk_metrics, scan_chunk_batched_metrics,
                      scan_chunk_metrics, step_ms_metrics)
 from .export import (MetricsFrame, engine_metrics_block,  # noqa: F401
-                     to_perfetto, to_progress_csv)
+                     to_perfetto, to_progress_csv, trace_to_perfetto)
 from .plane import MetricsCarry, counter_values, init_metrics  # noqa: F401
 from .spec import COUNTERS, MetricsSpec  # noqa: F401
+from .trace import (EVENTS, TraceCarry, TraceSpec,  # noqa: F401
+                    fast_forward_chunk_trace, init_trace,
+                    scan_chunk_batched_trace, scan_chunk_trace,
+                    step_ms_trace, trace_jump, trace_tap)

@@ -1,0 +1,327 @@
+"""First-divergence triage: the automatic bit-identity bisector.
+
+Every engine variant (dense, superstep-K, batched, fast-forward,
+sharded) carries a bit-identity contract against the per-ms reference
+— when one of them breaks it, the debugging question is always the
+same: at WHICH simulated millisecond does the trajectory first differ,
+in WHICH state leaf, at WHICH node — and what was in flight around that
+moment?  The reference answers it by stepping its event loop under a
+debugger; a compiled scan needs this module: run two engine-variant
+configurations side by side, localize the first divergence exactly, and
+print the decoded flight-recorder window around it from BOTH runs
+(`tools/divergence.py` is the one-command CLI).
+
+Method — the bisection is structured around the fact that replaying a
+deterministic pure engine from a saved state is exact:
+
+  1. COARSE: advance both configurations chunk by chunk, comparing the
+     full state pytrees ON DEVICE at every boundary (one bool transfer
+     per chunk — no state fetch) and keeping the last agreeing boundary
+     state.  This is the optimal "binary search" for a monotone
+     first-divergence predicate whose evaluation cost is linear in the
+     prefix length: every probe would have to re-simulate the prefix
+     anyway, so the forward scan with boundary fingerprints dominates a
+     logarithmic probe ladder.
+  2. FINE: from the saved boundary, re-advance both in steps of the
+     variants' finest common granularity ``g = lcm(K_a, K_b)`` (1 for
+     per-ms engines) until the first differing boundary — the divergent
+     window ``[t*, t* + g)``.
+  3. LOCALIZE: diff the two state pytrees at the divergent boundary:
+     first differing leaf (by canonical tree order, named via the
+     pytree key path) and the first differing element index within it.
+  4. REPLAY TRACED: re-run both sides from the saved chunk boundary
+     with each variant's EXACT traced twin (obs/trace.py — per-ms
+     taps, so events inside fused windows carry true origin ms) and
+     decode the event window around t*.
+
+`FaultInjector` wraps a protocol with a deliberate one-(ms, node, leaf)
+perturbation — the test harness for the bisector itself (a bisector
+that cannot find a planted divergence guards nothing) and a teaching
+tool for the triage workflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import TraceFrame
+from .trace import TraceSpec, fast_forward_chunk_trace, \
+    scan_chunk_batched_trace, scan_chunk_trace
+
+#: variant-dict keys understood by `build_variant` / the CLI
+VARIANT_KEYS = ("superstep", "batched", "fast_forward")
+
+
+def variant_granularity(variant: dict) -> int:
+    """Finest comparison step this engine variant supports: its fused
+    window length (the batched engine's floor is the K=2 pair)."""
+    k = int(variant.get("superstep", 1) or 1)
+    if variant.get("batched"):
+        k = max(k, 2)
+    return k
+
+
+def build_variant(protocol, ms: int, variant: dict, trace_spec=None):
+    """One jitted chunk callable for an engine-variant configuration,
+    over vmap-batched state (leading seed axis, the harness layout).
+
+    Untraced: ``(nets, ps) -> (nets, ps)``.  Traced (`trace_spec`):
+    ``-> (nets, ps, TraceCarry)`` via the variant's exact traced twin,
+    so the decoded events are the trajectory THIS variant computes."""
+    from ..core.batched import scan_chunk_batched
+    from ..core.network import fast_forward_chunk, scan_chunk
+
+    unknown = set(variant) - set(VARIANT_KEYS)
+    if unknown:
+        raise ValueError(f"unknown variant keys {sorted(unknown)}; "
+                         f"known: {VARIANT_KEYS}")
+    k = int(variant.get("superstep", 1) or 1)
+    if variant.get("batched"):
+        if trace_spec is not None:
+            base = scan_chunk_batched_trace(protocol, ms, trace_spec,
+                                            superstep=max(k, 2))
+        else:
+            base = scan_chunk_batched(protocol, ms, superstep=max(k, 2))
+        return jax.jit(base)
+    if variant.get("fast_forward"):
+        if trace_spec is not None:
+            traced = fast_forward_chunk_trace(protocol, ms, trace_spec,
+                                              seed_axis=True, superstep=k)
+
+            def run_t(nets, ps):
+                nets, ps, _, tc = traced(nets, ps)
+                return nets, ps, tc
+
+            return jax.jit(run_t)
+        base_ff = fast_forward_chunk(protocol, ms, seed_axis=True,
+                                     superstep=k)
+
+        def run(nets, ps):
+            nets, ps, _ = base_ff(nets, ps)
+            return nets, ps
+
+        return jax.jit(run)
+    if trace_spec is not None:
+        return jax.jit(jax.vmap(scan_chunk_trace(protocol, ms, trace_spec,
+                                                 superstep=k)))
+    return jax.jit(jax.vmap(scan_chunk(protocol, ms, superstep=k)))
+
+
+class FaultInjector:
+    """Protocol proxy that perturbs ONE element of the post-step state
+    at exactly one simulated ms: at ``t == at_ms``, ``delta`` is added
+    to ``leaf`` (a field of the protocol state, or ``"nodes.<field>"``
+    for engine node state) at index ``node``.  Everything else
+    delegates to the wrapped protocol, so the two sides of a bisection
+    run the SAME engine with a planted one-node divergence — the
+    bisector's ground truth."""
+
+    def __init__(self, inner, at_ms: int, leaf: str, node: int, delta=1):
+        self._inner = inner
+        self.at_ms = int(at_ms)
+        self.leaf = leaf
+        self.node = int(node)
+        self.delta = delta
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _perturb(self, tree, path: str, t):
+        head, _, rest = path.partition(".")
+        val = getattr(tree, head)
+        if rest:
+            return tree.replace(**{head: self._perturb(val, rest, t)})
+        hit = jnp.asarray(t == self.at_ms)
+        bumped = val.at[self.node].add(
+            jnp.where(hit, jnp.asarray(self.delta, val.dtype),
+                      jnp.asarray(0, val.dtype)))
+        return tree.replace(**{head: bumped})
+
+    def step(self, pstate, nodes, inbox, t, key, **kw):
+        pstate, nodes, out = self._inner.step(pstate, nodes, inbox, t,
+                                              key, **kw)
+        if self.leaf.startswith("nodes."):
+            nodes = self._perturb(nodes, self.leaf[len("nodes."):], t)
+        else:
+            pstate = self._perturb(pstate, self.leaf, t)
+        return pstate, nodes, out
+
+
+@dataclasses.dataclass
+class Divergence:
+    """Where two engine-variant runs first disagree."""
+
+    ms: int                 # divergent window start (states at `ms` agree)
+    granularity: int        # window width g = lcm(K_a, K_b)
+    leaf: str               # first differing leaf (pytree key path)
+    index: tuple            # first differing element (leading axis = run)
+    value_a: object
+    value_b: object
+    n_diff_leaves: int      # leaves differing at the divergent boundary
+    trace_a: TraceFrame | None = None
+    trace_b: TraceFrame | None = None
+    trace_window: tuple | None = None   # (lo, hi) of the decoded window
+
+    def format(self, trace_limit: int = 40) -> str:
+        g = self.granularity
+        win = (f"ms {self.ms}" if g == 1
+               else f"window [{self.ms}, {self.ms + g}) (granularity "
+                    f"{g} — the variants' finest common step)")
+        lines = [
+            f"first divergence: {win}",
+            f"  leaf : {self.leaf}",
+            f"  index: {self.index}  (leading axis = run/seed)",
+            f"  a={self.value_a}  b={self.value_b}",
+            f"  {self.n_diff_leaves} leaf(s) differ at the divergent "
+            "boundary",
+        ]
+        if self.trace_a is not None:
+            lo, hi = self.trace_window
+            lines += [f"--- trace A, ms [{lo}, {hi}) "
+                      f"({self.trace_a.n_events} events):",
+                      self.trace_a.format(limit=trace_limit) or "  (none)"]
+        if self.trace_b is not None:
+            lo, hi = self.trace_window
+            lines += [f"--- trace B, ms [{lo}, {hi}) "
+                      f"({self.trace_b.n_events} events):",
+                      self.trace_b.format(limit=trace_limit) or "  (none)"]
+        return "\n".join(lines)
+
+
+def _states_equal():
+    @jax.jit
+    def eq(a, b):
+        ok = jnp.asarray(True)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            ok = ok & jnp.array_equal(x, y)
+        return ok
+
+    return eq
+
+
+def _first_leaf_diff(state_a, state_b):
+    """(leaf path, element index, value_a, value_b, n_diff_leaves) of
+    the first differing leaf in canonical tree order."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    la, _ = tree_flatten_with_path(state_a)
+    lb, _ = tree_flatten_with_path(state_b)
+    first, n_diff = None, 0
+    for (path, xa), (_, xb) in zip(la, lb):
+        da, db = np.asarray(xa), np.asarray(xb)
+        mask = da != db
+        if mask.any():
+            n_diff += 1
+            if first is None:
+                idx = np.unravel_index(int(np.argmax(mask)), mask.shape) \
+                    if mask.ndim else ()
+                first = (keystr(path), tuple(int(i) for i in idx),
+                         da[idx] if mask.ndim else da,
+                         db[idx] if mask.ndim else db)
+    if first is None:
+        return None
+    path, idx, va, vb = first
+    return path, idx, va, vb, n_diff
+
+
+def first_divergence(protocol, variant_a, variant_b, total_ms,
+                     chunk_ms=None, seeds=1, protocol_b=None,
+                     trace_spec=None, trace_pad_ms=4, first_seed=0):
+    """Bisect the first state divergence between two engine-variant
+    configurations of `protocol` over `total_ms` simulated ms.
+
+    `variant_a` / `variant_b` are dicts over VARIANT_KEYS (e.g.
+    ``{"superstep": 1}`` vs ``{"superstep": 4, "batched": True}``).
+    `protocol_b` substitutes a different protocol object for side B —
+    same state shapes required (the `FaultInjector` hook).
+    `trace_spec` (default: a 4096-row `TraceSpec`; pass ``False`` to
+    skip the traced replay) decodes the event window
+    ``[t* - trace_pad_ms, t* + g + trace_pad_ms)`` around the divergence
+    from both sides' exact traced twins.
+
+    Returns a `Divergence`, or None when the runs are bit-identical
+    over the whole span.
+    """
+    pa, pb = protocol, protocol_b or protocol
+    ga = variant_granularity(variant_a)
+    gb = variant_granularity(variant_b)
+    g = ga * gb // math.gcd(ga, gb)
+    if chunk_ms is None:
+        chunk_ms = max(32, 4 * g)
+    chunk_ms = -(-chunk_ms // g) * g
+    total_ms = -(-int(total_ms) // chunk_ms) * chunk_ms
+
+    sd = first_seed + jnp.arange(seeds, dtype=jnp.int32)
+    state_a = jax.vmap(pa.init)(sd)
+    state_b = jax.vmap(pb.init)(sd)
+    t0 = int(np.asarray(jax.device_get(state_a[0].time)).reshape(-1)[0])
+
+    step_a = build_variant(pa, chunk_ms, variant_a)
+    step_b = build_variant(pb, chunk_ms, variant_b)
+    eq = _states_equal()
+
+    # 1. coarse: first divergent chunk, saving the last agreeing
+    # boundary (one bool transfer per chunk; states stay on device).
+    saved, saved_t = (state_a, state_b), t0
+    t = t0
+    diverged = False
+    for _ in range(total_ms // chunk_ms):
+        nxt_a = step_a(*state_a)
+        nxt_b = step_b(*state_b)
+        state_a, state_b = nxt_a, nxt_b
+        t += chunk_ms
+        if not bool(jax.device_get(eq(state_a, state_b))):
+            diverged = True
+            break
+        saved, saved_t = (state_a, state_b), t
+    if not diverged:
+        return None
+
+    # 2. fine: replay the divergent chunk from the saved boundary at
+    # the finest common granularity g.
+    fine_a = build_variant(pa, g, variant_a)
+    fine_b = build_variant(pb, g, variant_b)
+    state_a, state_b = saved
+    t_star = saved_t
+    for _ in range(chunk_ms // g):
+        state_a = fine_a(*state_a)
+        state_b = fine_b(*state_b)
+        if not bool(jax.device_get(eq(state_a, state_b))):
+            break
+        t_star += g
+
+    # 3. localize: first differing leaf/element at the boundary.
+    located = _first_leaf_diff(state_a, state_b)
+    if located is None:         # can only mean a nondeterministic build
+        raise RuntimeError(
+            "the fine pass lost the divergence the coarse pass found: "
+            "the build is not replay-deterministic (this bisector's one "
+            "precondition). Check the variant for host-dependent state")
+    leaf, idx, va, vb, n_diff = located
+
+    div = Divergence(ms=t_star, granularity=g, leaf=leaf, index=idx,
+                     value_a=va, value_b=vb, n_diff_leaves=n_diff)
+    if trace_spec is False:
+        return div
+
+    # 4. traced replay of both sides from the saved chunk boundary
+    # through the divergent window (+ pad), via each side's EXACT
+    # traced twin.
+    spec = trace_spec or TraceSpec()
+    span = (t_star - saved_t) + g + int(trace_pad_ms)
+    span = -(-span // g) * g
+    tr_a = build_variant(pa, span, variant_a, trace_spec=spec)
+    tr_b = build_variant(pb, span, variant_b, trace_spec=spec)
+    *_, tc_a = tr_a(*saved[0])
+    *_, tc_b = tr_b(*saved[1])
+    lo = max(saved_t, t_star - int(trace_pad_ms))
+    hi = saved_t + span
+    div.trace_a = TraceFrame.from_carry(spec, tc_a).window(lo, hi)
+    div.trace_b = TraceFrame.from_carry(spec, tc_b).window(lo, hi)
+    div.trace_window = (lo, hi)
+    return div

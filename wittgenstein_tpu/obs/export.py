@@ -210,6 +210,67 @@ def to_perfetto(frame: MetricsFrame, path: str | None = None,
     return trace
 
 
+#: pid of the flight-recorder lane — its own process next to the
+#: metrics lane (ENGINE_PID) and any XLA device pids on a merged
+#: Perfetto session.
+TRACE_PID = 90211
+
+#: per-node thread_name metadata is emitted for at most this many
+#: distinct nodes (unnamed tids still render; the cap only bounds the
+#: metadata volume for wide captures).
+_MAX_NAMED_NODE_TRACKS = 512
+
+
+def trace_to_perfetto(frame, path: str | None = None,
+                      name: str = "wtpu flight recorder") -> dict:
+    """Chrome-trace JSON for a decoded event stream (`TraceFrame`,
+    obs/decode.py): per-NODE track events on the simulated-time axis.
+
+    Same conventions and clock as `to_perfetto` (1 sim-ms -> 1000
+    trace-us, `process_name`/`thread_name` "M" metadata, "X" slices),
+    so a flight-recorder capture, the metrics interval lane and the XLA
+    op traces `tools/tpu_profile.py` parses all load on ONE Perfetto
+    timeline.  Track assignment: sends/drops/spill parks on the SOURCE
+    node's track, deliveries/unparks on the DESTINATION's, node_down on
+    the node's own; engine-global events (bc_retire, ff_jump) on tid 0.
+    `path` (optional) writes the JSON; a ``.gz`` suffix gzips it.
+    """
+    from .trace import EVENTS, KIND
+
+    src_side = {KIND["send"], KIND["drop"], KIND["spill_park"],
+                KIND["node_down"]}
+    events = [
+        {"ph": "M", "pid": TRACE_PID, "name": "process_name",
+         "args": {"name": f"{name} (simulated time)"}},
+        {"ph": "M", "pid": TRACE_PID, "tid": 0, "name": "thread_name",
+         "args": {"name": "engine (global events)"}},
+    ]
+    named = set()
+    for ev, buf in zip(frame.events, frame.buffer):
+        t, kind, src, dst, nbytes, aux = (int(x) for x in ev)
+        node = src if kind in src_side else dst
+        tid = node + 1 if node >= 0 else 0
+        if tid and tid not in named and len(named) < _MAX_NAMED_NODE_TRACKS:
+            named.add(tid)
+            events.append({"ph": "M", "pid": TRACE_PID, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"node {node}"}})
+        events.append({
+            "ph": "X", "pid": TRACE_PID, "tid": tid, "ts": t * 1000,
+            "dur": 250, "name": EVENTS[kind],
+            "args": {"src": src, "dst": dst, "payload_bytes": nbytes,
+                     "aux": aux, "buffer": int(buf)}})
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path:
+        if str(path).endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                json.dump(trace, f)
+        else:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+    return trace
+
+
 #: series longer than this are summarized (totals only) in the bench
 #: JSON line — one JSON line must stay one line.
 _MAX_SERIES_ROWS = 64

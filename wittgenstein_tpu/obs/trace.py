@@ -1,0 +1,469 @@
+"""Device side of the event flight recorder: message-level tracing.
+
+The metrics plane (obs/plane.py) answers "how much" per interval; this
+module answers "which message, when, to whom" — the question the
+reference gets for free from its single-threaded event loop (every
+`Envelope` is inspectable in delivery order, Network.java:108-115) and a
+compiled scan has no loop to inspect.  The recovery is the same shape as
+the metrics plane: a fixed-shape on-device ring (`TraceCarry`: a
+``[capacity, 6]`` int32 event buffer + a write cursor + a saturating
+``dropped`` counter) rides the engine chunk as an extra scan/while
+carry, and a host-side decoder (obs/decode.py) turns it into structured
+events after the chunk returns.  Zero host sync: every append is a pure
+masked-cumsum compaction scatter.
+
+Event record layout (``FIELDS``): ``(time_ms, kind, src, dst,
+payload_bytes, aux)``.  Kinds (``EVENTS``; aux semantics per kind):
+
+  send          unicast send attempt (aux = stable full-width outbox
+                slot id — the same id the latency draw is keyed on);
+                ``dst == -1`` marks a sendAll request (aux = -1)
+  deliver       a message delivered this ms (unicast: aux = inbox slot;
+                broadcast: aux = inbox_cap + broadcast-table slot)
+  drop          a routed send that can never deliver (aux: 1 = past
+                msg_discard_time, 2 = destination down, 3 = cross-
+                partition).  Ring-overflow and spill-overflow losses
+                are counted (NetState.dropped / sp_dropped), not traced
+                per message — they are decided inside the binning sort.
+  spill_park    far-future send parked in the spill buffer
+                (aux = absolute scheduled arrival)
+  spill_unpark  parked message re-injected into ring reach
+                (aux = absolute scheduled arrival)
+  bc_retire     broadcast-table record retired (outlived the ring;
+                aux = table slot, dst = -1)
+  ff_jump       quiet-window fast-forward jump (src = dst = -1,
+                aux = skipped ms; time = jump origin)
+  node_down     node observed newly down after a step (src = dst = id)
+
+Observation happens through the engine's `tap` hook
+(`core/network.step_ms` / `step_kms`): ``tap(t, net, None)`` at ms
+entry reads the ms's ring row, pre-retire broadcast table and spill
+drain set; ``tap(t, net, out)`` right after the protocol step reads the
+outbox — the only per-message send information that never reaches the
+carried state.  Everything recorded is a pure function of
+``(t, carried state, outbox)``, so **trace-ON is bit-identical** on the
+`(NetState, pstate)` trajectory for every engine variant
+(tests/test_trace.py), and the default ``tap=None`` traces zero extra
+operations — **trace-OFF has zero residue** (the `trace_zero_cost`
+analysis rule pins the uninstrumented carry width, the sibling of
+`metrics_zero_cost`).
+
+Inside a fused K-ms superstep window the taps fire per simulated ms
+with the window's own per-ms times, so every event carries its EXACT
+origin ms, never the window start (pinned against the K=1 trace in
+tests/test_trace.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core.latency import full_latency
+from ..core.network import (_jump, broadcast_arrivals, check_chunk_config,
+                            next_work, step_kms, step_ms)
+from ..ops import prng
+
+#: Canonical event kinds; the kind CODE is the index here and is stable
+#: regardless of which subset a spec enables (decode uses this table).
+EVENTS = ("send", "deliver", "drop", "spill_park", "spill_unpark",
+          "bc_retire", "ff_jump", "node_down")
+KIND = {name: i for i, name in enumerate(EVENTS)}
+
+#: Event record columns, in buffer order.
+FIELDS = ("time_ms", "kind", "src", "dst", "payload_bytes", "aux")
+
+_I32_MAX = 0x7FFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Static flight-recorder parameters (hashable, jit-closable).
+
+    capacity — event ring rows; once full, further events are counted
+    in the saturating ``dropped`` carry instead of overwriting (a
+    truncated trace must announce itself — `Runner.run_report` and the
+    bench `trace` block surface the counter).
+    events — enabled kind subset (canonical EVENTS order); disabled
+    kinds are never computed, a compile-time gate.
+    node_filter — optional ``(lo, hi)`` global-node-id half-open range:
+    only events touching a node in range (src or dst) are recorded
+    (`ff_jump` is global and always kept).
+    """
+
+    capacity: int = 4096
+    events: tuple = EVENTS
+    node_filter: tuple | None = None
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        unknown = [e for e in self.events if e not in EVENTS]
+        if unknown:
+            raise ValueError(f"unknown events {unknown}; known: {EVENTS}")
+        object.__setattr__(
+            self, "events",
+            tuple(e for e in EVENTS if e in set(self.events)))
+        if self.node_filter is not None:
+            lo, hi = self.node_filter
+            if not (isinstance(lo, int) and isinstance(hi, int) and lo < hi):
+                raise ValueError(
+                    f"node_filter must be an int (lo, hi) half-open range "
+                    f"with lo < hi, got {self.node_filter!r}")
+            object.__setattr__(self, "node_filter", (int(lo), int(hi)))
+
+    def enabled(self, name: str) -> bool:
+        return name in self.events
+
+
+@struct.dataclass
+class TraceCarry:
+    """The on-device event ring: ``buf[i]`` is the i-th recorded event
+    (FIELDS order) for ``i < cursor``; `dropped` counts events that
+    found the ring full (saturating — never wraps negative)."""
+
+    buf: jnp.ndarray        # int32 [capacity, 6]
+    cursor: jnp.ndarray     # int32 scalar — rows written (<= capacity)
+    dropped: jnp.ndarray    # int32 scalar
+
+
+def init_trace(spec: TraceSpec) -> TraceCarry:
+    """Fresh empty ring."""
+    return TraceCarry(
+        buf=jnp.zeros((spec.capacity, len(FIELDS)), jnp.int32),
+        cursor=jnp.asarray(0, jnp.int32),
+        dropped=jnp.asarray(0, jnp.int32))
+
+
+def _append(spec: TraceSpec, tc: TraceCarry, t, kind: int, src, dst,
+            nbytes, aux, valid) -> TraceCarry:
+    """Compact-append the masked candidate batch: the i-th valid entry
+    (in index order — the deterministic per-ms event order) lands at
+    ``cursor + i``; entries past capacity are dropped and counted.  One
+    masked cumsum + one row scatter — no sort, no host sync."""
+    cap = spec.capacity
+    m = valid.shape[0]
+    if spec.node_filter is not None and kind != KIND["ff_jump"]:
+        lo, hi = spec.node_filter
+        keep = ((src >= lo) & (src < hi)) | ((dst >= lo) & (dst < hi))
+        valid = valid & keep
+    valid_i = valid.astype(jnp.int32)
+    pos = tc.cursor + jnp.cumsum(valid_i) - 1
+    ok = valid & (pos < cap)
+    idx = jnp.where(ok, pos, cap)           # cap = OOB drop sentinel
+
+    def col(x):
+        return jnp.broadcast_to(jnp.asarray(x, jnp.int32), (m,))
+
+    ev = jnp.stack([col(t), col(kind), col(src), col(dst), col(nbytes),
+                    col(aux)], axis=1)
+    buf = tc.buf.at[idx].set(ev, mode="drop", unique_indices=True)
+    nv = jnp.sum(valid_i)
+    written = jnp.minimum(nv, jnp.maximum(cap - tc.cursor, 0))
+    dropped = tc.dropped + (nv - written)
+    # saturate instead of wrapping negative on pathological volumes
+    dropped = jnp.where(dropped < tc.dropped, jnp.int32(_I32_MAX), dropped)
+    return tc.replace(buf=buf, cursor=tc.cursor + written, dropped=dropped)
+
+
+def _unicast_row(cfg, net, t):
+    """The time-t unicast ring row, shaped for observation: the same
+    slice `build_inbox` reads (core/network.py), minus the counter
+    bumps.  Returns ``(src [N, C], size [N, C], valid [N, C])`` with the
+    delivery-time down/partition checks applied."""
+    nodes = net.nodes
+    c = cfg.inbox_cap
+    p, ns = cfg.box_split, cfg.split_n
+    h = t % cfg.horizon
+    base = h * (ns * c)
+
+    def rd(plane):
+        return jax.lax.dynamic_slice(plane, (base,),
+                                     (ns * c,)).reshape(ns, c)
+
+    def rd_all(planes):
+        if p == 1:
+            return rd(planes[0])
+        return jnp.concatenate([rd(pl) for pl in planes], axis=0)
+
+    src = rd_all(net.box_src)
+    size = rd_all(net.box_size)
+    valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
+    deliver_ok = (~nodes.down[:, None]) & (
+        nodes.partition[src] == nodes.partition[:, None])
+    return src, size, valid & deliver_ok
+
+
+def _entry_events(spec: TraceSpec, cfg, model, tc: TraceCarry, t,
+                  net) -> TraceCarry:
+    """Events observable at ms entry (pre-retire, pre-drain, pre-step):
+    this ms's deliveries (unicast ring row + broadcast recompute),
+    broadcast retirements, spill re-injections.  Append order is fixed:
+    deliver-unicast (node-major, slot-minor), deliver-broadcast
+    (node-major, table-slot-minor), bc_retire, spill_unpark."""
+    nodes = net.nodes
+    n = cfg.n
+    t = jnp.asarray(t, jnp.int32)
+    node_idx = jnp.arange(n, dtype=jnp.int32)
+    if spec.enabled("deliver"):
+        src, size, valid = _unicast_row(cfg, net, t)
+        dst = jnp.broadcast_to(node_idx[:, None], (n, cfg.inbox_cap))
+        slot = jnp.broadcast_to(
+            jnp.arange(cfg.inbox_cap, dtype=jnp.int32)[None, :],
+            (n, cfg.inbox_cap))
+        tc = _append(spec, tc, t, KIND["deliver"], src.reshape(-1),
+                     dst.reshape(-1), size.reshape(-1), slot.reshape(-1),
+                     valid.reshape(-1))
+        if cfg.bcast_slots > 0:
+            b = cfg.bcast_slots
+            arrival, ok, _ = broadcast_arrivals(cfg, model, net, nodes)
+            hit = jnp.transpose(ok & (arrival == t) &
+                                (~nodes.down[None, :]))          # [N, B]
+            bsrc = jnp.broadcast_to(net.bc_src[None, :], (n, b))
+            bsize = jnp.broadcast_to(net.bc_size[None, :], (n, b))
+            bdst = jnp.broadcast_to(node_idx[:, None], (n, b))
+            baux = jnp.broadcast_to(
+                cfg.inbox_cap + jnp.arange(b, dtype=jnp.int32)[None, :],
+                (n, b))
+            tc = _append(spec, tc, t, KIND["deliver"], bsrc.reshape(-1),
+                         bdst.reshape(-1), bsize.reshape(-1),
+                         baux.reshape(-1), hit.reshape(-1))
+    if cfg.bcast_slots > 0 and spec.enabled("bc_retire"):
+        retire = net.bc_active & ((t - net.bc_time) >= cfg.horizon)
+        slot = jnp.arange(cfg.bcast_slots, dtype=jnp.int32)
+        tc = _append(spec, tc, t, KIND["bc_retire"], net.bc_src,
+                     jnp.full_like(net.bc_src, -1), net.bc_size, slot,
+                     retire)
+    if cfg.spill_cap > 0 and spec.enabled("spill_unpark"):
+        sel = (net.sp_arrival >= 0) & (net.sp_arrival - t <=
+                                       cfg.horizon - 2)
+        tc = _append(spec, tc, t, KIND["spill_unpark"], net.sp_src,
+                     net.sp_dest, net.sp_size, net.sp_arrival, sel)
+    return tc
+
+
+def _post_events(spec: TraceSpec, cfg, model, tc: TraceCarry, t, net,
+                 out, down0) -> TraceCarry:
+    """Events observable right after the protocol step, from the outbox
+    and the post-step state.  Append order: send-unicast (node-major,
+    outbox-slot-minor), send-broadcast, spill_park, drop, node_down.
+    The drop/park determination replays the routing validity of
+    `_route_unicast` exactly — same latency draw keyed on (seed, t,
+    full-width slot id) — so a traced drop is the drop the engine
+    counts."""
+    nodes = net.nodes
+    n = cfg.n
+    t = jnp.asarray(t, jnp.int32)
+    kk = out.dest.shape[1]
+    m = n * kk
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), kk)
+    dest = out.dest.reshape(m)
+    size = out.size.reshape(m)
+    delay = out.delay.reshape(m)
+    want = (dest >= 0) & (~nodes.down[src])
+    dest_c = jnp.clip(dest, 0, n - 1)
+    midx = src * cfg.out_deg + out.slot0 + \
+        jnp.arange(m, dtype=jnp.int32) % kk
+    if spec.enabled("send"):
+        tc = _append(spec, tc, t, KIND["send"], src, dest_c, size, midx,
+                     want)
+        if cfg.bcast_slots > 0:
+            node_idx = jnp.arange(n, dtype=jnp.int32)
+            req = out.bcast & (~nodes.down)
+            tc = _append(spec, tc, t, KIND["send"], node_idx,
+                         jnp.full((n,), -1, jnp.int32), out.bcast_size,
+                         jnp.full((n,), -1, jnp.int32), req)
+    want_park = cfg.spill_cap > 0 and spec.enabled("spill_park")
+    if spec.enabled("drop") or want_park:
+        seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
+        delta = prng.uniform_delta(seed_t, midx)
+        lat = full_latency(model, nodes, src, dest_c, delta)
+        not_disc = lat < cfg.msg_discard_time
+        raw_total = jnp.clip(delay, 0, None) + jnp.maximum(lat, 1)
+        reachable = (~nodes.down[dest_c]) & (
+            nodes.partition[src] == nodes.partition[dest_c])
+        valid = want & not_disc & reachable
+        if want_park:
+            far = valid & (raw_total > cfg.horizon - 2)
+            tc = _append(spec, tc, t, KIND["spill_park"], src, dest_c,
+                         size, t + 1 + raw_total, far)
+        if spec.enabled("drop"):
+            reason = jnp.where(~not_disc, 1,
+                               jnp.where(nodes.down[dest_c], 2, 3))
+            tc = _append(spec, tc, t, KIND["drop"], src, dest_c, size,
+                         reason, want & ~valid)
+    if spec.enabled("node_down") and down0 is not None:
+        newly = nodes.down & ~down0
+        node_idx = jnp.arange(n, dtype=jnp.int32)
+        zero = jnp.zeros((n,), jnp.int32)
+        tc = _append(spec, tc, t, KIND["node_down"], node_idx, node_idx,
+                     zero, zero, newly)
+    return tc
+
+
+def trace_jump(spec: TraceSpec, tc: TraceCarry, t_from, dt) -> TraceCarry:
+    """Record one quiet-window fast-forward jump (``dt == 0`` appends
+    nothing)."""
+    if not spec.enabled("ff_jump"):
+        return tc
+    dt = jnp.asarray(dt, jnp.int32)
+    return _append(spec, tc, jnp.asarray(t_from, jnp.int32),
+                   KIND["ff_jump"], jnp.full((1,), -1, jnp.int32),
+                   jnp.full((1,), -1, jnp.int32),
+                   jnp.zeros((1,), jnp.int32), dt[None], (dt > 0)[None])
+
+
+def trace_tap(protocol, spec: TraceSpec, cell):
+    """Build the `step_ms`/`step_kms` observation hook bound to a
+    mutable 2-cell ``[TraceCarry, saved_down]``.  The engine calls the
+    tap twice per simulated ms; the builder reads the updated carry back
+    out of the cell after the step call — all within one trace, so the
+    carry threads through scan/while like any other state."""
+    cfg, model = protocol.cfg, protocol.latency
+
+    def tap(t, net, out):
+        if out is None:
+            cell[1] = net.nodes.down
+            cell[0] = _entry_events(spec, cfg, model, cell[0], t, net)
+        else:
+            cell[0] = _post_events(spec, cfg, model, cell[0], t, net, out,
+                                   cell[1])
+
+    return tap
+
+
+def step_ms_trace(protocol, spec: TraceSpec, net, pstate, tc):
+    """One traced millisecond: `step_ms` with the recorder tapped in.
+    The building block of the dense builders below."""
+    cell = [tc, None]
+    net, pstate = step_ms(protocol, net, pstate,
+                          tap=trace_tap(protocol, spec, cell))
+    return net, pstate, cell[0]
+
+
+def _step_window_trace(protocol, spec: TraceSpec, k: int):
+    """One traced K-ms window as a per-seed callable (k == 1 is a plain
+    traced ms)."""
+
+    def one(net, pstate, tc):
+        cell = [tc, None]
+        net, pstate = step_kms(protocol, net, pstate, k,
+                               tap=trace_tap(protocol, spec, cell))
+        return net, pstate, cell[0]
+
+    return one
+
+
+def scan_chunk_trace(protocol, ms: int, spec: TraceSpec,
+                     superstep: int = 1):
+    """Returns ``run(net, pstate) -> (net, pstate, TraceCarry)``
+    advancing `ms` milliseconds as one `lax.scan` with the flight
+    recorder in the carry — the traced twin of
+    ``scan_chunk(protocol, ms, superstep=K)``.  Inside a K window the
+    taps fire per simulated ms, so events carry their exact origin ms
+    and the recorded stream is bit-identical to the K=1 trace
+    (tests/test_trace.py)."""
+    check_chunk_config(protocol, ms, superstep=superstep)
+    step = _step_window_trace(protocol, spec, superstep)
+
+    def run(net, pstate):
+        def body(carry, _):
+            return step(*carry), ()
+
+        (net2, p2, tc), _ = jax.lax.scan(
+            body, (net, pstate, init_trace(spec)), length=ms // superstep)
+        return net2, p2, tc
+
+    return run
+
+
+def scan_chunk_batched_trace(protocol, ms: int, spec: TraceSpec,
+                             superstep: int = 2):
+    """Traced twin of `core/batched.scan_chunk_batched`: per-seed event
+    rings over the K-ms window engine.
+
+    The seed-folded mailbox scatter is a LAYOUT optimization — the
+    batched engine is bit-identical to the vmapped window engine
+    (tests/test_batched.py) — so the traced twin runs the vmapped
+    `step_kms` with per-ms taps: the trajectory (and therefore every
+    event) is exactly the one the folded production engine computes,
+    and the event stream per seed matches the dense trace's canonical
+    order."""
+    from ..core.batched import _check_batched_scope
+
+    check_chunk_config(protocol, ms, superstep=superstep)
+    _check_batched_scope(protocol, ms, superstep)
+    step = _step_window_trace(protocol, spec, superstep)
+
+    def run(net, pstate):
+        tc0 = jax.vmap(lambda _: init_trace(spec))(net.time)
+
+        def body(carry, _):
+            return jax.vmap(step)(*carry), ()
+
+        (net2, p2, tc), _ = jax.lax.scan(body, (net, pstate, tc0),
+                                         length=ms // superstep)
+        return net2, p2, tc
+
+    return run
+
+
+def fast_forward_chunk_trace(protocol, ms: int, spec: TraceSpec,
+                             seed_axis: bool = False, superstep: int = 1):
+    """Traced twin of `core/network.fast_forward_chunk`: returns
+    ``run(net, pstate) -> (net, pstate, stats, TraceCarry)``.  Executed
+    ms record their events exactly as the dense path does; each jump
+    appends one `ff_jump` event at its origin ms (a skipped ms is a
+    no-op step and records nothing — the jump event is the whole
+    story).  ``seed_axis=True`` mirrors the engine's vmap-batched mode
+    with per-seed rings and lockstep jumps."""
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    cfg, k = protocol.cfg, superstep
+    step = _step_window_trace(protocol, spec, k)
+
+    def run(net, pstate):
+        t0 = net.time[0] if seed_axis else net.time
+        t_end = t0 + ms
+        if seed_axis:
+            tc0 = jax.vmap(lambda _: init_trace(spec))(net.time)
+        else:
+            tc0 = init_trace(spec)
+
+        def cond(carry):
+            t = carry[0].time[0] if seed_axis else carry[0].time
+            return t < t_end
+
+        def body(carry):
+            net, ps, tc, skipped, jumps = carry
+            if seed_axis:
+                net, ps, tc = jax.vmap(step)(net, ps, tc)
+                t1 = net.time[0]
+                nw = jnp.min(jax.vmap(
+                    lambda n_, p_: next_work(protocol, n_, p_, t1))(
+                    net, ps))
+            else:
+                net, ps, tc = step(net, ps, tc)
+                t1 = net.time
+                nw = next_work(protocol, net, ps, t1)
+            dt = jnp.clip(nw, t1, t_end) - t1
+            if k > 1:
+                dt = dt - dt % k          # keep entry times K-aligned
+            net = _jump(cfg, net, dt, t1 + dt)
+            if seed_axis:
+                tc = jax.vmap(lambda t_: trace_jump(spec, t_, t1, dt))(tc)
+            else:
+                tc = trace_jump(spec, tc, t1, dt)
+            return (net, ps, tc, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, tc, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, tc0, z, z))
+        return net, pstate, {"skipped_ms": skipped,
+                             "jump_count": jumps}, tc
+
+    return run

@@ -146,6 +146,9 @@ class ShardedRunner:
     def _local_inbox(self, snet: ShardedNet, t, part_all=None,
                      extra_all=None, tables=None):
         """Local-ring slice + broadcast recompute for the local nodes.
+        Returns ``(inbox, nodes, sizes)`` — `sizes` is the per-slot
+        payload-byte view ``[nl, C + B]`` the trace plane records
+        (delivery itself reads sizes only for the receive counters).
 
         Global semantics preserved: latency draws key on GLOBAL ids."""
         cfg, lcfg = self.protocol.cfg, self.lcfg
@@ -202,7 +205,10 @@ class ShardedRunner:
         nodes = nodes.replace(
             msg_received=nodes.msg_received + recv,
             bytes_received=nodes.bytes_received + rbytes)
-        return inbox, nodes
+        sizes = jnp.concatenate(
+            [uc_size, jnp.broadcast_to(net.bc_size[None, :], (nl, b))],
+            axis=1)
+        return inbox, nodes, sizes
 
     def _bc_latency(self, snet, src_g, dst_g, delta, extra_all=None,
                     tables=None):
@@ -222,9 +228,23 @@ class ShardedRunner:
             lat = lat + extra_all[src_g] + extra_all[dst_g]
         return jnp.maximum(1, lat) * (src_g != dst_g) + (src_g == dst_g)
 
-    def step_fn(self, superstep: int = 1):
+    def step_fn(self, superstep: int = 1, trace_spec=None):
         """Returns the shard_map'ed step: one simulated ms (default), or
         one fused K-ms superstep window.
+
+        ``trace_spec`` (an `obs.TraceSpec`) compiles the flight
+        recorder into the step: the returned function then maps
+        ``(snet, pstate, TraceCarry) -> (snet, pstate, TraceCarry)``
+        with PER-SHARD event rings — deliveries recorded from each
+        shard's local inbox (dst = global id) and sends from its
+        outbox (src/aux = the same global ids/slot the latency draw
+        keys on), per-ms exact inside a K window.  Scope note: the
+        sharded recorder covers `send`/`deliver` (+ the node filter);
+        drop/spill/bc_retire kinds are decided inside the exchange
+        machinery and stay counter-only here (`xdropped`,
+        `net.dropped`).  Tracing is a pure read of values the step
+        already computes, so the (state, pstate) trajectory is
+        bit-identical to the untraced step (tests/test_trace.py).
 
         The K generalization mirrors `core/network.step_kms`: the local
         ring rows are untouched inside the window (K <= the protocol's
@@ -245,8 +265,10 @@ class ShardedRunner:
         K = superstep
         proto = self.protocol
         fw = cfg.payload_words
+        if trace_spec is not None:
+            from ..obs.trace import KIND, _append
 
-        def one_shard(snet: ShardedNet, pstate):
+        def one_shard(snet: ShardedNet, pstate, tc=None):
             net = snet.net
             t = net.time
             # replicated per-node tables for cross-shard checks (one [N]
@@ -279,9 +301,20 @@ class ShardedRunner:
                 ti = t + i
                 net = net.replace(bc_active=net.bc_active & (
                     (ti - net.bc_time) < cfg.horizon))
-                inbox, nodes = self._local_inbox(snet.replace(net=net), ti,
-                                                 part_all, extra_all,
-                                                 tables)
+                inbox, nodes, in_sizes = self._local_inbox(
+                    snet.replace(net=net), ti, part_all, extra_all,
+                    tables)
+                if tc is not None and trace_spec.enabled("deliver"):
+                    width = inbox.valid.shape[1]
+                    dst_g = jnp.broadcast_to(gids0[:, None], (nl, width))
+                    slot = jnp.broadcast_to(
+                        jnp.arange(width, dtype=jnp.int32)[None, :],
+                        (nl, width))
+                    tc = _append(trace_spec, tc, ti, KIND["deliver"],
+                                 inbox.src.reshape(-1),
+                                 dst_g.reshape(-1),
+                                 in_sizes.reshape(-1), slot.reshape(-1),
+                                 inbox.valid.reshape(-1))
                 key = jax.random.fold_in(jax.random.PRNGKey(net.seed), ti)
                 if step is not None:
                     # Shard-aware protocols receive their GLOBAL node ids.
@@ -304,6 +337,13 @@ class ShardedRunner:
                     jnp.where(want_i, size_i, 0))
                 nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
                 net = net.replace(nodes=nodes)
+                if tc is not None and trace_spec.enabled("send"):
+                    tc = _append(
+                        trace_spec, tc, ti, KIND["send"],
+                        jnp.repeat(gids0, ke),
+                        jnp.clip(dest_i, 0, cfg.n - 1), size_i,
+                        jnp.repeat(gids0, ke) * k + out.slot0 +
+                        jnp.arange(m, dtype=jnp.int32) % ke, want_i)
                 parts.append((
                     jnp.repeat(gids0, ke),              # global src ids
                     dest_i,
@@ -322,6 +362,11 @@ class ShardedRunner:
                 ))
                 # ---- broadcasts: replicated table, all shards agree ----
                 req = out.bcast & (~nodes.down)
+                if tc is not None and trace_spec.enabled("send"):
+                    tc = _append(trace_spec, tc, ti, KIND["send"], gids0,
+                                 jnp.full((nl,), -1, jnp.int32),
+                                 out.bcast_size,
+                                 jnp.full((nl,), -1, jnp.int32), req)
                 # gather every shard's requests (replicated result)
                 req_all = jax.lax.all_gather(req, "sp").reshape(-1)
                 pl_all = jax.lax.all_gather(out.bcast_payload,
@@ -486,30 +531,40 @@ class ShardedRunner:
             net = net.replace(
                 box_data=box_data, box_src=box_src, box_size=box_size,
                 box_count=box_count, dropped=dropped, time=t + K)
-            return snet.replace(net=net, xdropped=snet.xdropped + xdrop), \
-                pstate
+            snet = snet.replace(net=net, xdropped=snet.xdropped + xdrop)
+            if tc is not None:
+                return snet, pstate, tc
+            return snet, pstate
 
-        def wrapped(snet, pstate):
+        traced = trace_spec is not None
+
+        def wrapped(snet, pstate, tc=None):
             # shard_map blocks keep a leading length-1 shard axis; peel it
             # off for the body and restore it for the output specs.
             sq = lambda x: x.reshape(x.shape[1:])
             un = lambda x: x.reshape((1,) + x.shape)
+            if traced:
+                sn2, ps2, tc2 = one_shard(jax.tree.map(sq, snet),
+                                          jax.tree.map(sq, pstate),
+                                          jax.tree.map(sq, tc))
+                return (jax.tree.map(un, sn2), jax.tree.map(un, ps2),
+                        jax.tree.map(un, tc2))
             sn2, ps2 = one_shard(jax.tree.map(sq, snet),
                                  jax.tree.map(sq, pstate))
             return jax.tree.map(un, sn2), jax.tree.map(un, ps2)
 
         spec = P("sp")
+        specs = (spec,) * (3 if traced else 2)
         # jax >= 0.6 exposes jax.shard_map (check_vma); 0.4.x only has
         # the experimental module (check_rep).  Same semantics; the
         # check is disabled either way (the per-shard body mixes
         # replicated broadcast state with sharded node state).
         if hasattr(jax, "shard_map"):
-            return jax.shard_map(wrapped, mesh=self.mesh,
-                                 in_specs=(spec, spec),
-                                 out_specs=(spec, spec), check_vma=False)
+            return jax.shard_map(wrapped, mesh=self.mesh, in_specs=specs,
+                                 out_specs=specs, check_vma=False)
         from jax.experimental.shard_map import shard_map
-        return shard_map(wrapped, mesh=self.mesh, in_specs=(spec, spec),
-                         out_specs=(spec, spec), check_rep=False)
+        return shard_map(wrapped, mesh=self.mesh, in_specs=specs,
+                         out_specs=specs, check_rep=False)
 
     def _metric_values(self, spec, snet):
         """Global-aggregate counter values from the sharded state —
@@ -555,12 +610,20 @@ class ShardedRunner:
         return {k: v.astype(jnp.int32) for k, v in out.items()}
 
     def run_ms(self, snet, pstate, ms: int, metrics=None,
-               superstep: int = 1):
+               superstep: int = 1, trace=None):
         """Advance `ms` milliseconds.  ``metrics`` (an
         `obs.MetricsSpec`) additionally records the global-aggregate
         interval series on device and returns ``(snet, pstate,
         MetricsCarry)`` — the sharded twin of
         `obs.engine.scan_chunk_metrics`.
+
+        ``trace`` (an `obs.TraceSpec`) compiles the flight recorder
+        into the step instead (`step_fn(trace_spec=...)` — per-shard
+        event rings, deliver/send kinds) and returns ``(snet, pstate,
+        TraceCarry)`` with a leading shard axis on the carry;
+        `obs.TraceFrame.from_carry` merges the shards onto one
+        timeline.  One plane per pass (both are bit-identical on the
+        trajectory — run twice to get both).
 
         ``superstep=K`` advances in fused K-ms windows (one ICI
         exchange, one sort/scatter bin and one slot clear per window —
@@ -570,6 +633,11 @@ class ShardedRunner:
         from ..core.network import check_chunk_config
 
         ms = int(ms)
+        if metrics is not None and trace is not None:
+            raise ValueError(
+                "run_ms(metrics=..., trace=...) is one plane per pass: "
+                "run the chunk twice (both planes are bit-identical on "
+                "the trajectory)")
         check_chunk_config(self.protocol, ms, superstep=superstep)
         if superstep > 1:
             if metrics is not None and metrics.stat_each_ms % superstep:
@@ -589,12 +657,26 @@ class ShardedRunner:
         if not hasattr(self, "_jits"):
             self._jits = {}
             self._steps = {}
-        if superstep not in self._steps:
-            self._steps[superstep] = self.step_fn(superstep=superstep)
-        key = (ms, metrics, superstep)
+        if (superstep, trace) not in self._steps:
+            self._steps[(superstep, trace)] = self.step_fn(
+                superstep=superstep, trace_spec=trace)
+        key = (ms, metrics, trace, superstep)
         if key not in self._jits:
-            step = self._steps[superstep]
-            if metrics is None:
+            step = self._steps[(superstep, trace)]
+            if trace is not None:
+                from ..obs.trace import init_trace
+
+                @jax.jit
+                def run(sn, ps):
+                    tc0 = jax.vmap(lambda _: init_trace(trace))(
+                        sn.net.time)
+
+                    def body(carry, _):
+                        return step(*carry), ()
+                    (sn2, ps2, tc), _ = jax.lax.scan(
+                        body, (sn, ps, tc0), length=ms // superstep)
+                    return sn2, ps2, tc
+            elif metrics is None:
                 @jax.jit
                 def run(sn, ps):
                     def body(carry, _):

@@ -53,8 +53,8 @@ def timed():
         box["end"] = time.perf_counter()
 
 
-def run_report(net, wall_s: float | None = None, ff: dict | None = None) \
-        -> str:
+def run_report(net, wall_s: float | None = None, ff: dict | None = None,
+               trace: dict | None = None) -> str:
     """One-line run summary from the engine counters: simulated time,
     per-node message/byte traffic over live nodes (via the StatsHelper
     getters, which guard the all-down case), drop/clamp health, and
@@ -64,7 +64,14 @@ def run_report(net, wall_s: float | None = None, ff: dict | None = None) \
     (`Runner(fast_forward=True).ff_stats()`, or the stats dict
     `core/network.fast_forward_chunk` returns): when given, the report
     carries ``skipped_ms`` / ``jump_count`` / ``skip_rate`` instead of
-    silently omitting how the simulated span was covered."""
+    silently omitting how the simulated span was covered.
+
+    `trace` is the flight-recorder accounting from a traced run
+    (`Runner(trace=spec).trace_stats()`): when given, the report
+    carries the recorded-event count, the ring high-water mark against
+    capacity, and — LOUDLY — the dropped-event count, so a silently
+    truncated trace is visible in bench output instead of masquerading
+    as a complete one."""
     from . import stats
     nodes = net.nodes
     live = int(np.asarray((~np.asarray(nodes.down)).sum()))
@@ -91,6 +98,13 @@ def run_report(net, wall_s: float | None = None, ff: dict | None = None) \
         jumps = int(np.asarray(ff["jump_count"]).reshape(-1)[0])
         parts.append(f"ff skipped={skipped}ms jumps={jumps} "
                      f"skip_rate={skipped / max(1, t):.3f}")
+    if trace is not None:
+        tr = (f"trace events={int(trace['events'])} "
+              f"hw={int(trace['high_water'])}/{int(trace['capacity'])}")
+        if int(trace["dropped"]) > 0:
+            tr += (f" TRUNCATED dropped={int(trace['dropped'])} "
+                   "(raise TraceSpec.capacity)")
+        parts.append(tr)
     if wall_s is not None and wall_s > 0:
         parts.append(f"wall={wall_s:.2f}s ({t / wall_s:.0f} sim-ms/s)")
     return "Simulation execution time: " + " ".join(parts)
