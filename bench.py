@@ -27,7 +27,12 @@ WTPU_METRICS_EACH_MS / WTPU_METRICS_SEEDS size it.  WTPU_TRACE=1 adds a
 event counts + truncation accounting; schema in BENCH_NOTES.md r9);
 WTPU_TRACE_CAP sizes the ring — an over-small capacity (< 1 row per
 simulated ms) REFUSES loudly instead of emitting a silently truncated
-trace, mirroring the invalid-superstep refusal.
+trace, mirroring the invalid-superstep refusal.  Every line also
+carries an `audit` block (wittgenstein_tpu/obs/audit.py — an un-timed
+pass with the compiled conservation-law monitors ON; a violated
+verdict is loud in the block AND on stderr); WTPU_AUDIT=0 skips it.
+WTPU_LEDGER=0 skips the per-line `RunManifest` provenance row appended
+under reports/ledger/ (obs/ledger.py; schema in BENCH_NOTES.md r10).
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -197,18 +202,64 @@ def _check_trace_cap(total_ms):
 
 def _maybe_engine_trace(res, proto, total_ms, fast_forward=False):
     if os.environ.get("WTPU_TRACE") != "1":
-        return res
+        return _maybe_engine_audit(res, proto, total_ms,
+                                   fast_forward=fast_forward)
     _check_trace_cap(total_ms)
     res["trace"] = _collect_engine_trace(
         proto, total_ms, _int_env("WTPU_TRACE_CAP", 1 << 16),
         fast_forward=fast_forward)
+    return _maybe_engine_audit(res, proto, total_ms,
+                               fast_forward=fast_forward)
+
+
+def _collect_engine_audit(proto, total_ms, fast_forward=False):
+    """Un-timed invariant-audit pass for the JSON line's `audit` block
+    (wittgenstein_tpu/obs/audit.py; schema in BENCH_NOTES.md r10).
+
+    Single seed, the dense audited engine (or its fast-forward twin
+    under WTPU_FAST_FORWARD=1): runs AFTER the timed reps — the
+    measured hot path stays the uninstrumented engine (`audit_zero_cost`
+    rule) and the audited pass is bit-identical on the trajectory
+    (tests/test_audit.py), so the verdict describes the same run the
+    bench timed.  A VIOLATED verdict is loud in the block
+    (``"clean": false`` + the first-violation record) — the whole point
+    of the plane is that a benchmark number over a broken run announces
+    itself.  Never raises: a failed pass reports itself in the block."""
+    try:
+        from wittgenstein_tpu.core.network import fast_forward_ok
+        from wittgenstein_tpu.obs.audit import AuditSpec
+        from wittgenstein_tpu.obs.audit_report import (audit_block,
+                                                       audit_variant)
+
+        spec = AuditSpec()
+        variant = ({"fast_forward": True}
+                   if fast_forward and fast_forward_ok(proto) else {})
+        report, _ = audit_variant(proto, total_ms, variant, spec)
+        blk = audit_block(report, extra={"audit_seeds": 1})
+        if not report.clean:
+            print(f"bench: AUDIT VIOLATIONS in the instrumented pass:\n"
+                  f"{report.format()}", file=sys.stderr)
+        return blk
+    except Exception as e:      # noqa: BLE001 — the bench line must emit
+        print(f"bench: invariant-audit pass failed: {type(e).__name__}: "
+              f"{e!s:.300}", file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e!s:.200}"}
+
+
+def _maybe_engine_audit(res, proto, total_ms, fast_forward=False):
+    if os.environ.get("WTPU_AUDIT", "1") != "0":
+        res["audit"] = _collect_engine_audit(proto, total_ms,
+                                             fast_forward=fast_forward)
     return res
 
 
 def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
                   superstep, box_split=1):
-    """Build the benchmark's (step, init, steps, check, proto) tuple for
-    the reference default Handel scenario."""
+    """Build the benchmark's (step, init, steps, check, proto,
+    superstep, engine) tuple for the reference default Handel scenario
+    — `engine` names the dispatch actually taken ("batched" /
+    "fast_forward" / "vmapped"), recorded in the JSON line and the
+    ledger row so provenance never re-derives it."""
     import dataclasses
 
     from wittgenstein_tpu.core.network import scan_chunk
@@ -307,7 +358,9 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
                          "(core/batched.py is hard-wired to the fused "
                          "K-ms window engine)")
     ff_base = None          # stats-bearing (nets, ps) -> (nets, ps, stats)
+    engine = "fast_forward" if fast_forward else "vmapped"
     if (env_batched or "1") == "1" and superstep >= 2:
+        engine = "fast_forward" if fast_forward else "batched"
         # Seed-folded mailbox machinery (core/batched.py): avoids the
         # vmapped scatter's per-seed serialization (PROFILE_r4.md) —
         # bit-identical (tests/test_batched.py).
@@ -369,7 +422,7 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         assert evicted == 0   # queue never overflowed
         return {}
 
-    return step, init, steps, check, proto, superstep
+    return step, init, steps, check, proto, superstep, engine
 
 
 def _fixed_cost_estimate(n, seeds, chunk, mode, horizon, inbox_cap,
@@ -404,7 +457,7 @@ def _fixed_cost_estimate(n, seeds, chunk, mode, horizon, inbox_cap,
     try:
         cost_us = {}
         for ss in (1, eff_ss):
-            step, init, _, _, _, _ = _handel_setup(
+            step, init, _, _, _, _, _ = _handel_setup(
                 n, seeds, 2 * chunk, chunk, mode, horizon, inbox_cap, ss,
                 box_split=box_split)
             r = timed_chunks(step, init, 2, seeds, chunk,
@@ -441,12 +494,13 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     Returns a result dict (rate + provenance), not a bare float.
     """
     from wittgenstein_tpu.utils.measure import timed_chunks
-    step, init, steps, check, proto, eff_ss = _handel_setup(
+    step, init, steps, check, proto, eff_ss, engine = _handel_setup(
         n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
     _check_trace_cap(steps * chunk)
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res["superstep"] = eff_ss
+    res["engine"] = engine
     res.update(_fixed_cost_estimate(n, seeds, chunk, mode, horizon,
                                     inbox_cap, box_split, eff_ss))
     res.update(_ff_stats(step, steps, chunk))
@@ -473,7 +527,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     import time
     assert total_seeds % seed_batch == 0
     n_batches = total_seeds // seed_batch
-    step, init, steps, check, proto, eff_ss = _handel_setup(
+    step, init, steps, check, proto, eff_ss, engine = _handel_setup(
         n, seed_batch, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
         box_split=box_split)
     _check_trace_cap(steps * chunk)
@@ -508,6 +562,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
         "batch_wall_max_s": round(max(walls), 2),
         "crosscheck": "per_batch_materialization",
         "superstep": eff_ss,
+        "engine": engine,
     }
     # All microbatches' chunks (warmup excluded by the tail slice);
     # skip_rate is then the average across the whole seed sweep.
@@ -580,6 +635,7 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     res.update(_ff_stats(step, steps, chunk))
     res["node_count"] = proto.cfg.n
     res["superstep"] = eff_ss
+    res["engine"] = "fast_forward" if fast_forward else "vmapped"
     return _maybe_engine_metrics(res, proto, seeds, steps * chunk,
                                  fast_forward=fast_forward)
 
@@ -889,7 +945,29 @@ def main():
     }
     if os.environ.get("WTPU_BENCH_DEGRADED_FROM"):
         out["degraded_from_seeds"] = int(os.environ["WTPU_BENCH_DEGRADED_FROM"])
+    _append_ledger(out, n=n, seeds=seeds, mode=mode, chunk=chunk,
+                   proto_sel=proto_sel)
     print(json.dumps(out))
+
+
+def _append_ledger(out, **config_extra):
+    """One `RunManifest` provenance row per emitted metric line
+    (`obs.ledger.append_from_env` — the shared env-knob capture;
+    ``WTPU_LEDGER=0`` skips).  The engine label comes from the setup
+    that CHOSE the dispatch (the bench fns put it in the line), never
+    re-derived."""
+    if os.environ.get("WTPU_LEDGER", "1") == "0":
+        return
+    try:
+        from wittgenstein_tpu.obs import ledger
+        path = ledger.append_from_env(
+            out, engine=out.get("engine", "unspecified"), **config_extra)
+        if path:
+            print(f"bench: ledger row appended -> {path}",
+                  file=sys.stderr)
+    except Exception as e:      # noqa: BLE001 — provenance only
+        print(f"bench: ledger append failed: {type(e).__name__}: "
+              f"{e!s:.200}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,446 @@
+"""The invariant audit plane (wittgenstein_tpu/obs/audit.py).
+
+Invariants, per the package contract:
+
+  * audit-ON is simulation-bit-identical: the full (NetState, pstate)
+    pytree after an audited chunk equals the uninstrumented engine's —
+    dense scan (PingPong, Handel exact + cardinal, Dfinity), the
+    superstep-K window engine (K ∈ {2, 4}), the batched twin, the
+    fast-forward while loop (whose skip stats must also match), and
+    the sharded runner (including the cross-shard conservation check);
+  * clean runs audit CLEAN: zero violations across every monitored
+    invariant for every covered protocol and engine variant;
+  * a planted `FaultInjector` perturbation is FLAGGED, in the same
+    window that `first_divergence()` localizes — the audit plane and
+    the bisector must agree on where the run broke (the acceptance
+    pin, for Handel exact and PingPong);
+  * the audit totals cross-check against the metrics plane
+    (`cross_check_metrics`), and the `audit_zero_cost` analysis rule
+    catches silently-dead monitors.
+
+Protocol configs mirror tests/test_trace.py / test_obs.py so the
+compiles share the suite's persistent-cache entries where possible.
+"""
+
+import dataclasses
+import importlib.util
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.batched import scan_chunk_batched
+from wittgenstein_tpu.core.network import (Runner, fast_forward_chunk,
+                                           scan_chunk)
+from wittgenstein_tpu.obs import (AuditReport, AuditSpec, audit_block,
+                                  audit_variant, cross_check_metrics,
+                                  fast_forward_chunk_audit,
+                                  scan_chunk_audit,
+                                  scan_chunk_batched_audit)
+from wittgenstein_tpu.obs.diff import FaultInjector, first_divergence
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _protocols():
+    from wittgenstein_tpu.models.dfinity import Dfinity
+    from wittgenstein_tpu.models.handel import Handel
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    return {
+        "Handel": lambda: Handel(
+            node_count=64, threshold=56, nodes_down=6, pairing_time=4,
+            dissemination_period_ms=20, level_wait_time=50, fast_path=10),
+        "HandelCardinal": lambda: Handel(
+            node_count=64, threshold=56, nodes_down=6, pairing_time=4,
+            dissemination_period_ms=20, fast_path=10, mode="cardinal"),
+        "Dfinity": lambda: Dfinity(block_producers_count=10,
+                                   attesters_count=10,
+                                   attesters_per_round=10),
+        "PingPong": lambda: PingPong(node_count=64),
+    }
+
+
+def _floor_handel():
+    """test_superstep.py's floor-rich Handel: fixed 16 ms latency
+    licenses the K ∈ {2, 4} window ladder."""
+    from wittgenstein_tpu.models.handel import Handel
+    return Handel(node_count=64, threshold=56, nodes_down=6,
+                  pairing_time=4, dissemination_period_ms=20,
+                  level_wait_time=50, fast_path=10, horizon=64,
+                  network_latency_name="NetworkFixedLatency(16)")
+
+
+# ------------------------------------------------------------------ ON
+
+
+# Tier-1 keeps the two broadcast-bearing dense cells (PingPong exercises
+# send/deliver + bc_consistency cheaply, Dfinity the committee-paced
+# broadcast table); the Handel exact + cardinal dense cells live in the
+# slow deep-matrix battery — Handel exact is ALSO gated fast through the
+# batched twin and the superstep ladder below (reports/TIER1_DURATIONS.md).
+@pytest.mark.parametrize("name", ["PingPong", "Dfinity"])
+def test_audit_on_bit_identical_dense_and_clean(name):
+    proto = _protocols()[name]()
+    ms, seeds = 120, 2
+    spec = AuditSpec()
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(jax.vmap(scan_chunk(proto, ms)))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, ac = jax.jit(jax.vmap(scan_chunk_audit(proto, ms, spec)))(
+        nets, ps)
+    _trees_equal(ref, (net2, ps2))
+    report = AuditReport.from_carry(spec, ac)
+    assert report.clean, report.format()
+    # the totals actually sampled the run (not a dead plane)
+    assert report.totals_dict()["msg_sent"] > 0
+    # a verdict built WITH the engine config claims only the compiled
+    # subset (dense run: never shard_conservation)
+    from wittgenstein_tpu.obs.audit import monitored_invariants
+    mon = monitored_invariants(spec, proto.cfg)
+    assert "shard_conservation" not in mon
+
+
+def test_audit_superstep_windows_bit_identical_and_clean():
+    proto = _floor_handel()
+    spec = AuditSpec()
+    net, ps = proto.init(0)
+    ref = jax.jit(scan_chunk_audit(proto, 40, spec))(net, ps)
+    assert AuditReport.from_carry(spec, ref[2]).clean
+    for k in (2, 4):
+        net, ps = proto.init(0)
+        got = jax.jit(scan_chunk_audit(proto, 40, spec, superstep=k))(
+            net, ps)
+        # same trajectory AND the same per-window verdicts: the K-ms
+        # conservation balance is exact per origin ms, so the fused
+        # window proves exactly what the per-ms windows prove
+        _trees_equal(ref[:2], got[:2])
+        report = AuditReport.from_carry(spec, got[2])
+        assert report.clean, (k, report.format())
+
+
+def test_audit_batched_engine_bit_identical_and_clean():
+    proto = _protocols()["Handel"]()
+    ms, seeds = 80, 2
+    spec = AuditSpec()
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(scan_chunk_batched(proto, ms))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, ac = jax.jit(scan_chunk_batched_audit(proto, ms, spec))(
+        nets, ps)
+    _trees_equal(ref, (net2, ps2))
+    assert AuditReport.from_carry(spec, ac).clean
+
+
+def test_audit_fast_forward_bit_identical_and_clean():
+    proto = _protocols()["PingPong"]()
+    ms, seeds = 240, 2
+    spec = AuditSpec()
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(fast_forward_chunk(proto, ms, seed_axis=True))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    net2, ps2, stats, ac = jax.jit(
+        fast_forward_chunk_audit(proto, ms, spec, seed_axis=True))(
+        nets, ps)
+    _trees_equal(ref[:2], (net2, ps2))
+    assert int(np.asarray(stats["skipped_ms"])) == \
+        int(np.asarray(ref[2]["skipped_ms"])) > 0
+    report = AuditReport.from_carry(spec, ac)
+    assert report.clean, report.format()
+
+
+def test_audit_sharded_runner_and_cross_shard_conservation():
+    from jax.sharding import Mesh
+    from wittgenstein_tpu.parallel.sharded import RingForward, ShardedRunner
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    proto = RingForward(n=64, stride=9, latency=10)
+    runner = ShardedRunner(proto, mesh)
+    spec = AuditSpec()
+    snet, ps, ac = runner.run_ms(*runner.init(3), 24, audit=spec)
+    # the audited run didn't perturb the simulation
+    snet2, ps2 = runner.run_ms(*runner.init(3), 24)
+    _trees_equal((snet, ps), (snet2, ps2))
+    from wittgenstein_tpu.obs.audit import monitored_invariants
+    report = AuditReport.from_carry(       # per-shard carries merged
+        spec, ac,
+        monitored=monitored_invariants(spec, proto.cfg, sharded=True))
+    assert report.clean, report.format()
+    assert "shard_conservation" in report.claimed
+    assert "spill_budget" not in report.claimed
+    # the cross-shard conservation monitor watched REAL traffic (the
+    # ring protocol routes every send stride=9 nodes away, crossing
+    # shard boundaries) and the batch-merged totals are global
+    nodes = runner.gather_nodes(snet)
+    assert report.totals_dict()["msg_received"] == \
+        int(nodes.msg_received.sum()) > 0
+    # one plane per pass
+    from wittgenstein_tpu.obs import MetricsSpec
+    with pytest.raises(ValueError, match="one plane per"):
+        runner.run_ms(snet, ps, 24, metrics=MetricsSpec(), audit=spec)
+
+
+@pytest.mark.slow
+def test_audit_deep_matrix_bit_identical_and_clean():
+    """The wide acceptance matrix (each cell a fresh compile, so
+    slow-marked; the fast battery above already gates every contract
+    once): the Handel exact + cardinal dense cells, ff Dfinity +
+    Handel, superstep K=2 on the self-sending protocols, cardinal
+    batched."""
+    protos = _protocols()
+    spec = AuditSpec()
+    sd = jnp.arange(2, dtype=jnp.int32)
+    # dense cells not in the fast battery
+    for name in ("Handel", "HandelCardinal"):
+        proto = protos[name]()
+        nets, ps = jax.vmap(proto.init)(sd)
+        ref = jax.jit(jax.vmap(scan_chunk(proto, 120)))(nets, ps)
+        nets, ps = jax.vmap(proto.init)(sd)
+        n2, p2, ac = jax.jit(jax.vmap(scan_chunk_audit(proto, 120,
+                                                       spec)))(nets, ps)
+        _trees_equal(ref, (n2, p2))
+        assert AuditReport.from_carry(spec, ac).clean, name
+    # fast-forward: the other two opted-in protocols
+    for name in ("Dfinity", "Handel"):
+        proto = protos[name]()
+        nets, ps = jax.vmap(proto.init)(sd)
+        ref = jax.jit(fast_forward_chunk(proto, 120, seed_axis=True))(
+            nets, ps)
+        nets, ps = jax.vmap(proto.init)(sd)
+        n2, p2, stats, ac = jax.jit(fast_forward_chunk_audit(
+            proto, 120, spec, seed_axis=True))(nets, ps)
+        _trees_equal(ref[:2], (n2, p2))
+        assert AuditReport.from_carry(spec, ac).clean, name
+    # the universal K=2 window on the self-senders
+    for name in ("PingPong", "Dfinity"):
+        proto = protos[name]()
+        net, ps = proto.init(0)
+        ref = jax.jit(scan_chunk_audit(proto, 40, spec))(net, ps)
+        net, ps = proto.init(0)
+        got = jax.jit(scan_chunk_audit(proto, 40, spec, superstep=2))(
+            net, ps)
+        _trees_equal(ref[:2], got[:2])
+        assert AuditReport.from_carry(spec, got[2]).clean, name
+    # cardinal mode through the batched twin
+    proto = protos["HandelCardinal"]()
+    nets, ps = jax.vmap(proto.init)(sd)
+    ref = jax.jit(scan_chunk_batched(proto, 80))(nets, ps)
+    nets, ps = jax.vmap(proto.init)(sd)
+    n2, p2, ac = jax.jit(scan_chunk_batched_audit(proto, 80, spec))(
+        nets, ps)
+    _trees_equal(ref, (n2, p2))
+    assert AuditReport.from_carry(spec, ac).clean
+
+
+# --------------------------------------------------- audit x triage
+
+
+def _assert_injection_flagged(proto, at_ms, total_ms, chunk_ms):
+    """The acceptance pin: a one-(ms, node, leaf) perturbation trips a
+    conservation monitor in ITS OWN window, and the audit verdict
+    agrees with `first_divergence()`'s localization."""
+    bad = FaultInjector(proto, at_ms=at_ms, leaf="nodes.msg_sent",
+                        node=5, delta=-(1 << 20))
+    report, _ = audit_variant(bad, total_ms, {"superstep": 1},
+                              AuditSpec(mode="first"))
+    assert not report.clean
+    assert report.first["invariant"] == "counter_monotone"
+    assert report.first["ms"] == at_ms          # granularity-1 windows
+    div = first_divergence(proto, {"superstep": 1}, {"superstep": 1},
+                           total_ms, chunk_ms=chunk_ms, protocol_b=bad,
+                           trace_spec=False)
+    assert div is not None and div.ms == report.first["ms"]
+    assert "msg_sent" in div.leaf
+    # the report is loud about it
+    assert "AUDIT" in report.format()
+    assert "counter_monotone" in report.format()
+
+
+def test_audit_flags_injected_fault_pingpong_and_agrees_with_bisector():
+    from wittgenstein_tpu.models.pingpong import PingPong
+    _assert_injection_flagged(PingPong(node_count=32), at_ms=37,
+                              total_ms=64, chunk_ms=32)
+
+
+def test_audit_flags_injected_fault_handel_and_agrees_with_bisector():
+    _assert_injection_flagged(_protocols()["Handel"](), at_ms=21,
+                              total_ms=40, chunk_ms=20)
+
+
+def test_audit_mode_count_has_no_first_record():
+    from wittgenstein_tpu.models.pingpong import PingPong
+    bad = FaultInjector(PingPong(node_count=32), at_ms=37,
+                        leaf="nodes.msg_sent", node=5, delta=-(1 << 20))
+    report, _ = audit_variant(bad, 64, {"superstep": 1},
+                              AuditSpec(mode="count"))
+    assert not report.clean and report.first is None
+    assert report.violations()["counter_monotone"] >= 1
+    assert "mode='first'" in report.format()    # points at the remedy
+
+
+# ------------------------------------------------------------ drivers
+
+
+def test_runner_audit_and_report():
+    proto = _protocols()["PingPong"]()
+    spec = AuditSpec()
+    r0 = Runner(proto)
+    net, ps = proto.init(0)
+    ref = r0.run_ms(net, ps, 200)
+
+    r1 = Runner(proto, audit=spec)
+    net, ps = proto.init(0)
+    out = r1.run_ms(net, ps, 100)
+    out = r1.run_ms(*out, 100)                  # chunked: carries stitch
+    _trees_equal(ref, out)
+    report = r1.audit_report()
+    assert report.clean, report.format()
+    rep = r1.run_report(out[0], wall_s=0.25)
+    assert "audit clean" in rep and "AUDIT VIOLATIONS" not in rep
+    # one plane per pass
+    from wittgenstein_tpu.obs import MetricsSpec
+    with pytest.raises(ValueError, match="run the chunk twice"):
+        Runner(proto, metrics=MetricsSpec(), audit=spec)
+
+    # a violated run SHOUTS in the report
+    bad = FaultInjector(proto, at_ms=37, leaf="nodes.msg_sent", node=5,
+                        delta=-(1 << 20))
+    r2 = Runner(bad, audit=spec)
+    net, ps = bad.init(0)
+    out2 = r2.run_ms(net, ps, 100)
+    assert "AUDIT VIOLATIONS" in r2.run_report(out2[0])
+
+
+def test_audit_metrics_cross_check():
+    from wittgenstein_tpu.obs import MetricsFrame, MetricsSpec
+    from wittgenstein_tpu.obs.engine import scan_chunk_metrics
+
+    proto = _protocols()["PingPong"]()
+    ms, seeds = 120, 2
+    sd = jnp.arange(seeds, dtype=jnp.int32)
+    mspec = MetricsSpec(stat_each_ms=10)
+    nets, ps = jax.vmap(proto.init)(sd)
+    _, _, mc = jax.jit(jax.vmap(scan_chunk_metrics(proto, ms, mspec)))(
+        nets, ps)
+    frame = MetricsFrame.from_carry(mspec, mc)
+
+    report, _ = audit_variant(proto, ms, {"superstep": 1}, AuditSpec(),
+                              seeds=seeds)
+    assert cross_check_metrics(report, frame) == []
+    # and the cross-check actually compares something: corrupt one
+    # audit total and it must scream
+    broken = dataclasses.replace(report, totals=report.totals + 1)
+    assert len(cross_check_metrics(broken, frame)) == len(
+        [c for c in ("msg_sent", "msg_received", "drop_count",
+                     "done_count") if mspec.col(c) is not None])
+
+
+def test_audit_spec_validation_and_block():
+    with pytest.raises(ValueError, match="mode"):
+        AuditSpec(mode="loud")
+    with pytest.raises(ValueError, match="unknown invariants"):
+        AuditSpec(invariants=("ring_conservation", "nope"))
+    with pytest.raises(ValueError, match="spill_budget"):
+        AuditSpec(spill_budget=-1)
+    # canonical ordering regardless of the order passed
+    spec = AuditSpec(invariants=("counter_monotone", "ring_conservation"))
+    assert spec.invariants == ("ring_conservation", "counter_monotone")
+    assert spec.enabled("ring_conservation")
+    assert not spec.enabled("bc_consistency")
+
+
+def test_ledger_round_trip(tmp_path):
+    from wittgenstein_tpu.obs import ledger
+
+    path = tmp_path / "ledger.jsonl"
+    line = {"metric": "m", "value": 12.5, "unit": "sim_ms/s",
+            "sim_ms": 1000, "superstep": 2, "batch": 4,
+            "audit": {"clean": True, "total": 0},
+            "engine_metrics": {"totals": {"msg_sent": 7}}}
+    mani = ledger.manifest_from_bench(line, config={"n": 64, "k": 2})
+    assert mani.audit_clean is True
+    assert mani.metrics_digest and mani.audit_digest
+    assert mani.config_digest == ledger.digest({"n": 64, "k": 2})
+    assert ledger.append(mani, path) == str(path)
+    ledger.append(mani, path)                   # append-only: 2 rows
+    rows = ledger.read_all(path)
+    assert len(rows) == 2
+    assert dataclasses.asdict(rows[0]) == dataclasses.asdict(mani)
+    # a torn tail is skipped, not fatal
+    with open(path, "a") as f:
+        f.write("{not json\n")
+    assert len(ledger.read_all(path)) == 2
+
+
+# ------------------------------------------------------------- tools
+
+
+def _cli():
+    """Load tools/audit.py (tools/ is not a package)."""
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    sys.path.insert(0, str(tools))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "audit_cli", tools / "audit.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(str(tools))
+    return mod
+
+
+def test_audit_cli_clean_and_violated(monkeypatch, capsys):
+    monkeypatch.setenv("WTPU_LEDGER", "0")
+    cli = _cli()
+    rc = cli.main(["--proto", "pingpong", "--nodes", "32",
+                   "--ms", "64"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "CLEAN" in out
+    rc = cli.main(["--proto", "pingpong", "--nodes", "32", "--ms", "64",
+                   "--inject", "37:nodes.msg_sent:5:-1048576"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "counter_monotone" in out and "ms 37" in out
+    # config errors are exit code 2
+    assert cli.main(["--proto", "nope"]) == 2
+    assert cli.main(["--proto", "pingpong", "--inject", "bad"]) == 2
+
+
+# ------------------------------------------------------------- rules
+
+
+def test_audit_zero_cost_rule_catches_dead_instrumentation():
+    from wittgenstein_tpu.analysis.rules_audit import AuditZeroCostRule
+    from wittgenstein_tpu.analysis.targets import AnalysisTarget
+
+    def plain_chunk(x, y):
+        def body(c, _):
+            return (c[0] + 1, c[1] * 2), ()
+        c, _ = jax.lax.scan(body, (x, y), length=3)
+        return c
+
+    rule = AuditZeroCostRule()
+    args = (jnp.zeros((4,), jnp.int32), jnp.ones((4,), jnp.float32))
+    clean = AnalysisTarget.from_fn("fake", plain_chunk, args)
+    fs = rule.run(clean, {})
+    vals = {f.metric: f.value for f in fs if f.metric}
+    assert vals["carry_extra_leaves"] == 0
+    assert not [f for f in fs if f.severity == "error"]
+
+    # an uninstrumented build labeled as an audit target = silently-
+    # dead monitors, which must be an error
+    dead = AnalysisTarget.from_fn("fake+audit", plain_chunk, args)
+    errs = [f for f in rule.run(dead, {}) if f.severity == "error"]
+    assert errs and "silently dead" in errs[0].message

@@ -91,6 +91,38 @@ def test_plane_barrier_bit_identity(plane_barrier):
     _trees_equal(a, b)
 
 
+@pytest.mark.parametrize("plane_barrier", [True, False])
+def test_step_2ms_batched_direct_iterations(plane_barrier):
+    """ADVICE r5 item 1, at the exact granularity it asked for: a few
+    DIRECT `step_2ms_batched` iterations (no scan wrapper) compared
+    against the vmapped `step_kms(K=2)` reference, full-pytree equality
+    asserted after EVERY iteration, with the barrier on and off."""
+    from wittgenstein_tpu.core.batched import step_2ms_batched
+    from wittgenstein_tpu.core.network import step_kms
+
+    proto = Handel(node_count=64, threshold=56, nodes_down=6,
+                   pairing_time=4, dissemination_period_ms=20,
+                   level_wait_time=50, fast_path=10)
+
+    @jax.jit
+    def adv_batched(nets, ps):
+        return step_2ms_batched(proto, nets, ps,
+                                plane_barrier=plane_barrier)
+
+    @jax.jit
+    def adv_ref(nets, ps):
+        return jax.vmap(lambda n_, p_: step_kms(proto, n_, p_, 2))(
+            nets, ps)
+
+    sd = jnp.arange(3, dtype=jnp.int32)
+    nets_b, ps_b = jax.vmap(proto.init)(sd)
+    nets_r, ps_r = jax.vmap(proto.init)(sd)
+    for _ in range(4):
+        nets_b, ps_b = adv_batched(nets_b, ps_b)
+        nets_r, ps_r = adv_ref(nets_r, ps_r)
+        _trees_equal((nets_r, ps_r), (nets_b, ps_b))
+
+
 def test_batched_rejects_broadcast_protocols():
     from wittgenstein_tpu.models.pingpong import PingPong
     with pytest.raises(ValueError, match="broadcast-free"):

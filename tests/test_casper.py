@@ -152,6 +152,11 @@ def test_fork_choice_unit():
     assert best(pv, b1, a2) == max(a2, b1)
 
 
+@pytest.mark.slow   # tier-1 budget (reports/TIER1_DURATIONS.md, PR-6
+# round): 23 s warm — same-seed repeat of the 4000-ms Casper run whose
+# semantics test_chain_growth_and_consensus already gates fast; the
+# determinism CONTRACT keeps its fast gates via the Handel, GSF and
+# PingPong determinism runs (the avalanche-determinism precedent).
 def test_determinism():
     p = make(random_on_ties=False)
     r = Runner(p, donate=False)

@@ -70,6 +70,12 @@ def test_progress_per_time_series():
     assert ts.times[0] == 10 and ts.times[-1] <= 300
 
 
+@pytest.mark.slow   # tier-1 budget (reports/TIER1_DURATIONS.md, PR-6
+# round): 22 s warm — the explicit-devices DP equality pair.  The
+# data-parallel seed-axis layout keeps fast gates through the
+# run_multiple_times tests (auto device split over the virtual 8-dev
+# mesh) and the node-axis sharding equality battery in test_sharded.py;
+# the full 2-D mesh equality pair was already slow-marked (PR 4 round).
 def test_seed_axis_sharded_over_devices_matches_single_device():
     """VERDICT r1 #6: R=8 seeds across the 8-device virtual mesh must be
     bit-equal to the single-device vmap (the multi-device analog of

@@ -36,18 +36,24 @@ def main():
     # BATCHED path: an ambient WTPU_BENCH_BATCHED=0 would silently
     # compile the vmapped engine twice (which ignores plane_barrier)
     # and report a meaningless A/B of two identical programs
-    # (ADVICE.md r5 item 3).  Force the batched path for both builds.
+    # (ADVICE.md r5 item 3).  Force the batched path for both builds —
+    # and force the quiet-window engine OFF: an ambient
+    # WTPU_FAST_FORWARD=1 would swap in the while-loop engine, whose
+    # wall time is skip-rate-dominated, mislabeling the barrier A/B.
     os.environ["WTPU_BENCH_BATCHED"] = "1"
+    os.environ["WTPU_FAST_FORWARD"] = "0"
 
     import bench
+    assert os.environ.get("WTPU_BENCH_BATCHED") != "0", \
+        "WTPU_BENCH_BATCHED must not be 0 for the barrier A/B"
 
     def build(barrier: bool):
         os.environ["WTPU_PLANE_BARRIER"] = "1" if barrier else "0"
         return bench._handel_setup(n, seeds, sim_ms, chunk, "exact",
                                    256, 12, superstep=2)
 
-    step_on, init, steps, check, _, _ = build(True)
-    step_off, _, _, _, _, _ = build(False)
+    step_on, init, steps, check, _, _, _ = build(True)
+    step_off, _, _, _, _, _, _ = build(False)
     os.environ.pop("WTPU_PLANE_BARRIER", None)
 
     # Prove the knob reached the compiler: the on/off builds must be

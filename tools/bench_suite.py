@@ -207,18 +207,86 @@ def bench_trace_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_audit_smoke():
+    """Invariant-audit smoke stage (PR 6): a tiny PingPong run with the
+    compiled conservation-law monitors ON, zero violations asserted,
+    and one `RunManifest` ledger row round-tripped — the whole audit
+    path (tap -> AuditCarry -> AuditReport -> ledger) exercised end to
+    end in seconds, so a monitor or ledger regression surfaces in the
+    suite instead of during an incident."""
+    import dataclasses
+    import os
+    import tempfile
+
+    from wittgenstein_tpu.models.pingpong import PingPong
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.obs.audit import AuditSpec, monitored_invariants
+    from wittgenstein_tpu.obs.audit_report import audit_block, audit_variant
+
+    proto = PingPong(node_count=64)
+    spec = AuditSpec(mode="first")
+    report, _ = audit_variant(proto, 120, {"superstep": 1}, spec)
+    assert report.clean, report.format()
+    blk = audit_block(report)
+    assert blk["clean"] and blk["total"] == 0, blk
+    # the verdict claims exactly the invariants this build compiled
+    assert set(blk["violations"]) == \
+        set(monitored_invariants(spec, proto.cfg))
+    json.dumps(blk)                         # one-line-JSON embeddable
+
+    # ledger round trip against an ISOLATED file: the shared
+    # reports/ledger/ledger.jsonl is append-only and written by any
+    # concurrent bench process, so a rows[-1] equality there would
+    # race (and slow down with accumulated history); the real ledger
+    # still gets this stage's row via the suite's _append_ledger
+    mani = ledger.manifest_from_bench(
+        {"metric": "audit_smoke", "sim_ms": 120, "superstep": 1,
+         "audit": blk},
+        config={"proto": "pingpong", "nodes": 64, "ms": 120,
+                "stage": "audit_smoke", "engine": "vmapped"},
+        label="audit_smoke")
+    fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        assert ledger.append(mani, tmp) == tmp, "ledger append failed"
+        rows = ledger.read_all(tmp)
+        assert len(rows) == 1, rows
+        assert dataclasses.asdict(rows[0]) == dataclasses.asdict(mani), \
+            "ledger round-trip mismatch"
+    finally:
+        os.unlink(tmp)
+    return {"metric": "audit_smoke_violations", "value": report.total,
+            "unit": "violations", "audit": blk,
+            "ledger_round_trip": "ok",
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
     "sanfermin_32768n": bench_sanfermin,
     "dfinity_10k_validators": bench_dfinity,
     "trace_smoke": bench_trace_smoke,
+    "audit_smoke": bench_audit_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
 # emit the SAME metric name as the success path, or a consumer keying
 # on it never sees the failure line.
-METRIC_NAMES = {"trace_smoke": "trace_smoke_events"}
+METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
+                "audit_smoke": "audit_smoke_violations"}
+
+
+def _append_ledger(name, res):
+    """One provenance row per emitted suite line
+    (`obs.ledger.append_from_env` — the shared env-knob capture;
+    ``WTPU_LEDGER=0`` skips).  Never raises into the suite loop."""
+    import os
+    if os.environ.get("WTPU_LEDGER", "1") == "0":
+        return
+    from wittgenstein_tpu.obs import ledger
+    ledger.append_from_env(res, label=name, stage=name,
+                           engine="vmapped")   # run_config's scan_chunk
 
 
 def main():
@@ -232,6 +300,7 @@ def main():
         except Exception as e:                  # noqa: BLE001 — per-config
             res = {"metric": metric,
                    "error": f"{type(e).__name__}: {e!s:.300}"}
+        _append_ledger(name, res)
         print(json.dumps(res), flush=True)
 
 

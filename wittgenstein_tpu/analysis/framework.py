@@ -67,9 +67,9 @@ def register_rule(cls):
 
 def _install_rules():
     """Import the rule modules for their registration side effect."""
-    from . import (rules_carry, rules_determinism, rules_dtype,  # noqa: F401
-                   rules_hostsync, rules_metrics, rules_superstep,
-                   rules_trace, rules_vmem)
+    from . import (rules_audit, rules_carry, rules_determinism,  # noqa: F401
+                   rules_dtype, rules_hostsync, rules_metrics,
+                   rules_superstep, rules_trace, rules_vmem)
 
 
 def load_budgets(path=BUDGETS_PATH) -> dict:

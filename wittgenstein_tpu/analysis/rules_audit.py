@@ -1,0 +1,48 @@
+"""Rule ``audit_zero_cost`` — the invariant audit plane may never
+silently tax an unaudited build, and may never silently die.
+
+Sibling of `trace_zero_cost` (rules_trace.py) and `metrics_zero_cost`
+(rules_metrics.py), for the AUDIT plane (wittgenstein_tpu/obs/audit.py).
+The contract is two-sided:
+
+  * audit-OFF builds carry ZERO monitor residue.  The engine's `tap`
+    hook defaults to None — a plain Python branch, so the
+    uninstrumented program is the historical one BY CONSTRUCTION; this
+    rule makes that structural claim an enforced ratchet: the chunk's
+    outermost scan/while carry width over the state leaf count
+    (`carry_extra_leaves`) is measured on every pre-existing target and
+    budgeted at its known instrumentation, so a tap accidentally left
+    threaded into a production builder fails the gate with the measured
+    width;
+  * an ``+audit`` target whose loop carry does NOT widen by the
+    `AuditCarry` leaves (counts + first + prev_done + prev_counters +
+    totals = 5) has silently-dead monitors — an error, not a budget.
+"""
+
+from __future__ import annotations
+
+from .framework import Rule, register_rule
+from .rules_metrics import zero_cost_findings
+
+#: AuditCarry contributes this many pytree leaves (counts, first,
+#: prev_done, prev_counters, totals).
+_AUDIT_CARRY_LEAVES = 5
+
+#: analysis target-name suffix of the audited builds
+AUDIT_SUFFIX = "+audit"
+
+
+@register_rule
+class AuditZeroCostRule(Rule):
+    name = "audit_zero_cost"
+    scope = "protocol"
+    budgeted_metrics = ("carry_extra_leaves", "jaxpr_eqns")
+
+    def run(self, target, budget):
+        return zero_cost_findings(
+            self.name, target, AUDIT_SUFFIX, _AUDIT_CARRY_LEAVES,
+            lambda extra: (
+                f"audited target carries only {extra} extra loop "
+                f"vars (< {_AUDIT_CARRY_LEAVES}: the AuditCarry "
+                "leaves) — the invariant monitors are silently "
+                "dead in this build"))

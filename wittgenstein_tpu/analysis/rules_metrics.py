@@ -51,6 +51,46 @@ def _count_eqns(jaxpr) -> int:
     return sum(len(j.eqns) for j in _iter_jaxprs(jaxpr))
 
 
+def zero_cost_findings(rule_name, target, suffixes, plane_leaves,
+                       dead_message) -> list:
+    """The shared body of the three plane zero-cost rules (metrics /
+    trace / audit): measure the chunk's outermost scan/while carry
+    width over the state leaf count + the jaxpr equation count, and
+    error when a target carrying one of `suffixes` does NOT widen by
+    its plane's `plane_leaves` — ONE implementation, so the three
+    planes' residue contracts can never drift apart.
+    `dead_message(extra)` renders the plane-specific error text."""
+    import jax
+
+    n_state = len(jax.tree.leaves(target.args))
+    loops = _loop_carry_widths(target.jaxpr.jaxpr)
+    if not loops:
+        return [Finding(
+            rule=rule_name, target=target.name, severity="warning",
+            message="no top-level scan/while loop in the traced "
+                    "chunk — carry-residue check has nothing to "
+                    "measure")]
+    # The chunk loop: the widest top-level loop (phase-specialized
+    # builds can emit a narrower tail scan after the block scan).
+    prim, carry = max(loops, key=lambda pc: pc[1])
+    extra = carry - n_state
+    findings = [
+        Finding(rule=rule_name, target=target.name, severity="info",
+                metric="carry_extra_leaves", value=extra,
+                message=f"{prim} carry holds {carry} vars for "
+                        f"{n_state} state leaves "
+                        f"(carry_extra_leaves={extra})"),
+        Finding(rule=rule_name, target=target.name, severity="info",
+                metric="jaxpr_eqns", value=_count_eqns(target.jaxpr.jaxpr),
+                message="total jaxpr equations in the compiled chunk"),
+    ]
+    if target.name.endswith(suffixes) and extra < plane_leaves:
+        findings.append(Finding(
+            rule=rule_name, target=target.name, severity="error",
+            message=dead_message(extra)))
+    return findings
+
+
 @register_rule
 class MetricsZeroCostRule(Rule):
     name = "metrics_zero_cost"
@@ -58,36 +98,11 @@ class MetricsZeroCostRule(Rule):
     budgeted_metrics = ("carry_extra_leaves", "jaxpr_eqns")
 
     def run(self, target, budget):
-        import jax
-
-        n_state = len(jax.tree.leaves(target.args))
-        loops = _loop_carry_widths(target.jaxpr.jaxpr)
-        if not loops:
-            return [Finding(
-                rule=self.name, target=target.name, severity="warning",
-                message="no top-level scan/while loop in the traced "
-                        "chunk — carry-residue check has nothing to "
-                        "measure")]
-        # The chunk loop: the widest top-level loop (phase-specialized
-        # builds can emit a narrower tail scan after the block scan).
-        prim, carry = max(loops, key=lambda pc: pc[1])
-        extra = carry - n_state
-        instrumented = target.name.endswith(INSTRUMENTED_SUFFIXES)
-        findings = [
-            Finding(rule=self.name, target=target.name, severity="info",
-                    metric="carry_extra_leaves", value=extra,
-                    message=f"{prim} carry holds {carry} vars for "
-                            f"{n_state} state leaves "
-                            f"(carry_extra_leaves={extra})"),
-            Finding(rule=self.name, target=target.name, severity="info",
-                    metric="jaxpr_eqns", value=_count_eqns(target.jaxpr.jaxpr),
-                    message="total jaxpr equations in the compiled chunk"),
-        ]
-        if instrumented and extra < _METRICS_CARRY_LEAVES:
-            findings.append(Finding(
-                rule=self.name, target=target.name, severity="error",
-                message=f"instrumented target carries only {extra} extra "
-                        f"loop vars (< {_METRICS_CARRY_LEAVES}: the "
-                        "MetricsCarry leaves) — the metrics plane is "
-                        "silently dead in this build"))
-        return findings
+        return zero_cost_findings(
+            self.name, target, INSTRUMENTED_SUFFIXES,
+            _METRICS_CARRY_LEAVES,
+            lambda extra: (
+                f"instrumented target carries only {extra} extra "
+                f"loop vars (< {_METRICS_CARRY_LEAVES}: the "
+                "MetricsCarry leaves) — the metrics plane is "
+                "silently dead in this build"))

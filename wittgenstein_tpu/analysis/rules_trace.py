@@ -22,8 +22,8 @@ Sibling of `metrics_zero_cost` (rules_metrics.py), for the EVENT plane
 
 from __future__ import annotations
 
-from .framework import Finding, Rule, register_rule
-from .rules_metrics import _count_eqns, _loop_carry_widths
+from .framework import Rule, register_rule
+from .rules_metrics import zero_cost_findings
 
 #: TraceCarry contributes this many pytree leaves (buf, cursor, dropped).
 _TRACE_CARRY_LEAVES = 3
@@ -39,36 +39,10 @@ class TraceZeroCostRule(Rule):
     budgeted_metrics = ("carry_extra_leaves", "jaxpr_eqns")
 
     def run(self, target, budget):
-        import jax
-
-        n_state = len(jax.tree.leaves(target.args))
-        loops = _loop_carry_widths(target.jaxpr.jaxpr)
-        if not loops:
-            return [Finding(
-                rule=self.name, target=target.name, severity="warning",
-                message="no top-level scan/while loop in the traced "
-                        "chunk — carry-residue check has nothing to "
-                        "measure")]
-        prim, carry = max(loops, key=lambda pc: pc[1])
-        extra = carry - n_state
-        findings = [
-            Finding(rule=self.name, target=target.name, severity="info",
-                    metric="carry_extra_leaves", value=extra,
-                    message=f"{prim} carry holds {carry} vars for "
-                            f"{n_state} state leaves "
-                            f"(carry_extra_leaves={extra})"),
-            Finding(rule=self.name, target=target.name, severity="info",
-                    metric="jaxpr_eqns",
-                    value=_count_eqns(target.jaxpr.jaxpr),
-                    message="total jaxpr equations in the compiled "
-                            "chunk"),
-        ]
-        if (target.name.endswith(TRACE_SUFFIX)
-                and extra < _TRACE_CARRY_LEAVES):
-            findings.append(Finding(
-                rule=self.name, target=target.name, severity="error",
-                message=f"traced target carries only {extra} extra loop "
-                        f"vars (< {_TRACE_CARRY_LEAVES}: the TraceCarry "
-                        "leaves) — the flight recorder is silently dead "
-                        "in this build"))
-        return findings
+        return zero_cost_findings(
+            self.name, target, TRACE_SUFFIX, _TRACE_CARRY_LEAVES,
+            lambda extra: (
+                f"traced target carries only {extra} extra loop "
+                f"vars (< {_TRACE_CARRY_LEAVES}: the TraceCarry "
+                "leaves) — the flight recorder is silently dead "
+                "in this build"))

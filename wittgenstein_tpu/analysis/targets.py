@@ -308,6 +308,39 @@ def _trace_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: Protocols whose invariant-audit builds (obs/audit.py) are audited
+#: alongside the uninstrumented engines under "<name>+audit": the
+#: audited chunk is a different compiled program — its host-sync
+#: profile, carry copies and carry width are gated separately, and the
+#: `audit_zero_cost` rule asserts the monitors are actually LIVE there
+#: (carry widens by the AuditCarry leaves) while every OTHER target's
+#: carry width proves audit-OFF zero residue.  One broadcast protocol
+#: (PingPong — exercises the bc_consistency monitor) and the flagship
+#: (Handel — ring conservation under real traffic).
+AUDIT_PROTOCOLS = ("PingPong", "Handel")
+AUDIT_SUFFIX = "+audit"
+
+
+def _audit_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(AUDIT_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..obs.audit import AuditSpec, scan_chunk_audit
+
+        proto = _registry()[base_name]()
+        spec = AuditSpec()
+        base = jax.vmap(scan_chunk_audit(proto, chunk, spec))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+audit"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -443,6 +476,7 @@ def target_names() -> tuple:
                  sorted(f"{n}{METRICS_SUFFIX}" for n in METRICS_PROTOCOLS) +
                  sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS) +
                  sorted(f"{n}{TRACE_SUFFIX}" for n in TRACE_PROTOCOLS) +
+                 sorted(f"{n}{AUDIT_SUFFIX}" for n in AUDIT_PROTOCOLS) +
                  sorted(SS_PROTOCOLS))
 
 
@@ -450,6 +484,12 @@ def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
     if name in SS_PROTOCOLS:
         return _ss_target(name)
+    if name.endswith(AUDIT_SUFFIX):
+        if name[:-len(AUDIT_SUFFIX)] not in AUDIT_PROTOCOLS:
+            raise KeyError(
+                f"unknown audit target {name!r}; known: "
+                f"{sorted(f'{n}{AUDIT_SUFFIX}' for n in AUDIT_PROTOCOLS)}")
+        return _audit_target(name)
     if name.endswith(TRACE_SUFFIX):
         if name[:-len(TRACE_SUFFIX)] not in TRACE_PROTOCOLS:
             raise KeyError(

@@ -1148,7 +1148,8 @@ class Runner:
 
     def __init__(self, protocol, donate="auto", chunk_limit=10_000,
                  donate_threshold=1 << 20, superstep=1,
-                 fast_forward=False, metrics=None, trace=None):
+                 fast_forward=False, metrics=None, trace=None,
+                 audit=None):
         self.protocol = protocol
         self._jits = {}
         if donate == "auto":
@@ -1175,17 +1176,25 @@ class Runner:
         # no sync); `trace_frame()` decodes, `trace_stats()` surfaces
         # the truncation accounting (`run_report` prints it so a
         # clipped ring can never pass silently).
-        if metrics is not None and trace is not None:
+        if sum(p is not None for p in (metrics, trace, audit)) > 1:
             raise ValueError(
-                "Runner(metrics=..., trace=...) is not supported in one "
-                "pass: the two planes are separate carries and their "
-                "builders do not compose yet. Fix: run the chunk twice "
-                "(both planes are bit-identical on the trajectory), or "
-                "pick the one you are debugging with")
+                "Runner supports ONE observability plane per pass "
+                "(metrics=, trace=, audit=): the planes are separate "
+                "carries and their builders do not compose yet. Fix: "
+                "run the chunk twice (every plane is bit-identical on "
+                "the trajectory), or pick the one you are debugging "
+                "with")
         self._trace = trace
+        # audit (an obs.AuditSpec) swaps in the invariant-monitor chunk
+        # builders (obs/audit.py — bit-identical trajectory); each
+        # chunk's AuditCarry lands in `audit_carries` (device arrays —
+        # no sync); `audit_report()` decodes, and `run_report` prints a
+        # LOUD verdict so a violated run can never pass silently.
+        self._audit = audit
         self._ff_raw = []           # per-chunk device stats dicts
         self.metrics_carries = []
         self.trace_carries = []
+        self.audit_carries = []
         # superstep=K fuses engine work across K-ms windows (step_kms,
         # bit-identical); the requested value is an UPPER BOUND — each
         # chunk runs the largest K <= it that `pick_superstep` proves
@@ -1217,6 +1226,15 @@ class Runner:
                 from ..obs.trace import scan_chunk_trace
                 base = scan_chunk_trace(self.protocol, ms, self._trace,
                                         superstep=superstep)
+            elif self._audit is not None and self._fast_forward:
+                from ..obs.audit import fast_forward_chunk_audit
+                base = fast_forward_chunk_audit(self.protocol, ms,
+                                                self._audit,
+                                                superstep=superstep)
+            elif self._audit is not None:
+                from ..obs.audit import scan_chunk_audit
+                base = scan_chunk_audit(self.protocol, ms, self._audit,
+                                        superstep=superstep)
             elif self._fast_forward:
                 base = fast_forward_chunk(self.protocol, ms,
                                           superstep=superstep)
@@ -1240,6 +1258,8 @@ class Runner:
             self.metrics_carries.append(out[-1])
         if self._trace is not None:
             self.trace_carries.append(out[-1])
+        if self._audit is not None:
+            self.audit_carries.append(out[-1])
         return net, pstate
 
     def ff_stats(self):
@@ -1293,14 +1313,36 @@ class Runner:
                 "capacity": self._trace.capacity,
                 "dropped": dropped}
 
+    def audit_report(self):
+        """Host-side `obs.AuditReport` stitched from every chunk's
+        carry, or None when the audit plane was off/never ran.  Forces
+        a device sync (host ints)."""
+        if self._audit is None or not self.audit_carries:
+            return None
+        from ..obs.audit import monitored_invariants
+        from ..obs.audit_report import AuditReport
+        return AuditReport.from_carries(
+            self._audit, self.audit_carries,
+            monitored=monitored_invariants(self._audit,
+                                           self.protocol.cfg))
+
+    def audit_stats(self):
+        """Audit verdict dict across every chunk this Runner ran, or
+        None when the plane was off/never ran (`run_report` prints it
+        LOUDLY — a violated run cannot pass silently)."""
+        rep = self.audit_report()
+        return None if rep is None else rep.stats()
+
     def run_report(self, net, wall_s=None):
         """One-line run summary (utils/profiling.run_report) carrying
-        this Runner's quiet-window skip accounting AND the trace
-        truncation counters — a clipped event ring shows up in bench
-        output instead of passing silently."""
+        this Runner's quiet-window skip accounting, the trace
+        truncation counters AND the audit verdict — a clipped event
+        ring or a violated invariant shows up in bench output instead
+        of passing silently."""
         from ..utils.profiling import run_report
         return run_report(net, wall_s, ff=self.ff_stats(),
-                          trace=self.trace_stats())
+                          trace=self.trace_stats(),
+                          audit=self.audit_stats())
 
     def run_ms(self, net, pstate, ms: int):
         if not self._validated:

@@ -42,8 +42,24 @@ tests/test_trace.py; trace-OFF zero residue, analysis
 bisect the first state divergence between any two engine-variant
 configurations down to the exact (ms, pytree leaf, element) and print
 the decoded trace window around it from both runs.
+
+The AUDIT plane (`audit`, `audit_report` — PR 6) closes the loop from
+*describing* a run to *proving* it: an `AuditSpec(invariants, mode)`
+compiles conservation-law monitors (message conservation, ring/spill
+bounds, clock and done/counter monotonicity, broadcast-table
+consistency, cross-shard exchange conservation) into every engine
+variant through the same tap-hook chain, under the same two-sided
+contract (audit-ON bit-identical, tests/test_audit.py; audit-OFF zero
+residue, analysis `audit_zero_cost`).  `obs/ledger.py` appends a
+`RunManifest` provenance row per bench run under ``reports/ledger/``,
+and `tools/audit.py` is the one-command clean/violated CLI.
 """
 
+from .audit import (AuditCarry, AuditSpec, INVARIANTS,  # noqa: F401
+                    fast_forward_chunk_audit, init_audit,
+                    scan_chunk_audit, scan_chunk_batched_audit)
+from .audit_report import (AuditReport, audit_block,  # noqa: F401
+                           audit_variant, cross_check_metrics)
 from .decode import TraceFrame, trace_block  # noqa: F401
 from .engine import (fast_forward_chunk_batched_metrics,  # noqa: F401
                      fast_forward_chunk_metrics, scan_chunk_batched_metrics,

@@ -1,0 +1,626 @@
+"""Device side of the invariant audit plane: compiled conservation-law
+monitors.
+
+The metrics plane (obs/plane.py) answers "how much per interval" and the
+flight recorder (obs/trace.py) answers "which message, when" — but both
+are *descriptive*: a correctness break (a lost message, a resurrected
+done-node, a counter running backwards) is only discovered after the
+fact, when a bit-identity test fails and the divergence bisector
+(obs/diff.py) is run by hand.  This module turns the engine's
+conservation laws into monitors that run INSIDE the compiled chunk:
+
+  an `AuditSpec(invariants, mode)` compiles a fixed-shape int32
+  `AuditCarry` (per-invariant violation counters + an optional
+  first-violation ``(ms, invariant, index, observed, expected)``
+  record) into the engine chunk through the same `step_ms`/`step_kms`
+  tap hook the flight recorder uses.  Everything observed is a pure
+  function of ``(t, carried state, outbox)`` — no host callback, no
+  transfer, no extra PRNG draw — so **audit-ON is bit-identical** on
+  the ``(NetState, pstate)`` trajectory for every engine variant
+  (tests/test_audit.py) and the default ``tap=None`` build carries zero
+  residue — **audit-OFF has zero cost** (the `audit_zero_cost` analysis
+  rule pins the uninstrumented carry width, sibling of
+  `trace_zero_cost`).
+
+Invariant catalogue (``INVARIANTS``; the code is the index, stable
+regardless of the enabled subset):
+
+  ring_conservation   unicast-ring message conservation, checked per
+                      window with per-origin-ms exact send accounting:
+                      Δ ring occupancy == routed ring sends + spill
+                      re-injections − consumed ring rows − Δ overflow
+                      drops.  Inside a fused K-ms superstep the post
+                      tap replays each origin ms's routing validity
+                      with that ms's own latency draw (the same keying
+                      `enqueue_unicast` uses), so the balance is exact
+                      for any K; under fast-forwarding each executed
+                      window balances against its own entry/exit
+                      occupancy, and a jump moves only the clock —
+                      jump-aware by construction.
+  ring_capacity       every ``box_count`` cell <= ``inbox_cap``.
+  spill_budget        parked spill entries <= the HWM budget
+                      (``AuditSpec.spill_budget``, default the full
+                      ``spill_cap``) and no parked entry is overdue
+                      (arrival in the past = a missed drain).
+  clock_monotone      each window advances the clock by exactly K;
+                      each fast-forward jump is non-negative.
+  done_monotone       ``done_at`` is a fixed point once set (the
+                      precondition for cross-seed dedup of converged
+                      nodes, ROADMAP item 4); done-count monotonicity
+                      follows.
+  counter_monotone    the cumulative engine counters (msg/byte
+                      totals, dropped, bc_dropped, clamped,
+                      sp_dropped) never decrease window over window.
+  bc_consistency      no active broadcast-table record outlives the
+                      ring horizon (retire ran, live/retire agree).
+  shard_conservation  sharded engine only: per-(src shard, dst shard)
+                      message counts leaving an ICI exchange equal the
+                      counts arriving (one extra [S] all_to_all of
+                      bucket counts per window).
+
+The carry also samples final counter totals (``TOTALS``) so the host
+can cross-check the audit plane against a `MetricsCarry` from the same
+run (`obs.audit_report.cross_check_metrics`) — the two planes are
+separate carries (one per pass, like metrics vs trace), so the
+cross-check runs host-side over both results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core.latency import full_latency
+from ..core.network import (_jump, check_chunk_config, next_work, step_kms,
+                            step_ms)
+from ..ops import prng
+
+#: Canonical invariants; the invariant CODE is the index here and is
+#: stable regardless of which subset a spec enables (decode uses this).
+INVARIANTS = (
+    "ring_conservation",
+    "ring_capacity",
+    "spill_budget",
+    "clock_monotone",
+    "done_monotone",
+    "counter_monotone",
+    "bc_consistency",
+    "shard_conservation",
+)
+INV = {name: i for i, name in enumerate(INVARIANTS)}
+
+#: First-violation record columns, in buffer order.
+FIRST_FIELDS = ("ms", "invariant", "index", "observed", "expected")
+
+#: Cumulative engine counters `counter_monotone` snapshots per window
+#: (the "index" a counter_monotone first-violation record points into).
+#: The sharded engine has no spill buffer; its last slot carries the
+#: cross-shard exchange overflow counter instead.
+MONO_COUNTERS = ("msg_sent", "msg_received", "bytes_sent",
+                 "bytes_received", "dropped", "bc_dropped", "clamped",
+                 "sp_dropped")
+
+#: Audit totals sampled at the last window, cross-checkable against the
+#: metrics plane's identically-named counters.
+TOTALS = ("msg_sent", "msg_received", "drop_count", "done_count")
+
+MODES = ("count", "first")
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditSpec:
+    """Static audit-plane parameters (hashable, jit-closable).
+
+    invariants — enabled subset (canonical INVARIANTS order); disabled
+    invariants are never computed, a compile-time gate.
+    mode — "count" compiles the per-invariant violation counters only;
+    "first" (default) additionally compiles the first-violation record
+    ``(ms, invariant, index, observed, expected)`` — ms is the window
+    entry time, index the violating node/row/counter (-1 = global).
+    spill_budget — HWM budget for `spill_budget` (None = the config's
+    full ``spill_cap``).
+    """
+
+    invariants: tuple = INVARIANTS
+    mode: str = "first"
+    spill_budget: int | None = None
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got "
+                             f"{self.mode!r}")
+        unknown = [i for i in self.invariants if i not in INVARIANTS]
+        if unknown:
+            raise ValueError(f"unknown invariants {unknown}; known: "
+                             f"{INVARIANTS}")
+        object.__setattr__(
+            self, "invariants",
+            tuple(i for i in INVARIANTS if i in set(self.invariants)))
+        if self.spill_budget is not None and self.spill_budget < 0:
+            raise ValueError(f"spill_budget must be >= 0, got "
+                             f"{self.spill_budget}")
+
+    def enabled(self, name: str) -> bool:
+        return name in self.invariants
+
+
+def monitored_invariants(spec: AuditSpec, cfg,
+                         sharded: bool = False) -> tuple:
+    """The invariants a build with this spec ACTUALLY compiles for an
+    engine config — the honest subset a clean verdict may claim:
+    `shard_conservation` exists only in the sharded engine,
+    `spill_budget` only with a spill buffer (never sharded), and
+    `bc_consistency` only with broadcast slots."""
+    out = []
+    for name in spec.invariants:
+        if name == "shard_conservation" and not sharded:
+            continue
+        if name == "spill_budget" and (sharded or cfg.spill_cap == 0):
+            continue
+        if name == "bc_consistency" and cfg.bcast_slots == 0:
+            continue
+        out.append(name)
+    return tuple(out)
+
+
+@struct.dataclass
+class AuditCarry:
+    """The on-device audit state: ``counts[i]`` accumulates invariant
+    i's violations (full INVARIANTS indexing — fixed layout whatever
+    subset is enabled); ``first`` holds the earliest violation record
+    (FIRST_FIELDS order, ms == -1 while clean; written only in "first"
+    mode); ``prev_done``/``prev_counters`` are the previous window's
+    snapshots the monotonicity invariants difference against;
+    ``totals`` samples the TOTALS counters at the last folded window
+    (the metrics-plane cross-check input)."""
+
+    counts: jnp.ndarray         # int32 [len(INVARIANTS)]
+    first: jnp.ndarray          # int32 [5] — FIRST_FIELDS order
+    prev_done: jnp.ndarray      # int32 [N]
+    prev_counters: jnp.ndarray  # int32 [len(MONO_COUNTERS)]
+    totals: jnp.ndarray         # int32 [len(TOTALS)]
+
+
+def _mono_counters(net) -> jnp.ndarray:
+    nodes = net.nodes
+    return jnp.stack([
+        jnp.sum(nodes.msg_sent), jnp.sum(nodes.msg_received),
+        jnp.sum(nodes.bytes_sent), jnp.sum(nodes.bytes_received),
+        net.dropped, net.bc_dropped, net.clamped, net.sp_dropped,
+    ]).astype(jnp.int32)
+
+
+def init_audit(spec: AuditSpec, net) -> AuditCarry:
+    """Fresh carry with the monotonicity snapshots taken from the chunk
+    ENTRY state (the first window differences against reality, not
+    zeros — a restored mid-run state audits cleanly)."""
+    return AuditCarry(
+        counts=jnp.zeros((len(INVARIANTS),), jnp.int32),
+        first=jnp.full((len(FIRST_FIELDS),), -1, jnp.int32),
+        prev_done=net.nodes.done_at.astype(jnp.int32),
+        prev_counters=_mono_counters(net),
+        totals=jnp.zeros((len(TOTALS),), jnp.int32))
+
+
+def _routed_ring_candidates(cfg, model, net, out, t) -> jnp.ndarray:
+    """How many of this outbox's sends the engine will bin into the
+    unicast ring at ms `t` — the audit's replay of `_route_unicast`'s
+    validity decision, keyed on the same (seed, t, full-width slot id)
+    latency draw, so the count is the engine's count bit for bit."""
+    nodes = net.nodes
+    n, kk = cfg.n, out.dest.shape[1]
+    m = n * kk
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), kk)
+    dest = out.dest.reshape(m)
+    want = (dest >= 0) & (~nodes.down[src])
+    dest_c = jnp.clip(dest, 0, n - 1)
+    seed_t = prng.hash3(net.seed, prng.TAG_LATENCY, t)
+    midx = src * cfg.out_deg + out.slot0 + \
+        jnp.arange(m, dtype=jnp.int32) % kk
+    delta = prng.uniform_delta(seed_t, midx)
+    lat = full_latency(model, nodes, src, dest_c, delta)
+    valid = want & (lat < cfg.msg_discard_time) & (~nodes.down[dest_c]) & (
+        nodes.partition[src] == nodes.partition[dest_c])
+    if cfg.spill_cap > 0:
+        # far-future sends park in the spill buffer instead of the ring
+        raw_total = jnp.clip(out.delay.reshape(m), 0, None) + \
+            jnp.maximum(lat, 1)
+        valid = valid & ~(raw_total > cfg.horizon - 2)
+    return jnp.sum(valid).astype(jnp.int32)
+
+
+def audit_tap(protocol, spec: AuditSpec, cell):
+    """Build the `step_ms`/`step_kms` observation hook bound to a
+    mutable 1-cell ``[window_obs]``.  Entry taps accumulate the window's
+    consumed ring rows and spill drain set (and snapshot entry
+    occupancy/time at the first one); post taps accumulate each origin
+    ms's routed-send count.  The builder folds the window after the
+    step returns (`fold_window`)."""
+    cfg, model = protocol.cfg, protocol.latency
+    want_cons = spec.enabled("ring_conservation")
+
+    def tap(t, net, out):
+        if out is None:
+            obs = cell[0]
+            t32 = jnp.asarray(t, jnp.int32)
+            if obs is None:
+                obs = {"t_entry": t32,
+                       "occ_entry": jnp.sum(net.box_count).astype(
+                           jnp.int32),
+                       "dropped_entry": net.dropped,
+                       "consumed": jnp.asarray(0, jnp.int32),
+                       "candidates": jnp.asarray(0, jnp.int32),
+                       "drained": jnp.asarray(0, jnp.int32)}
+            if want_cons:
+                row = jax.lax.dynamic_slice(
+                    net.box_count, (t32 % cfg.horizon, 0), (1, cfg.n))
+                obs["consumed"] = obs["consumed"] + \
+                    jnp.sum(row).astype(jnp.int32)
+                if cfg.spill_cap > 0:
+                    sel = (net.sp_arrival >= 0) & \
+                        (net.sp_arrival - t32 <= cfg.horizon - 2)
+                    obs["drained"] = obs["drained"] + \
+                        jnp.sum(sel).astype(jnp.int32)
+            cell[0] = obs
+        elif want_cons:
+            cell[0]["candidates"] = cell[0]["candidates"] + \
+                _routed_ring_candidates(cfg, model, net, out, t)
+
+    return tap
+
+
+def _apply(spec: AuditSpec, ac: AuditCarry, t_ms, results) -> AuditCarry:
+    """Fold one window's invariant results ``[(inv_id, count, index,
+    observed, expected), ...]`` into the carry."""
+    results = sorted(results, key=lambda r: r[0])
+    counts = ac.counts
+    for inv_id, cnt, _, _, _ in results:
+        counts = counts.at[inv_id].add(cnt)
+    first = ac.first
+    if spec.mode == "first":
+        # walk in canonical order so the within-window "first" is
+        # deterministic; the ms-level first is the first violating
+        # window (first[0] stays -1 until then)
+        for inv_id, cnt, idx, obs_v, exp_v in results:
+            hit = (cnt > 0) & (first[0] < 0)
+            rec = jnp.stack([
+                jnp.asarray(t_ms, jnp.int32),
+                jnp.asarray(inv_id, jnp.int32),
+                jnp.asarray(idx, jnp.int32),
+                jnp.asarray(obs_v, jnp.int32),
+                jnp.asarray(exp_v, jnp.int32)])
+            first = jnp.where(hit, rec, first)
+    return ac.replace(counts=counts, first=first)
+
+
+def _common_results(spec: AuditSpec, cfg, ac: AuditCarry, obs, net,
+                    k: int, cur) -> list:
+    """The invariant checks shared by the dense and sharded folds —
+    clock advance, ring capacity, done fixed-point, cumulative-counter
+    monotonicity (`cur` is the engine flavor's counter vector), and
+    broadcast-table consistency.  ONE definition, so the two engines
+    can never silently monitor different invariants."""
+    nodes = net.nodes
+    t_after = net.time
+    results = []
+
+    def add(name, count, index, observed, expected):
+        if spec.enabled(name):
+            results.append((INV[name], count.astype(jnp.int32), index,
+                            observed, expected))
+
+    d = (t_after - obs["t_entry"]).astype(jnp.int32)
+    add("clock_monotone", (d != k).astype(jnp.int32),
+        jnp.asarray(-1, jnp.int32), d, jnp.asarray(k, jnp.int32))
+
+    over = net.box_count > cfg.inbox_cap
+    n_over = jnp.sum(over).astype(jnp.int32)
+    add("ring_capacity", n_over,
+        jnp.where(n_over > 0, jnp.argmax(over.reshape(-1)), -1).astype(
+            jnp.int32),
+        jnp.max(net.box_count).astype(jnp.int32),
+        jnp.asarray(cfg.inbox_cap, jnp.int32))
+
+    viol = (ac.prev_done > 0) & (nodes.done_at != ac.prev_done)
+    nv = jnp.sum(viol).astype(jnp.int32)
+    vi = jnp.argmax(viol).astype(jnp.int32)
+    add("done_monotone", nv, jnp.where(nv > 0, vi, -1).astype(jnp.int32),
+        nodes.done_at[vi].astype(jnp.int32), ac.prev_done[vi])
+
+    dec = cur < ac.prev_counters
+    nc = jnp.sum(dec).astype(jnp.int32)
+    ci = jnp.argmax(dec).astype(jnp.int32)
+    add("counter_monotone", nc,
+        jnp.where(nc > 0, ci, -1).astype(jnp.int32), cur[ci],
+        ac.prev_counters[ci])
+
+    if cfg.bcast_slots > 0 and spec.enabled("bc_consistency"):
+        # after the window's last retire (at t_after - 1) no active
+        # record may be older than the horizon
+        age = (t_after - 1 - net.bc_time).astype(jnp.int32)
+        stale = net.bc_active & (age >= cfg.horizon)
+        ns = jnp.sum(stale).astype(jnp.int32)
+        si = jnp.argmax(stale).astype(jnp.int32)
+        add("bc_consistency", ns,
+            jnp.where(ns > 0, si, -1).astype(jnp.int32), age[si],
+            jnp.asarray(cfg.horizon - 1, jnp.int32))
+    return results
+
+
+def _done_count(nodes) -> jnp.ndarray:
+    return jnp.sum((~nodes.down) & (nodes.done_at > 0)).astype(jnp.int32)
+
+
+def fold_window(spec: AuditSpec, cfg, ac: AuditCarry, obs, net,
+                k: int) -> AuditCarry:
+    """Evaluate every enabled invariant over one executed window
+    (entry observations in `obs`, exit state in `net`) and fold the
+    verdicts + refreshed snapshots into the carry.  Pure reductions
+    over state the engine already maintains — zero host sync."""
+    nodes = net.nodes
+    t_after = net.time
+    cur = _mono_counters(net)
+    results = _common_results(spec, cfg, ac, obs, net, k, cur)
+
+    def add(name, count, index, observed, expected):
+        if spec.enabled(name):
+            results.append((INV[name], count.astype(jnp.int32), index,
+                            observed, expected))
+
+    if spec.enabled("ring_conservation"):
+        occ_after = jnp.sum(net.box_count).astype(jnp.int32)
+        ddrop = (net.dropped - obs["dropped_entry"]).astype(jnp.int32)
+        lhs = occ_after - obs["occ_entry"]
+        rhs = obs["candidates"] + obs["drained"] - obs["consumed"] - ddrop
+        add("ring_conservation", (lhs != rhs).astype(jnp.int32),
+            jnp.asarray(-1, jnp.int32), lhs, rhs)
+
+    if cfg.spill_cap > 0 and spec.enabled("spill_budget"):
+        budget = cfg.spill_cap if spec.spill_budget is None \
+            else spec.spill_budget
+        parked = net.sp_arrival >= 0
+        occ_sp = jnp.sum(parked).astype(jnp.int32)
+        overdue = parked & (net.sp_arrival <= t_after)
+        n_bad = jnp.maximum(occ_sp - budget, 0) + \
+            jnp.sum(overdue).astype(jnp.int32)
+        add("spill_budget", n_bad,
+            jnp.where(jnp.any(overdue), jnp.argmax(overdue), -1).astype(
+                jnp.int32),
+            occ_sp, jnp.asarray(budget, jnp.int32))
+
+    drop_total = (net.dropped + net.bc_dropped + net.clamped +
+                  net.sp_dropped).astype(jnp.int32)
+    totals = jnp.stack([cur[0], cur[1], drop_total, _done_count(nodes)])
+    return _apply(spec, ac, obs["t_entry"], results).replace(
+        prev_done=nodes.done_at.astype(jnp.int32), prev_counters=cur,
+        totals=totals)
+
+
+def audit_jump(spec: AuditSpec, ac: AuditCarry, t_from, dt) -> AuditCarry:
+    """Audit one quiet-window fast-forward jump: the only invariant a
+    pure clock move can break is monotonicity (dt < 0)."""
+    if not spec.enabled("clock_monotone"):
+        return ac
+    dt = jnp.asarray(dt, jnp.int32)
+    bad = (dt < 0).astype(jnp.int32)
+    ac = ac.replace(counts=ac.counts.at[INV["clock_monotone"]].add(bad))
+    if spec.mode == "first":
+        rec = jnp.stack([jnp.asarray(t_from, jnp.int32),
+                         jnp.asarray(INV["clock_monotone"], jnp.int32),
+                         jnp.asarray(-1, jnp.int32), dt,
+                         jnp.asarray(0, jnp.int32)])
+        ac = ac.replace(first=jnp.where((bad > 0) & (ac.first[0] < 0),
+                                        rec, ac.first))
+    return ac
+
+
+# ------------------------------------------------------ chunk builders
+
+
+def step_ms_audit(protocol, spec: AuditSpec, net, pstate, ac):
+    """One audited millisecond: `step_ms` with the monitors tapped in.
+    The building block of the dense builders below."""
+    cell = [None]
+    net, pstate = step_ms(protocol, net, pstate,
+                          tap=audit_tap(protocol, spec, cell))
+    return net, pstate, fold_window(spec, protocol.cfg, ac, cell[0],
+                                    net, 1)
+
+
+def _step_window_audit(protocol, spec: AuditSpec, k: int):
+    """One audited K-ms window as a per-seed callable (k == 1 is a
+    plain audited ms)."""
+
+    def one(net, pstate, ac):
+        cell = [None]
+        net, pstate = step_kms(protocol, net, pstate, k,
+                               tap=audit_tap(protocol, spec, cell))
+        return net, pstate, fold_window(spec, protocol.cfg, ac, cell[0],
+                                        net, k)
+
+    return one
+
+
+def scan_chunk_audit(protocol, ms: int, spec: AuditSpec,
+                     superstep: int = 1):
+    """Returns ``run(net, pstate) -> (net, pstate, AuditCarry)``
+    advancing `ms` milliseconds as one `lax.scan` with the invariant
+    monitors in the carry — the audited twin of
+    ``scan_chunk(protocol, ms, superstep=K)``.  Inside a K window the
+    taps fire per simulated ms, so the conservation balance is exact
+    per origin ms and the trajectory is bit-identical to the
+    uninstrumented engine (tests/test_audit.py)."""
+    check_chunk_config(protocol, ms, superstep=superstep)
+    step = _step_window_audit(protocol, spec, superstep)
+
+    def run(net, pstate):
+        def body(carry, _):
+            return step(*carry), ()
+
+        (net2, p2, ac), _ = jax.lax.scan(
+            body, (net, pstate, init_audit(spec, net)),
+            length=ms // superstep)
+        return net2, p2, ac
+
+    return run
+
+
+def scan_chunk_batched_audit(protocol, ms: int, spec: AuditSpec,
+                             superstep: int = 2):
+    """Audited twin of `core/batched.scan_chunk_batched`: per-seed
+    monitors over the K-ms window engine.
+
+    Like the traced twin (obs/trace.py), this runs the vmapped
+    `step_kms` with per-ms taps: the seed-folded mailbox scatter is a
+    LAYOUT optimization proven bit-identical to the vmapped window
+    engine (tests/test_batched.py), so the audited trajectory — and
+    therefore every verdict — is exactly the one the folded production
+    engine computes."""
+    from ..core.batched import _check_batched_scope
+
+    check_chunk_config(protocol, ms, superstep=superstep)
+    _check_batched_scope(protocol, ms, superstep)
+    step = _step_window_audit(protocol, spec, superstep)
+
+    def run(net, pstate):
+        ac0 = jax.vmap(lambda n_: init_audit(spec, n_))(net)
+
+        def body(carry, _):
+            return jax.vmap(step)(*carry), ()
+
+        (net2, p2, ac), _ = jax.lax.scan(body, (net, pstate, ac0),
+                                         length=ms // superstep)
+        return net2, p2, ac
+
+    return run
+
+
+def fast_forward_chunk_audit(protocol, ms: int, spec: AuditSpec,
+                             seed_axis: bool = False, superstep: int = 1):
+    """Audited twin of `core/network.fast_forward_chunk`: returns
+    ``run(net, pstate) -> (net, pstate, stats, AuditCarry)``.  Each
+    executed window balances its own conservation equation; each jump
+    is audited for clock monotonicity (`audit_jump`) — a skipped ms is
+    a no-op step that conserves everything by construction.
+    ``seed_axis=True`` mirrors the engine's vmap-batched mode with
+    per-seed carries and lockstep jumps."""
+    check_chunk_config(protocol, ms, superstep=superstep,
+                       fast_forward=True)
+    cfg, k = protocol.cfg, superstep
+    step = _step_window_audit(protocol, spec, k)
+
+    def run(net, pstate):
+        t0 = net.time[0] if seed_axis else net.time
+        t_end = t0 + ms
+        if seed_axis:
+            ac0 = jax.vmap(lambda n_: init_audit(spec, n_))(net)
+        else:
+            ac0 = init_audit(spec, net)
+
+        def cond(carry):
+            t = carry[0].time[0] if seed_axis else carry[0].time
+            return t < t_end
+
+        def body(carry):
+            net, ps, ac, skipped, jumps = carry
+            if seed_axis:
+                net, ps, ac = jax.vmap(step)(net, ps, ac)
+                t1 = net.time[0]
+                nw = jnp.min(jax.vmap(
+                    lambda n_, p_: next_work(protocol, n_, p_, t1))(
+                    net, ps))
+            else:
+                net, ps, ac = step(net, ps, ac)
+                t1 = net.time
+                nw = next_work(protocol, net, ps, t1)
+            dt = jnp.clip(nw, t1, t_end) - t1
+            if k > 1:
+                dt = dt - dt % k          # keep entry times K-aligned
+            net = _jump(cfg, net, dt, t1 + dt)
+            if seed_axis:
+                ac = jax.vmap(lambda a_: audit_jump(spec, a_, t1, dt))(ac)
+            else:
+                ac = audit_jump(spec, ac, t1, dt)
+            return (net, ps, ac, skipped + dt,
+                    jumps + (dt > 0).astype(jnp.int32))
+
+        z = jnp.asarray(0, jnp.int32)
+        net, pstate, ac, skipped, jumps = jax.lax.while_loop(
+            cond, body, (net, pstate, ac0, z, z))
+        return net, pstate, {"skipped_ms": skipped,
+                             "jump_count": jumps}, ac
+
+    return run
+
+
+# ------------------------------------------------------ sharded engine
+
+
+def _mono_counters_sharded(snet) -> jnp.ndarray:
+    """Per-shard cumulative-counter vector (MONO_COUNTERS layout; the
+    sharded engine has no spill buffer, so the last slot carries the
+    cross-shard exchange overflow `xdropped` instead of sp_dropped)."""
+    net = snet.net
+    nodes = net.nodes
+    return jnp.stack([
+        jnp.sum(nodes.msg_sent), jnp.sum(nodes.msg_received),
+        jnp.sum(nodes.bytes_sent), jnp.sum(nodes.bytes_received),
+        net.dropped, net.bc_dropped, net.clamped, snet.xdropped,
+    ]).astype(jnp.int32)
+
+
+def init_audit_sharded(spec: AuditSpec, snet) -> AuditCarry:
+    """Fresh per-shard carry (call under vmap over the shard axis)."""
+    return AuditCarry(
+        counts=jnp.zeros((len(INVARIANTS),), jnp.int32),
+        first=jnp.full((len(FIRST_FIELDS),), -1, jnp.int32),
+        prev_done=snet.net.nodes.done_at.astype(jnp.int32),
+        prev_counters=_mono_counters_sharded(snet),
+        totals=jnp.zeros((len(TOTALS),), jnp.int32))
+
+
+def fold_window_sharded(spec: AuditSpec, cfg, ac: AuditCarry, obs,
+                        snet, k: int) -> AuditCarry:
+    """Per-shard window fold for `ShardedRunner.step_fn`: the shared
+    invariant checks of `_common_results` (one definition — the dense
+    and sharded audits can never silently monitor different
+    invariants) over this shard's slice, plus local ring conservation
+    (received exchange candidates vs Δ local occupancy) and the
+    cross-shard exchange conservation verdict the step computed
+    in-window (``obs["xmismatch"]``).  `obs` carries the same keys as
+    the dense path's plus the mismatch; totals attribute the
+    replicated `bc_dropped` to shard 0 only, so the host-side sum over
+    shards is global."""
+    net = snet.net
+    nodes = net.nodes
+    cur = _mono_counters_sharded(snet)
+    results = _common_results(spec, cfg, ac, obs, net, k, cur)
+
+    def add(name, count, index, observed, expected):
+        if spec.enabled(name):
+            results.append((INV[name], count.astype(jnp.int32), index,
+                            observed, expected))
+
+    if spec.enabled("ring_conservation"):
+        occ_after = jnp.sum(net.box_count).astype(jnp.int32)
+        ddrop = (net.dropped - obs["dropped_entry"]).astype(jnp.int32)
+        lhs = occ_after - obs["occ_entry"]
+        rhs = obs["candidates"] - obs["consumed"] - ddrop
+        add("ring_conservation", (lhs != rhs).astype(jnp.int32),
+            jnp.asarray(-1, jnp.int32), lhs, rhs)
+
+    if spec.enabled("shard_conservation"):
+        xm = obs["xmismatch"]
+        add("shard_conservation", xm, jnp.asarray(-1, jnp.int32), xm,
+            jnp.asarray(0, jnp.int32))
+
+    bc_term = jnp.where(snet.shard_id == 0, net.bc_dropped, 0)
+    drop_total = (net.dropped + bc_term + net.clamped +
+                  snet.xdropped).astype(jnp.int32)
+    totals = jnp.stack([cur[0], cur[1], drop_total, _done_count(nodes)])
+    return _apply(spec, ac, obs["t_entry"], results).replace(
+        prev_done=nodes.done_at.astype(jnp.int32), prev_counters=cur,
+        totals=totals)

@@ -1,0 +1,188 @@
+"""Run-ledger manifests: one JSONL row of provenance per measured run.
+
+Every bench/suite invocation currently leaves its evidence scattered —
+a JSON line on stdout, maybe a BENCH_*.json capture, a compile-cache
+delta — with nothing tying a number back to the exact configuration and
+observability artifacts that produced it.  The ledger fixes that: a
+`RunManifest` records the run's configuration digest, engine variant,
+superstep K, seed count, backend, wall time, and content digests of the
+metrics/trace/audit blocks (plus the audit verdict), appended as one
+JSONL row under ``reports/ledger/``.  Rows are append-only and
+self-describing (``schema`` version field), so a sweep's worth of runs
+is greppable and two runs claiming the same config are checkable by
+digest equality — the first concrete step of the serializable-
+ScenarioSpec refactor (ROADMAP item 2).
+
+`bench.py` and `tools/bench_suite.py` append a row per emitted metric
+line (``WTPU_LEDGER=0`` disables); `tools/audit.py` appends one per
+audited run.  Writing never raises into the caller — a full disk must
+not kill a metric line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import pathlib
+import sys
+import time
+
+#: manifest schema version (bump on field changes; readers key on it)
+SCHEMA = 1
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+
+#: default ledger location (repo-local, append-only JSONL)
+LEDGER_DIR = _REPO / "reports" / "ledger"
+LEDGER_PATH = LEDGER_DIR / "ledger.jsonl"
+
+
+def digest(obj) -> str:
+    """Short stable content digest of any JSON-serializable object
+    (canonical key order; non-serializable leaves stringified)."""
+    payload = json.dumps(obj, sort_keys=True, default=str,
+                         separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class RunManifest:
+    """One run's provenance row (JSONL-serializable)."""
+
+    run: str                        # metric / stage label
+    engine: str                     # "batched" | "vmapped" | "fast_forward" | "sharded" | ...
+    superstep: int
+    seeds: int
+    backend: str
+    config_digest: str              # digest of the run configuration
+    ts_unix: float = dataclasses.field(default_factory=time.time)
+    schema: int = SCHEMA
+    wall_s: float | None = None
+    sim_ms: int | None = None
+    value: float | None = None      # the run's headline number, if any
+    unit: str | None = None
+    metrics_digest: str | None = None
+    trace_digest: str | None = None
+    audit_digest: str | None = None
+    audit_clean: bool | None = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, row: dict) -> "RunManifest":
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra_unknown = {k: v for k, v in row.items() if k not in known}
+        kw = {k: v for k, v in row.items() if k in known}
+        if extra_unknown:       # forward-compat: unknowns ride in extra
+            kw.setdefault("extra", {}).update(extra_unknown)
+        return cls(**kw)
+
+
+def manifest_from_bench(line: dict, config: dict, label: str | None = None,
+                        backend: str | None = None) -> RunManifest:
+    """Build a manifest from a bench/suite JSON line + the knob dict
+    that produced it.  `config` should hold everything that selects the
+    compiled program (protocol, sizes, engine env knobs) — its digest
+    is what makes two runs comparable-by-construction."""
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:       # noqa: BLE001 — provenance, not control
+            backend = "unknown"
+    # callers that know their dispatch pass config["engine"]; guessing
+    # an engine from the superstep would mislabel A/B legs (e.g. the
+    # vmapped dense calibration leg at K=4), so the fallback is honest
+    if line.get("fast_forward") or config.get("fast_forward"):
+        engine = "fast_forward"
+    else:
+        engine = "unspecified"
+    audit = line.get("audit") or {}
+    wall = line.get("wall_total_s", line.get("wall_median_s"))
+    return RunManifest(
+        run=label or str(line.get("metric", "run")),
+        engine=str(config.get("engine", engine)),
+        superstep=int(line.get("superstep", config.get("superstep", 1))
+                      or 1),
+        seeds=int(line.get("total_seeds",
+                           line.get("batch", config.get("seeds", 1)))),
+        backend=backend,
+        config_digest=digest(config),
+        wall_s=float(wall) if wall is not None else None,
+        sim_ms=int(line["sim_ms"]) if line.get("sim_ms") else None,
+        value=float(line["value"]) if line.get("value") is not None
+        else None,
+        unit=line.get("unit"),
+        metrics_digest=digest(line["engine_metrics"])
+        if line.get("engine_metrics") else None,
+        trace_digest=digest(line["trace"]) if line.get("trace") else None,
+        audit_digest=digest(audit) if audit else None,
+        audit_clean=bool(audit["clean"]) if "clean" in audit else None,
+        extra={k: line[k] for k in ("metric", "vs_baseline",
+                                    "compile_cache") if k in line},
+    )
+
+
+def append(manifest: RunManifest, path=None) -> str | None:
+    """Append one manifest row to the JSONL ledger (default
+    ``reports/ledger/ledger.jsonl``); returns the path written, or None
+    when the write failed (logged to stderr — provenance must never
+    kill a metric line)."""
+    path = pathlib.Path(path) if path else LEDGER_PATH
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(manifest.to_json(), sort_keys=True,
+                               default=str) + "\n")
+        return str(path)
+    except OSError as e:
+        print(f"ledger: append failed ({e}); row dropped",
+              file=sys.stderr)
+        return None
+
+
+def append_from_env(line: dict, label: str | None = None,
+                    **config_extra) -> str | None:
+    """The one-call provenance append `bench.py` and
+    `tools/bench_suite.py` share: capture the WTPU_*/JAX_PLATFORMS
+    engine knobs as the config (ONE definition of what the config
+    digest covers — two callers re-implementing the filter would let
+    their digests silently diverge for identical configurations),
+    merge `config_extra` (callers pass `engine=` from the dispatch
+    they actually took), build the manifest, and append.  Never raises
+    — provenance must not kill a metric line; returns the path written
+    or None."""
+    import os
+
+    try:
+        config = {k: v for k, v in sorted(os.environ.items())
+                  if k.startswith(("WTPU_", "JAX_PLATFORMS"))}
+        config.update(config_extra)
+        return append(manifest_from_bench(line, config, label=label))
+    except Exception as e:      # noqa: BLE001 — provenance only
+        print(f"ledger: append_from_env failed: {type(e).__name__}: "
+              f"{e!s:.200}", file=sys.stderr)
+        return None
+
+
+def read_all(path=None) -> list:
+    """All ledger rows as `RunManifest`s (malformed lines skipped with
+    a stderr note — an append-only log must tolerate a torn tail)."""
+    path = pathlib.Path(path) if path else LEDGER_PATH
+    if not path.exists():
+        return []
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(RunManifest.from_json(json.loads(line)))
+            except (json.JSONDecodeError, TypeError) as e:
+                print(f"ledger: skipping malformed row {i}: {e}",
+                      file=sys.stderr)
+    return out

@@ -228,7 +228,8 @@ class ShardedRunner:
             lat = lat + extra_all[src_g] + extra_all[dst_g]
         return jnp.maximum(1, lat) * (src_g != dst_g) + (src_g == dst_g)
 
-    def step_fn(self, superstep: int = 1, trace_spec=None):
+    def step_fn(self, superstep: int = 1, trace_spec=None,
+                audit_spec=None):
         """Returns the shard_map'ed step: one simulated ms (default), or
         one fused K-ms superstep window.
 
@@ -259,14 +260,32 @@ class ShardedRunner:
         (ms, dest) slot order matches the sequential path bit-for-bit).
         Messages carry their origin-ms offset through the exchange so
         the receiver keys each latency draw on the origin ms, exactly
-        as the per-ms path does."""
+        as the per-ms path does.
+
+        ``audit_spec`` (an `obs.AuditSpec`) compiles the invariant
+        audit plane into the step instead: the returned function then
+        carries a per-shard `AuditCarry` third argument.  The sharded
+        monitors cover the local-ring/monotonicity invariants per
+        shard, the replicated broadcast table's consistency, local
+        ring conservation (received exchange candidates vs Δ local
+        occupancy), and CROSS-SHARD exchange conservation: the
+        per-destination-shard bucket counts each shard placed ride one
+        extra tiny ``[S]`` all_to_all, so every shard verifies that
+        what its peers claim they sent it equals what actually arrived
+        (obs/audit.py `fold_window_sharded`).  Pure reads of values
+        the step already computes — bit-identical trajectory
+        (tests/test_audit.py)."""
         cfg, lcfg, S = self.protocol.cfg, self.lcfg, self.n_shards
         nl, k, xcap = self.n_local, cfg.out_deg, self.xcap
         K = superstep
         proto = self.protocol
         fw = cfg.payload_words
+        if trace_spec is not None and audit_spec is not None:
+            raise ValueError("one observability plane per step_fn")
         if trace_spec is not None:
             from ..obs.trace import KIND, _append
+        if audit_spec is not None:
+            from ..obs.audit import fold_window_sharded
 
         def one_shard(snet: ShardedNet, pstate, tc=None):
             net = snet.net
@@ -292,6 +311,21 @@ class ShardedRunner:
             snet = snet.replace(net=net)
             gids0 = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
             step = getattr(proto, "step_sharded", None)
+            aobs = None
+            if audit_spec is not None:
+                # window-entry observations for the sharded fold: the K
+                # consumed rows are intact until the deferred clear, so
+                # one contiguous slice reads them all up-front
+                aobs = {
+                    "t_entry": jnp.asarray(t, jnp.int32),
+                    "occ_entry": jnp.sum(net.box_count).astype(jnp.int32),
+                    "dropped_entry": net.dropped,
+                    "consumed": jnp.sum(jax.lax.dynamic_slice(
+                        net.box_count, (t % cfg.horizon, 0),
+                        (K, nl))).astype(jnp.int32),
+                    "candidates": jnp.asarray(0, jnp.int32),
+                    "xmismatch": jnp.asarray(0, jnp.int32),
+                }
 
             # ---- K protocol steps: per-ms local inbox reads (the local
             # ring is untouched inside the window — binning is deferred)
@@ -304,7 +338,7 @@ class ShardedRunner:
                 inbox, nodes, in_sizes = self._local_inbox(
                     snet.replace(net=net), ti, part_all, extra_all,
                     tables)
-                if tc is not None and trace_spec.enabled("deliver"):
+                if trace_spec is not None and trace_spec.enabled("deliver"):
                     width = inbox.valid.shape[1]
                     dst_g = jnp.broadcast_to(gids0[:, None], (nl, width))
                     slot = jnp.broadcast_to(
@@ -337,7 +371,7 @@ class ShardedRunner:
                     jnp.where(want_i, size_i, 0))
                 nodes = nodes.replace(msg_sent=sent, bytes_sent=sbytes)
                 net = net.replace(nodes=nodes)
-                if tc is not None and trace_spec.enabled("send"):
+                if trace_spec is not None and trace_spec.enabled("send"):
                     tc = _append(
                         trace_spec, tc, ti, KIND["send"],
                         jnp.repeat(gids0, ke),
@@ -362,7 +396,7 @@ class ShardedRunner:
                 ))
                 # ---- broadcasts: replicated table, all shards agree ----
                 req = out.bcast & (~nodes.down)
-                if tc is not None and trace_spec.enabled("send"):
+                if trace_spec is not None and trace_spec.enabled("send"):
                     tc = _append(trace_spec, tc, ti, KIND["send"], gids0,
                                  jnp.full((nl,), -1, jnp.int32),
                                  out.bcast_size,
@@ -458,8 +492,27 @@ class ShardedRunner:
                 return x.reshape((S, K, xcap) + x.shape[1:]).swapaxes(
                     0, 1).reshape((S * K * xcap,) + x.shape[1:])
 
+            xb_dest = xc(b_dest)
+            if aobs is not None and audit_spec.enabled(
+                    "shard_conservation"):
+                # cross-shard conservation: what each peer CLAIMS it
+                # sent me (its per-dest-shard bucket counts, exchanged
+                # over one tiny [S] all_to_all) must equal what
+                # actually arrived in its segment of the exchange
+                sent_to = jnp.sum(
+                    ok_s[:, None] &
+                    (ds_s[:, None] == jnp.arange(S, dtype=jnp.int32)[
+                        None, :]), axis=0).astype(jnp.int32)
+                claims = jax.lax.all_to_all(
+                    sent_to.reshape(S, 1)[None], "sp", split_axis=1,
+                    concat_axis=1)[0].reshape(S)
+                received_from = jnp.sum(
+                    xb_dest.reshape(S, K * xcap) >= 0,
+                    axis=1).astype(jnp.int32)
+                aobs["xmismatch"] = jnp.sum(
+                    jnp.abs(claims - received_from)).astype(jnp.int32)
             r_src = omm(xc(b_src))
-            r_dest = omm(xc(b_dest))
+            r_dest = omm(xb_dest)
             r_payload = omm(xc(b_payload))
             r_size = omm(xc(b_size))
             r_delay = omm(xc(b_delay))
@@ -482,6 +535,8 @@ class ShardedRunner:
                 ~net.nodes.down[dl] & \
                 (part_all[jnp.maximum(r_src, 0)] ==
                  net.nodes.partition[dl])
+            if aobs is not None:
+                aobs["candidates"] = jnp.sum(ok).astype(jnp.int32)
             raw_total = jnp.clip(r_delay, 0, None) + jnp.maximum(lat, 1)
             total = jnp.clip(raw_total, 1, cfg.horizon - 2)
             # Arrivals past the ring clamp (counted, like the single-chip
@@ -532,11 +587,14 @@ class ShardedRunner:
                 box_data=box_data, box_src=box_src, box_size=box_size,
                 box_count=box_count, dropped=dropped, time=t + K)
             snet = snet.replace(net=net, xdropped=snet.xdropped + xdrop)
+            if aobs is not None:
+                tc = fold_window_sharded(audit_spec, cfg, tc, aobs,
+                                         snet, K)
             if tc is not None:
                 return snet, pstate, tc
             return snet, pstate
 
-        traced = trace_spec is not None
+        traced = trace_spec is not None or audit_spec is not None
 
         def wrapped(snet, pstate, tc=None):
             # shard_map blocks keep a leading length-1 shard axis; peel it
@@ -610,7 +668,7 @@ class ShardedRunner:
         return {k: v.astype(jnp.int32) for k, v in out.items()}
 
     def run_ms(self, snet, pstate, ms: int, metrics=None,
-               superstep: int = 1, trace=None):
+               superstep: int = 1, trace=None, audit=None):
         """Advance `ms` milliseconds.  ``metrics`` (an
         `obs.MetricsSpec`) additionally records the global-aggregate
         interval series on device and returns ``(snet, pstate,
@@ -625,6 +683,13 @@ class ShardedRunner:
         timeline.  One plane per pass (both are bit-identical on the
         trajectory — run twice to get both).
 
+        ``audit`` (an `obs.AuditSpec`) compiles the invariant audit
+        plane into the step (`step_fn(audit_spec=...)` — local + cross-
+        shard conservation monitors) and returns ``(snet, pstate,
+        AuditCarry)`` with a leading shard axis on the carry;
+        `obs.AuditReport.from_carry` merges the shards onto one
+        verdict.  One plane per pass, like metrics/trace.
+
         ``superstep=K`` advances in fused K-ms windows (one ICI
         exchange, one sort/scatter bin and one slot clear per window —
         `step_fn(superstep=K)`, bit-identical); gated by the shared
@@ -633,11 +698,11 @@ class ShardedRunner:
         from ..core.network import check_chunk_config
 
         ms = int(ms)
-        if metrics is not None and trace is not None:
+        if sum(p is not None for p in (metrics, trace, audit)) > 1:
             raise ValueError(
-                "run_ms(metrics=..., trace=...) is one plane per pass: "
-                "run the chunk twice (both planes are bit-identical on "
-                "the trajectory)")
+                "run_ms(metrics=, trace=, audit=) is one plane per "
+                "pass: run the chunk twice (every plane is "
+                "bit-identical on the trajectory)")
         check_chunk_config(self.protocol, ms, superstep=superstep)
         if superstep > 1:
             if metrics is not None and metrics.stat_each_ms % superstep:
@@ -657,12 +722,12 @@ class ShardedRunner:
         if not hasattr(self, "_jits"):
             self._jits = {}
             self._steps = {}
-        if (superstep, trace) not in self._steps:
-            self._steps[(superstep, trace)] = self.step_fn(
-                superstep=superstep, trace_spec=trace)
-        key = (ms, metrics, trace, superstep)
+        if (superstep, trace, audit) not in self._steps:
+            self._steps[(superstep, trace, audit)] = self.step_fn(
+                superstep=superstep, trace_spec=trace, audit_spec=audit)
+        key = (ms, metrics, trace, audit, superstep)
         if key not in self._jits:
-            step = self._steps[(superstep, trace)]
+            step = self._steps[(superstep, trace, audit)]
             if trace is not None:
                 from ..obs.trace import init_trace
 
@@ -676,6 +741,19 @@ class ShardedRunner:
                     (sn2, ps2, tc), _ = jax.lax.scan(
                         body, (sn, ps, tc0), length=ms // superstep)
                     return sn2, ps2, tc
+            elif audit is not None:
+                from ..obs.audit import init_audit_sharded
+
+                @jax.jit
+                def run(sn, ps):
+                    ac0 = jax.vmap(
+                        lambda s: init_audit_sharded(audit, s))(sn)
+
+                    def body(carry, _):
+                        return step(*carry), ()
+                    (sn2, ps2, ac), _ = jax.lax.scan(
+                        body, (sn, ps, ac0), length=ms // superstep)
+                    return sn2, ps2, ac
             elif metrics is None:
                 @jax.jit
                 def run(sn, ps):

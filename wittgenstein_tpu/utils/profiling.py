@@ -54,7 +54,8 @@ def timed():
 
 
 def run_report(net, wall_s: float | None = None, ff: dict | None = None,
-               trace: dict | None = None) -> str:
+               trace: dict | None = None,
+               audit: dict | None = None) -> str:
     """One-line run summary from the engine counters: simulated time,
     per-node message/byte traffic over live nodes (via the StatsHelper
     getters, which guard the all-down case), drop/clamp health, and
@@ -71,7 +72,12 @@ def run_report(net, wall_s: float | None = None, ff: dict | None = None,
     carries the recorded-event count, the ring high-water mark against
     capacity, and — LOUDLY — the dropped-event count, so a silently
     truncated trace is visible in bench output instead of masquerading
-    as a complete one."""
+    as a complete one.
+
+    `audit` is the invariant-audit verdict from an audited run
+    (`Runner(audit=spec).audit_stats()`): a clean run states what it
+    proved (invariant count), a violated run SHOUTS the per-invariant
+    counts and the first-violation record."""
     from . import stats
     nodes = net.nodes
     live = int(np.asarray((~np.asarray(nodes.down)).sum()))
@@ -105,6 +111,19 @@ def run_report(net, wall_s: float | None = None, ff: dict | None = None,
             tr += (f" TRUNCATED dropped={int(trace['dropped'])} "
                    "(raise TraceSpec.capacity)")
         parts.append(tr)
+    if audit is not None:
+        if audit["total"] == 0:
+            parts.append(f"audit clean "
+                         f"({len(audit['invariants'])} invariants)")
+        else:
+            per = ",".join(f"{k}={v}"
+                           for k, v in audit["violations"].items() if v)
+            au = f"!! AUDIT VIOLATIONS total={audit['total']} [{per}]"
+            first = audit.get("first")
+            if first:
+                au += (f" first=(ms {first['ms']} {first['invariant']} "
+                       f"index={first['index']})")
+            parts.append(au)
     if wall_s is not None and wall_s > 0:
         parts.append(f"wall={wall_s:.2f}s ({t / wall_s:.0f} sim-ms/s)")
     return "Simulation execution time: " + " ".join(parts)
