@@ -33,6 +33,11 @@ pass with the compiled conservation-law monitors ON; a violated
 verdict is loud in the block AND on stderr); WTPU_AUDIT=0 skips it.
 WTPU_LEDGER=0 skips the per-line `RunManifest` provenance row appended
 under reports/ledger/ (obs/ledger.py; schema in BENCH_NOTES.md r10).
+The WTPU_* scenario knobs are captured as ONE `ScenarioSpec`
+(wittgenstein_tpu/serve/spec.py — the request plane's config object);
+main() reads its knobs back out of the spec and the ledger row's
+config digest is the spec digest, so bench, bench_suite and serve
+share one config path.
 
 If the accelerator backend cannot initialize (wedged/down device tunnel),
 the bench re-execs itself on the plain CPU backend with a small config and
@@ -665,21 +670,11 @@ def _int_list_env(name, default):
 
 def _int_env(name, default):
     """One tolerant scalar-int env read: a malformed override must not
-    crash the bench before it emits its metric line.  Every WTPU_BENCH_*
-    scalar is a count (nodes, seeds, ms, caps, reps), so non-positive
-    values are rejected along with non-numeric ones."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        val = 0
-    if val <= 0:
-        print(f"bench: ignoring malformed {name}={raw!r}; using "
-              f"{default}", file=sys.stderr)
-        return default
-    return val
+    crash the bench before it emits its metric line.  Delegates to the
+    shared definition (`serve.spec.int_env`) so the knob parsing the
+    one-config-path contract depends on cannot silently fork."""
+    from wittgenstein_tpu.serve.spec import int_env
+    return int_env(name, default, prefix="bench")
 
 
 def _parent_init_bounded(timeout_s):
@@ -841,38 +836,46 @@ def main():
                                                enable_persistent_cache)
     cache_dir = enable_persistent_cache()
     cache_before = cache_entry_count(cache_dir)
-    n = _int_env("WTPU_BENCH_NODES", 2048)
-    seeds = _int_env("WTPU_BENCH_SEEDS", 16)
-    sim_ms = _int_env("WTPU_BENCH_MS", 1000)
+    # ONE config path (wittgenstein_tpu/serve/spec.py): the WTPU_* flag
+    # soup is captured as a ScenarioSpec — the same object the request
+    # plane and bench_suite use — and the bench reads its knobs back
+    # OUT of the spec, so the ledger's config digest IS the spec digest
+    # (no second source of truth).  Measurement-protocol knobs (reps,
+    # microbatching, box_split) are not scenario config and stay env.
+    from wittgenstein_tpu.serve.spec import ScenarioSpec
+    spec = ScenarioSpec.from_env()
+    # proto_sel stays the RAW env value: an unknown selection must
+    # reach bench_quiet's loud refusal (before any ledger append),
+    # never silently coerce to the Handel headline.
+    proto_sel = os.environ.get("WTPU_BENCH_PROTO", "handel")
+    n = spec.params.get("node_count", _int_env("WTPU_BENCH_NODES", 2048))
+    seeds = len(spec.seeds)
+    sim_ms = spec.sim_ms
     # The scan length per jitted call.  An explicit superstep K needs
     # chunk % K == 0 (the gate refuses instead of mislabeling the A/B),
     # so ladder scripts probing K > 8 override the default 200 — e.g.
     # 240 admits every K in {2, 4, 8, 16} while staying a multiple of
     # Handel's schedule lcm 20 (phase specialization stays on).
-    chunk = _int_env("WTPU_BENCH_CHUNK", 200)
-    mode = os.environ.get("WTPU_BENCH_MODE", "exact")
-    horizon = _int_env("WTPU_BENCH_HORIZON", 256)
+    chunk = spec.chunk_ms
+    mode = spec.params.get("mode",
+                           os.environ.get("WTPU_BENCH_MODE", "exact"))
+    horizon = spec.params.get("horizon",
+                              _int_env("WTPU_BENCH_HORIZON", 256))
     # inbox 12 measured drop-free at both the 2048-node headline config
     # and the 65536-node cardinal tier-2 config (BENCH_NOTES.md r3).
-    inbox_cap = _int_env("WTPU_BENCH_INBOX", 12)
+    inbox_cap = spec.params.get("inbox_cap",
+                                _int_env("WTPU_BENCH_INBOX", 12))
     reps = _int_env("WTPU_BENCH_REPS", 3)
     # WTPU_SUPERSTEP=K runs the fused K-ms window engine
     # (core/network.step_kms, bit-identical — tests/test_superstep.py);
     # "auto" picks the largest K the latency floor proves.  The legacy
     # WTPU_BENCH_SUPERSTEP spelling still works; default stays the
-    # universally-valid 2.
-    raw_ss = os.environ.get("WTPU_SUPERSTEP")
-    if raw_ss == "auto":
-        superstep = "auto"
-    elif raw_ss is not None:
-        superstep = _int_env("WTPU_SUPERSTEP", 2)
-    else:
-        superstep = _int_env("WTPU_BENCH_SUPERSTEP", 2)
+    # universally-valid 2 (ScenarioSpec.from_env mirrors the rule).
+    superstep = spec.superstep
     # Seed counts past the single-chip vmap ceiling run as sequential
     # microbatches (the 256-seed path, RunMultipleTimes.java:41-87).
     seed_batch = _int_env("WTPU_BENCH_SEED_BATCH", 16)
     box_split = _int_env("WTPU_BENCH_BOX_SPLIT", 1)
-    proto_sel = os.environ.get("WTPU_BENCH_PROTO", "handel")
     try:
         if proto_sel != "handel":
             res = bench_quiet(proto_sel, n=n, seeds=seeds, sim_ms=sim_ms,
@@ -945,23 +948,23 @@ def main():
     }
     if os.environ.get("WTPU_BENCH_DEGRADED_FROM"):
         out["degraded_from_seeds"] = int(os.environ["WTPU_BENCH_DEGRADED_FROM"])
-    _append_ledger(out, n=n, seeds=seeds, mode=mode, chunk=chunk,
+    _append_ledger(out, spec, n=n, seeds=seeds, mode=mode, chunk=chunk,
                    proto_sel=proto_sel)
     print(json.dumps(out))
 
 
-def _append_ledger(out, **config_extra):
+def _append_ledger(out, spec, **extra):
     """One `RunManifest` provenance row per emitted metric line
-    (`obs.ledger.append_from_env` — the shared env-knob capture;
-    ``WTPU_LEDGER=0`` skips).  The engine label comes from the setup
-    that CHOSE the dispatch (the bench fns put it in the line), never
-    re-derived."""
+    (`obs.ledger.append_from_spec`; ``WTPU_LEDGER=0`` skips).  The
+    config digest is the `ScenarioSpec` digest — the one definition
+    bench, bench_suite and serve share — and the engine label comes
+    from the setup that CHOSE the dispatch (the bench fns put it in
+    the line), never re-derived."""
     if os.environ.get("WTPU_LEDGER", "1") == "0":
         return
     try:
         from wittgenstein_tpu.obs import ledger
-        path = ledger.append_from_env(
-            out, engine=out.get("engine", "unspecified"), **config_extra)
+        path = ledger.append_from_spec(out, spec, **extra)
         if path:
             print(f"bench: ledger row appended -> {path}",
                   file=sys.stderr)

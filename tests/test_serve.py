@@ -1,0 +1,452 @@
+"""Request plane (wittgenstein_tpu/serve) — the PR-7 battery.
+
+Acceptance pins:
+  * coalescing bit-identity: N coalesced requests (one compile key,
+    different seeds) return per-request results bit-identical to the
+    same requests run sequentially through `Runner`, metrics/audit
+    planes ON;
+  * a repeated spec is a registry HIT with no recompile — callable
+    identity asserted (the `ab_plane_barrier` distinct-executables
+    pattern, inverted);
+  * `ScenarioSpec` canonical-JSON round-trip + digest stability.
+"""
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+from wittgenstein_tpu.core.network import Runner
+from wittgenstein_tpu.models.pingpong import PingPong
+from wittgenstein_tpu.obs import ledger
+from wittgenstein_tpu.obs.audit import AuditSpec
+from wittgenstein_tpu.obs.spec import MetricsSpec
+from wittgenstein_tpu.serve import (CompileRegistry, ScenarioSpec,
+                                    Scheduler, Service)
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0,), sim_ms=240, chunk_ms=120,
+                obs=("metrics", "audit"))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------- spec
+
+
+def test_spec_canonical_roundtrip_and_digest_stability():
+    spec = _spec(params={"node_count": 64},
+                 partition=(5, 3), obs=("audit", "metrics"))
+    # round trip through the canonical wire form is exact
+    again = ScenarioSpec.from_json(spec.canonical_json())
+    assert again == spec
+    assert again.canonical_json() == spec.canonical_json()
+    # dict-ordering and collection normalization never move the digest:
+    # obs order canonicalizes, partition sorts, params key order is
+    # irrelevant to the sorted-key JSON
+    reordered = ScenarioSpec.from_json(json.loads(json.dumps(
+        spec.to_json())))
+    assert reordered.digest() == spec.digest()
+    assert _spec(obs=("metrics", "audit"), partition=(3, 5)).digest() == \
+        _spec(obs=("audit", "metrics"), partition=(5, 3)).digest()
+    # every program-affecting field moves the digest
+    for change in (dict(sim_ms=480), dict(chunk_ms=60),
+                   dict(superstep=2), dict(seeds=(1,)),
+                   dict(params={"node_count": 128})):
+        assert _spec(**change).digest() != _spec().digest(), change
+
+
+def test_compile_key_is_seed_and_span_blind():
+    """Coalescing-by-construction: requests differing only in DATA
+    (seeds, partition, total span) share a compile key; program
+    changes (engine, K, chunk, params, obs planes) split it."""
+    key = _spec().compile_key()
+    assert _spec(seeds=(7, 8, 9)).compile_key() == key
+    assert _spec(sim_ms=480).compile_key() == key
+    assert _spec(partition=(3,)).compile_key() == key
+    for change in (dict(chunk_ms=60), dict(superstep=2),
+                   dict(params={"node_count": 128}),
+                   dict(obs=("metrics",)),
+                   dict(attack={"at_ms": 37, "leaf": "nodes.msg_sent",
+                                "node": 5, "delta": 1})):
+        assert _spec(**change).compile_key() != key, change
+
+
+def test_spec_validation_refuses_with_remedy():
+    # unknown protocol -> the registry's known list
+    with pytest.raises(ValueError, match="unknown protocol"):
+        _spec(protocol="NopeProto").validate()
+    # unknown constructor kwarg -> 400-able ValueError WITH the template
+    # echoed (not a deep TypeError) — server/core.validate_parameters
+    with pytest.raises(ValueError, match="node_count"):
+        _spec(params={"node_count": 64, "bogus": 1}).validate()
+    # engine gate remedies come from check_chunk_config itself
+    with pytest.raises(ValueError, match="superstep"):
+        _spec(superstep=16).validate()      # PingPong self-sends: K<=2
+    with pytest.raises(ValueError, match="multiple of chunk_ms"):
+        _spec(sim_ms=250).validate()
+    with pytest.raises(ValueError, match="trace_capacity"):
+        _spec(obs=("trace",), trace_capacity=16).validate()
+    with pytest.raises(ValueError, match="batched"):
+        _spec(engine="batched", superstep=1).validate()
+    with pytest.raises(ValueError, match="unknown engine"):
+        _spec(engine="warp").validate()
+    with pytest.raises(ValueError, match="unknown field"):
+        ScenarioSpec.from_json({"protocol": "PingPong", "nodes": 64})
+    # a typo'd obs plane is refused at construction, never silently
+    # dropped (it would run unobserved and digest as a config the
+    # requester never meant)
+    with pytest.raises(ValueError, match="unknown obs plane"):
+        _spec(obs=("Metrics",))
+    # an out-of-range fault plant would be silently dropped by jax's
+    # oob scatter — refused instead
+    with pytest.raises(ValueError, match="attack node"):
+        _spec(attack={"at_ms": 37, "leaf": "nodes.msg_sent",
+                      "node": 999}).validate()
+    with pytest.raises(ValueError, match="attack at_ms"):
+        _spec(attack={"at_ms": 500, "leaf": "nodes.msg_sent",
+                      "node": 5}).validate()
+    # "auto" resolves to an int K
+    assert isinstance(_spec(superstep="auto").validate().superstep, int)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_repeat_spec_is_warm_hit():
+    """The ab_plane_barrier pattern inverted: a repeated spec must map
+    to the SAME chunk callable (no retrace, no recompile), a different
+    compile key to a DISTINCT one."""
+    reg = CompileRegistry(persistent=False)
+    spec = _spec().validate()
+    f1 = reg.chunk_fn(spec, "metrics")
+    f2 = reg.chunk_fn(spec, "metrics")
+    assert f1 is f2, "repeated spec must be a registry HIT"
+    assert reg.hits == 1 and reg.misses == 1
+    # a data-only difference (other seeds) is still the same program
+    f3 = reg.chunk_fn(_spec(seeds=(5, 6)).validate(), "metrics")
+    assert f3 is f1
+    # a program difference is a distinct callable
+    f4 = reg.chunk_fn(_spec(chunk_ms=60, sim_ms=240).validate(),
+                      "metrics")
+    assert f4 is not f1
+    assert reg.stats()["entries"] == 2
+
+
+def test_registry_refuses_unresolved_spec():
+    reg = CompileRegistry(persistent=False)
+    with pytest.raises(ValueError, match="resolved"):
+        reg.chunk_fn(_spec(superstep="auto"))
+
+
+# ------------------------------------------- coalescing bit-identity
+
+
+def _sequential_reference(spec, seed):
+    """One seed run twice through `Runner` (one obs plane per pass —
+    the planes are bit-identical on the trajectory), chunked exactly
+    like the scheduler (chunk_limit = chunk_ms)."""
+    proto = spec.build_protocol()
+    runner = Runner(proto, donate=False, chunk_limit=spec.chunk_ms,
+                    metrics=MetricsSpec(stat_each_ms=spec.stat_each_ms))
+    net, ps = proto.init(np.int32(seed))
+    net, ps = runner.run_ms(net, ps, spec.sim_ms)
+    auditor = Runner(proto, donate=False, chunk_limit=spec.chunk_ms,
+                     audit=AuditSpec())
+    anet, aps = proto.init(np.int32(seed))
+    auditor.run_ms(anet, aps, spec.sim_ms)
+    return (net, ps), runner.metrics_frame(), auditor.audit_report()
+
+
+def test_coalesced_requests_bit_identical_to_sequential(tmp_path):
+    """THE acceptance pin: 3 coalesced requests (same compile key,
+    different seeds) == 3 sequential single-seed Runner runs, bit for
+    bit, with the metrics AND audit planes ON — plus one ledger row
+    per request whose config digest is the spec digest."""
+    lpath = tmp_path / "ledger.jsonl"
+    sch = Scheduler(ledger_path=str(lpath))
+    rids = [sch.submit(_spec(seeds=(s,))) for s in (0, 1, 2)]
+    out = sch.run_pending()
+    assert out["processed"] == 3
+    for rid, seed in zip(rids, (0, 1, 2)):
+        req = sch.request(rid)
+        assert req.status == "done", req.error
+        (net, ps), frame, audit = _sequential_reference(req.spec, seed)
+        # final state: scheduler lane (width 1) vs the sequential run
+        lane = jax.tree.map(lambda x: x[0], req.final_state)
+        _trees_equal(lane, (net, ps))
+        # metrics plane: identical interval series
+        blk = req.artifacts["engine_metrics"]
+        np.testing.assert_array_equal(
+            np.array(blk["series"]["msg_sent"]),
+            frame.column("msg_sent"))
+        assert blk["totals"] == frame.totals()
+        # audit plane: same verdict, same conservation totals
+        ablk = req.artifacts["audit"]
+        assert ablk["clean"] and audit.clean
+        assert ablk["totals"] == audit.totals_dict()
+        assert ablk["violations"] == audit.violations()
+    # one RunManifest row per request, config digest == spec digest
+    rows = ledger.read_all(str(lpath))
+    assert len(rows) == 3
+    for row, rid in zip(rows, rids):
+        assert row.run == f"serve:{rid}"
+        assert row.config_digest == sch.request(rid).spec.digest()
+        assert row.audit_clean is True
+        assert row.extra["compile_key"] == sch.request(rid).compile_key
+
+
+def test_continuous_batching_late_join(tmp_path):
+    """A compatible request submitted while the group is in flight
+    joins at the next chunk boundary — and its result is bit-identical
+    to running it alone."""
+    sch = Scheduler(ledger_path=str(tmp_path / "l.jsonl"))
+    a = sch.submit(_spec(seeds=(0,), sim_ms=360))
+    late = {}
+
+    def join_once():
+        if not late:
+            late["id"] = sch.submit(_spec(seeds=(9,)))
+
+    sch.on_boundary = join_once
+    out = sch.run_pending()
+    assert out["processed"] == 2
+    ra, rb = sch.request(a), sch.request(late["id"])
+    assert ra.status == "done" and rb.status == "done"
+    # B started while A's group was running (it joined, not a 2nd group)
+    assert rb.started <= ra.finished
+    # the joiner's artifacts match a solo run of the same spec
+    solo_sch = Scheduler(registry=sch.registry,
+                         ledger_path=str(tmp_path / "solo.jsonl"))
+    solo = solo_sch.submit(_spec(seeds=(9,)))
+    solo_sch.run_pending()
+    rs = solo_sch.request(solo)
+    _trees_equal(rb.final_state, rs.final_state)
+    assert rb.artifacts["engine_metrics"]["series"] == \
+        rs.artifacts["engine_metrics"]["series"]
+    assert rb.artifacts["audit"] == rs.artifacts["audit"]
+
+
+def test_partition_and_attack_requests(tmp_path):
+    """Partition is data (same compile key, different trajectory);
+    an attack is program (the audit plane must flag the planted
+    perturbation, the PR-6 acceptance shape)."""
+    sch = Scheduler(ledger_path=str(tmp_path / "l.jsonl"))
+    plain = sch.submit(_spec())
+    part = sch.submit(_spec(partition=(3, 5)))
+    atk = sch.submit(_spec(
+        attack={"at_ms": 37, "leaf": "nodes.msg_sent", "node": 5,
+                "delta": -(1 << 20)}))
+    assert sch.request(plain).compile_key == sch.request(part).compile_key
+    assert sch.request(atk).compile_key != sch.request(plain).compile_key
+    sch.run_pending()
+    rp = sch.request(part)
+    assert rp.status == "done"
+    down = np.asarray(rp.final_state[0].nodes.down)
+    assert down[:, 3].all() and down[:, 5].all()
+    assert rp.artifacts["summary"]["live_count"] == 62
+    ra = sch.request(atk)
+    assert ra.status == "done"
+    assert not ra.artifacts["audit"]["clean"], \
+        "planted counter perturbation must be flagged"
+    assert ra.artifacts["audit"]["first"]["invariant"] == \
+        "counter_monotone"
+    # the clean request stays clean in the same drain
+    assert sch.request(plain).artifacts["audit"]["clean"]
+
+
+def test_done_request_eviction(tmp_path):
+    """A long-lived service must not pin every past request's final
+    state: beyond `keep_done` the oldest finished records are evicted
+    (the ledger row stays the durable artifact)."""
+    sch = Scheduler(ledger_path=str(tmp_path / "l.jsonl"), keep_done=1)
+    a = sch.submit(_spec(seeds=(0,), obs=("metrics",)))
+    b = sch.submit(_spec(seeds=(1,), obs=("metrics",)))
+    sch.run_pending()
+    assert sch.request(b).status == "done"
+    with pytest.raises(KeyError):
+        sch.request(a)                  # evicted; ledger row remains
+    assert len(ledger.read_all(str(tmp_path / "l.jsonl"))) == 2
+
+
+# -------------------------------------------------------------- service
+
+
+def test_service_in_process_manual_drain(tmp_path):
+    svc = Service(scheduler=Scheduler(ledger_path=str(tmp_path / "l.jsonl")),
+                  auto=False)
+    sub = svc.submit(_spec(seeds=(0, 1)).to_json())
+    assert sub["status"] == "queued" and sub["compile_key"]
+    st = svc.status(sub["id"])
+    assert st["status"] == "queued" and st["sim_ms"] == 240
+    # result before done answers with status, not an error
+    assert svc.result(sub["id"])["status"] == "queued"
+    svc.run_pending()
+    res = svc.result(sub["id"])
+    assert res["status"] == "done"
+    assert res["summary"]["done_count"] > 0
+    assert res["audit"]["clean"]
+    assert res["engine_metrics"]["intervals"] == 24
+    assert svc.registry_stats()["misses"] >= 1
+    # warm resubmit: same compile key, no new registry entries
+    entries = svc.registry_stats()["entries"]
+    sub2 = svc.submit(_spec(seeds=(7,)).to_json())
+    svc.run_pending()
+    assert sub2["compile_key"] == sub["compile_key"]
+    assert svc.registry_stats()["entries"] == entries
+    assert svc.result(sub2["id"])["status"] == "done"
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_http_batch_round_trip():
+    """/w/batch/*: submit -> status -> result over HTTP, manual drain
+    (deterministic), plus the 400-with-remedy on a bad spec and the
+    unknown-kwarg 400 with the template echoed on /w/network/init."""
+    import threading
+
+    from wittgenstein_tpu.server.http import make_server
+    httpd = make_server(0, batch_auto=False)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        spec = _spec(params={"node_count": 32}, sim_ms=120, chunk_ms=120,
+                     obs=("metrics",))
+        sub = _post(port, "/w/batch/submit", spec.to_json())
+        assert sub["status"] == "queued"
+        _post(port, "/w/batch/run")
+        st = _get(port, f"/w/batch/status/{sub['id']}")
+        assert st["status"] == "done"
+        assert st["progress"]["done_count"] > 0    # streamed snapshot
+        res = _get(port, f"/w/batch/result/{sub['id']}")
+        assert res["engine_metrics"]["totals"]["msg_sent"] > 0
+        reg = _get(port, "/w/batch/registry")
+        assert reg["misses"] >= 1
+        # bad spec -> 400 with remedy text
+        bad = dict(spec.to_json(), sim_ms=250)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/w/batch/submit", bad)
+        assert ei.value.code == 400
+        assert "multiple of chunk_ms" in json.loads(ei.value.read())["error"]
+        # unknown request id -> 400 (KeyError surfaced)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/w/batch/status/nope")
+        assert ei.value.code == 400
+        # malformed JSON body -> 400, not a closed socket
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/w/batch/submit",
+            data=b'{"protocol":"PingPong",',
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        assert "malformed JSON" in json.loads(ei.value.read())["error"]
+        # satellite: unknown init kwarg -> 400 WITH the template echoed
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/w/network/init/PingPong",
+                  {"node_count": 32, "bogus": 1})
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read())["error"]
+        assert "bogus" in err and "node_count" in err
+    finally:
+        httpd.batch_service.close()
+        httpd.shutdown()
+
+
+def test_service_auto_worker_drains():
+    """The background worker drains a submit without an explicit run
+    (the production server mode)."""
+    svc = Service(auto=True)
+    svc.scheduler.ledger_path = "/dev/null"
+    try:
+        sub = svc.submit(_spec(params={"node_count": 32}, sim_ms=120,
+                               chunk_ms=120, obs=("metrics",)).to_json())
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if svc.status(sub["id"])["status"] in ("done", "error"):
+                break
+            time.sleep(0.2)
+        st = svc.status(sub["id"])
+        assert st["status"] == "done", st
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------- engine variants (slow)
+
+
+@pytest.mark.slow
+def test_serve_fast_forward_variant_bit_identity(tmp_path):
+    """engine='fast_forward' through the request plane == the dense
+    vmapped engine, bit for bit (compiles two engine variants ->
+    slow)."""
+    sch = Scheduler(ledger_path=str(tmp_path / "l.jsonl"))
+    dense = sch.submit(_spec(seeds=(0, 1)))
+    ff = sch.submit(_spec(seeds=(0, 1), engine="fast_forward"))
+    assert sch.request(dense).compile_key != sch.request(ff).compile_key
+    sch.run_pending()
+    rd, rf = sch.request(dense), sch.request(ff)
+    assert rd.status == "done" and rf.status == "done", (rd.error,
+                                                         rf.error)
+    _trees_equal(rd.final_state, rf.final_state)
+    assert rf.artifacts["fast_forward"]["skipped_ms"] > 0
+    assert rd.artifacts["audit"]["clean"] and rf.artifacts["audit"]["clean"]
+    # trajectory counters agree; execution counters (samples, ff_*)
+    # legitimately differ — skipped ms are not executed steps
+    td = rd.artifacts["engine_metrics"]["totals"]
+    tf = rf.artifacts["engine_metrics"]["totals"]
+    for name in ("msg_sent", "msg_received", "bytes_sent",
+                 "bytes_received", "done_count", "live_count",
+                 "drop_count"):
+        assert td[name] == tf[name], name
+
+
+@pytest.mark.slow
+def test_serve_batched_variant_bit_identity(tmp_path):
+    """engine='batched' (seed-folded Handel) through the request plane
+    == the vmapped engine (compiles two engine variants -> slow)."""
+    from wittgenstein_tpu.models.handel import Handel  # noqa: F401
+    params = dict(node_count=64, threshold=56, nodes_down=6,
+                  pairing_time=4, dissemination_period_ms=20,
+                  level_wait_time=50, fast_path=10)
+    sch = Scheduler(ledger_path=str(tmp_path / "l.jsonl"))
+    mk = lambda eng, k: ScenarioSpec(          # noqa: E731
+        protocol="Handel", params=params, seeds=(0, 1), sim_ms=80,
+        chunk_ms=80, engine=eng, superstep=k, obs=("metrics", "audit"),
+        stat_each_ms=20)
+    vm = sch.submit(mk("vmapped", 2))
+    bt = sch.submit(mk("batched", 2))
+    sch.run_pending()
+    rv, rb = sch.request(vm), sch.request(bt)
+    assert rv.status == "done" and rb.status == "done", (rv.error,
+                                                         rb.error)
+    _trees_equal(rv.final_state, rb.final_state)
+    assert rv.artifacts["engine_metrics"]["totals"] == \
+        rb.artifacts["engine_metrics"]["totals"]
+    assert rv.artifacts["audit"]["clean"] and rb.artifacts["audit"]["clean"]

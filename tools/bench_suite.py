@@ -7,6 +7,8 @@ Configs (BASELINE.md "Tracked configs"):
   * SanFermin 32k
   * Dfinity 10k validators (10 BPs + 10,000 attesters, rotating
     100-attester committees)
+plus smoke stages: trace_smoke (PR 5), audit_smoke (PR 6), serve_smoke
+(PR 7 — 2 coalesced requests through the in-process request plane).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
@@ -32,6 +34,25 @@ from wittgenstein_tpu.core.network import scan_chunk   # noqa: E402
 from wittgenstein_tpu.utils.measure import timed_chunks  # noqa: E402
 
 
+def _env_superstep():
+    """THE suite's WTPU_SUPERSTEP parse — `run_config` (what the run
+    requests) and `_stage_spec` (what the ledger digests) share this
+    single definition, so the digested K can never drift from the K
+    the run requests.  Returns "auto" or an int >= 1 (malformed -> 1,
+    the suite's historical default)."""
+    import os
+
+    raw = os.environ.get("WTPU_SUPERSTEP", "1")
+    if raw == "auto":
+        return "auto"
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        print(f"bench_suite: ignoring malformed WTPU_SUPERSTEP={raw!r}; "
+              f"using 1", file=sys.stderr)
+        return 1
+
+
 def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
                superstep=None):
     """Build the jitted step/init for one config and measure it.
@@ -40,20 +61,9 @@ def run_config(proto, seeds, sim_ms, chunk, check, reps=2, t0_mod=None,
     default 1 keeps the tracked configs comparable with their history);
     the effective K — auto-picked and floor-gated like bench.py — is
     recorded in the JSON line."""
-    import os
-
     from wittgenstein_tpu.core.network import pick_superstep
     if superstep is None:
-        raw = os.environ.get("WTPU_SUPERSTEP", "1")
-        if raw == "auto":
-            superstep = "auto"
-        else:
-            try:
-                superstep = max(1, int(raw))
-            except ValueError:
-                print(f"bench_suite: ignoring malformed "
-                      f"WTPU_SUPERSTEP={raw!r}; using 1", file=sys.stderr)
-                superstep = 1
+        superstep = _env_superstep()
     if superstep == "auto" or superstep > 1:
         superstep = pick_superstep(
             proto, chunk, t0=0,
@@ -261,6 +271,55 @@ def bench_audit_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_serve_smoke():
+    """Request-plane smoke stage (PR 7): an in-process `serve.Service`,
+    2 coalesced requests (one compile key, different seeds) through
+    submit -> drain -> result, artifacts and per-request ledger rows
+    asserted — the whole plane (spec validation -> registry -> the
+    coalescing scheduler -> artifacts -> ledger) exercised end to end
+    in seconds, so a request-plane regression surfaces in the suite
+    instead of during a service incident.  The ledger round-trips
+    against an ISOLATED temp file (the audit_smoke convention — the
+    shared ledger is append-only and concurrently written)."""
+    import dataclasses
+    import os
+    import tempfile
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.serve import ScenarioSpec, Scheduler, Service
+
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": 64},
+                        seeds=(0,), sim_ms=120, chunk_ms=120,
+                        obs=("metrics", "audit"))
+    fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        svc = Service(scheduler=Scheduler(ledger_path=tmp), auto=False)
+        a = svc.submit(spec.to_json())
+        b = svc.submit(dataclasses.replace(spec, seeds=(1,)).to_json())
+        assert a["compile_key"] == b["compile_key"], "must coalesce"
+        svc.run_pending()
+        ra, rb = svc.result(a["id"]), svc.result(b["id"])
+        assert ra["status"] == "done" and rb["status"] == "done"
+        assert ra["audit"]["clean"] and rb["audit"]["clean"]
+        assert ra["engine_metrics"]["totals"]["msg_sent"] > 0
+        assert ra["summary"]["done_count"] > 0
+        json.dumps(ra), json.dumps(rb)      # one-line-JSON embeddable
+        rows = ledger.read_all(tmp)
+        assert len(rows) == 2, rows
+        assert all(r.audit_clean for r in rows)
+        assert rows[0].config_digest == spec.digest()
+        reg = svc.registry_stats()
+        assert reg["misses"] >= 1
+        return {"metric": "serve_smoke_requests", "value": 2,
+                "unit": "requests", "registry": reg,
+                "audit_clean": True, "ledger_rows": len(rows),
+                "platform": jax.default_backend()}
+    finally:
+        os.unlink(tmp)
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -268,25 +327,102 @@ CONFIGS = {
     "dfinity_10k_validators": bench_dfinity,
     "trace_smoke": bench_trace_smoke,
     "audit_smoke": bench_audit_smoke,
+    "serve_smoke": bench_serve_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
 # emit the SAME metric name as the success path, or a consumer keying
 # on it never sees the failure line.
 METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
-                "audit_smoke": "audit_smoke_violations"}
+                "audit_smoke": "audit_smoke_violations",
+                "serve_smoke": "serve_smoke_requests"}
+
+
+def _stage_spec(name):
+    """Each tracked stage's static config as a `ScenarioSpec` — the
+    suite's half of the one-config-path contract (bench.py builds its
+    spec from the env; the stages are hard-coded configs, so their
+    specs mostly are too).  The knobs `run_config` DOES honor from the
+    env (WTPU_SUPERSTEP, the WTPU_METRICS/TRACE/AUDIT plane gates via
+    bench's `_maybe_engine_metrics` chain) fold into the spec the same
+    way, so a K=4 suite row can never digest equal to a K=1 row.
+
+    The digest covers the REQUESTED config (the raw env K, before
+    `run_config`'s pick_superstep demotion): equal digests therefore
+    imply equal programs (demotion is deterministic), while the
+    manifest's own `superstep` field records the EFFECTIVE K the run
+    executed (run_config puts it in the line).  Returns None for
+    unlisted/ad-hoc stage names."""
+    import os
+
+    from wittgenstein_tpu.serve.spec import ScenarioSpec
+    env_ss = _env_superstep()       # run_config's own parse, shared
+    env_obs = tuple(
+        p for p, on in (
+            ("metrics", os.environ.get("WTPU_METRICS", "1") != "0"),
+            ("trace", os.environ.get("WTPU_TRACE") == "1"),
+            ("audit", os.environ.get("WTPU_AUDIT", "1") != "0")) if on)
+    table = {
+        "pingpong_1000n": dict(
+            protocol="PingPong", params={"node_count": 1000},
+            seeds=tuple(range(4)), sim_ms=800, chunk_ms=100),
+        "gsf_4096n": dict(
+            protocol="GSFSignature", params={"node_count": 4096},
+            seeds=tuple(range(4)), sim_ms=2500, chunk_ms=250),
+        "sanfermin_32768n": dict(
+            protocol="SanFermin",
+            # box_split=2 is applied via cfg replace in bench_sanfermin
+            # — program-affecting, so it must be in the digest even
+            # though the ctor cannot express it (provenance capture,
+            # never built)
+            params={"node_count": 32768, "inbox_cap": 16,
+                    "box_split": 2},
+            seeds=(0,), sim_ms=6000, chunk_ms=500),
+        "dfinity_10k_validators": dict(
+            protocol="Dfinity",
+            params={"block_producers_count": 10,
+                    "attesters_count": 10_000,
+                    "attesters_per_round": 100, "block_capacity": 512},
+            seeds=(0,), sim_ms=120_000, chunk_ms=2000),
+        "trace_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=120, obs=("trace",),
+            trace_capacity=1024, superstep=1),
+        "audit_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=120, obs=("audit",), superstep=1),
+        "serve_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=120, obs=("metrics", "audit"),
+            superstep=1),
+    }
+    cfg = table.get(name)
+    if cfg is None:
+        return None
+    # smoke stages pin their own planes/K (stage-intrinsic); the four
+    # run_config-driven stages take the env-honored values
+    cfg.setdefault("obs", env_obs)
+    cfg.setdefault("superstep", env_ss)
+    return ScenarioSpec(**cfg)
 
 
 def _append_ledger(name, res):
-    """One provenance row per emitted suite line
-    (`obs.ledger.append_from_env` — the shared env-knob capture;
-    ``WTPU_LEDGER=0`` skips).  Never raises into the suite loop."""
+    """One provenance row per emitted suite line; the config digest is
+    the stage's `ScenarioSpec` digest (`obs.ledger.append_from_spec` —
+    the one config path bench.py and serve share; unlisted stages fall
+    back to the env capture).  ``WTPU_LEDGER=0`` skips.  Never raises
+    into the suite loop."""
     import os
     if os.environ.get("WTPU_LEDGER", "1") == "0":
         return
     from wittgenstein_tpu.obs import ledger
-    ledger.append_from_env(res, label=name, stage=name,
-                           engine="vmapped")   # run_config's scan_chunk
+    spec = _stage_spec(name)
+    if spec is not None:
+        ledger.append_from_spec(res, spec, label=name, stage=name,
+                                engine=res.get("engine", "vmapped"))
+    else:
+        ledger.append_from_env(res, label=name, stage=name,
+                               engine="vmapped")  # run_config's scan_chunk
 
 
 def main():

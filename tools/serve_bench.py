@@ -1,0 +1,89 @@
+"""Cold-vs-warm submit->result latency of the request plane.
+
+The warm-start story is the request plane's whole value proposition
+(ROADMAP item 2: repeat shapes ~zero compile latency), so it gets its
+own honest number: ONE in-process `serve.Service`, the same
+`ScenarioSpec` shape submitted twice —
+
+  cold: fresh registry, first compile of the chunk programs (the
+        persistent on-disk cache may still warm the XLA compile; the
+        `compile_cache` field says which happened, bench.py convention);
+  warm: a second request with different seeds — same compile key, a
+        registry HIT, no retrace, no recompile.
+
+Output: one JSON line on stdout with both latencies and the registry
+counters (BENCH_NOTES.md r11 schema), plus a `RunManifest` ledger row
+per measured request (config digest = the spec digest).
+
+Usage: python tools/serve_bench.py [nodes] [sim_ms]
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax                                        # noqa: E402
+
+import wittgenstein_tpu.models                    # noqa: E402, F401
+from wittgenstein_tpu.core.harness import (       # noqa: E402
+    cache_entry_count, enable_persistent_cache)
+from wittgenstein_tpu.serve import (              # noqa: E402
+    ScenarioSpec, Scheduler, Service)
+
+
+def timed_submit(svc, spec):
+    """submit -> drain -> result, one wall-clock number (the latency a
+    synchronous client of the manual-drain service observes)."""
+    t0 = time.perf_counter()
+    sub = svc.submit(spec.to_json())
+    svc.run_pending()
+    res = svc.result(sub["id"])
+    wall = time.perf_counter() - t0
+    assert res["status"] == "done", res
+    assert res["audit"]["clean"], res["audit"]
+    return wall, res
+
+
+def main():
+    import dataclasses
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    sim_ms = int(sys.argv[2]) if len(sys.argv) > 2 else 240
+    cache_dir = enable_persistent_cache()
+    cache_before = cache_entry_count(cache_dir)
+    svc = Service(scheduler=Scheduler(), auto=False)
+    # largest chunk <= 120 that divides the requested span — any CLI
+    # sim_ms passes spec validation instead of tripping the
+    # multiple-of-chunk refusal
+    chunk = max(d for d in range(1, min(sim_ms, 120) + 1)
+                if sim_ms % d == 0)
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": n},
+                        seeds=(0,), sim_ms=sim_ms, chunk_ms=chunk,
+                        obs=("metrics", "audit"))
+    cold_s, _ = timed_submit(svc, spec)
+    warm_s, _ = timed_submit(svc, dataclasses.replace(spec, seeds=(1,)))
+    reg = svc.registry_stats()
+    assert reg["hits"] >= 1, reg        # the warm leg must be a HIT
+    cache_new = cache_entry_count(cache_dir) - cache_before
+    out = {
+        "metric": f"serve_warm_submit_latency_ms_pingpong_{n}n",
+        "value": round(1e3 * warm_s, 1),
+        "unit": "ms",
+        "cold_ms": round(1e3 * cold_s, 1),
+        "warm_ms": round(1e3 * warm_s, 1),
+        "cold_over_warm": round(cold_s / max(warm_s, 1e-9), 1),
+        "sim_ms": sim_ms,
+        "registry": reg,
+        "compile_cache": ("off" if cache_dir is None else
+                          "hit" if cache_new == 0 else "miss"),
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,6 +126,33 @@ def manifest_from_bench(line: dict, config: dict, label: str | None = None,
     )
 
 
+def manifest_from_spec(line: dict, spec, label: str | None = None,
+                       backend: str | None = None,
+                       **extra) -> RunManifest:
+    """Build a manifest whose ``config_digest`` IS the `ScenarioSpec`
+    digest (serve/spec.py) — the one config path bench, bench_suite and
+    the serve scheduler share, so rows from all three claiming the same
+    spec are comparable by digest equality.  `extra` keys (e.g. the
+    spec's ``compile_key``) ride in the manifest's extra dict."""
+    config = dict(spec.to_json())
+    config["engine"] = line.get("engine", spec.engine)
+    # manifest_from_bench's seed fallback is a COUNT; the spec's field
+    # is the seed list
+    config["seeds"] = len(spec.seeds)
+    if not isinstance(spec.superstep, int):
+        # an unresolved "auto" would hit manifest_from_bench's int()
+        # fallback when the line carries no superstep of its own —
+        # drop it from the fallback dict (the digest below still
+        # covers the requested value)
+        config.pop("superstep", None)
+    mani = manifest_from_bench(line, config, label=label, backend=backend)
+    mani.config_digest = spec.digest()
+    if not line.get("superstep") and isinstance(spec.superstep, int):
+        mani.superstep = spec.superstep
+    mani.extra.update(extra)
+    return mani
+
+
 def append(manifest: RunManifest, path=None) -> str | None:
     """Append one manifest row to the JSONL ledger (default
     ``reports/ledger/ledger.jsonl``); returns the path written, or None
@@ -141,6 +168,20 @@ def append(manifest: RunManifest, path=None) -> str | None:
     except OSError as e:
         print(f"ledger: append failed ({e}); row dropped",
               file=sys.stderr)
+        return None
+
+
+def append_from_spec(line: dict, spec, label: str | None = None,
+                     path=None, **extra) -> str | None:
+    """`manifest_from_spec` + `append` with the never-raises contract
+    of `append_from_env` (provenance must not kill a metric line).
+    Returns the path written or None."""
+    try:
+        return append(manifest_from_spec(line, spec, label=label, **extra),
+                      path)
+    except Exception as e:      # noqa: BLE001 — provenance only
+        print(f"ledger: append_from_spec failed: {type(e).__name__}: "
+              f"{e!s:.200}", file=sys.stderr)
         return None
 
 

@@ -1,0 +1,35 @@
+"""Simulation-as-a-service: the batch-workload request plane.
+
+The reference ships a wserver REST façade for ONE interactive network
+(`server/` mirrors it).  This package is its batch analogue — ROADMAP
+item 2's "millions of users" path: many concurrent scenario requests,
+coalesced into few compiled device programs.
+
+  `spec`      — `ScenarioSpec`: the frozen, serializable description of
+                one scenario run (protocol, params, engine variant,
+                superstep K, obs planes, attack/partition, seeds) with
+                a canonical JSON form, a `compile_key()` digest over
+                exactly the program-affecting subset, and validation
+                that reuses the engine's own eligibility gates
+                (`check_chunk_config`/`pick_superstep`) so a bad spec
+                is refused with remedy text instead of compiled.
+  `registry`  — `CompileRegistry`: compile-key -> jitted-chunk-program
+                registry layered on the PR-2 persistent compile cache;
+                repeat shapes are warm starts, hit/miss counters ride
+                the obs block conventions.
+  `scheduler` — `Scheduler`: a coalescing queue that groups pending
+                requests sharing a compile key and runs them as ONE
+                vmapped seed-batched program (continuous seed batching:
+                compatible requests join at the next chunk boundary),
+                returning per-request ProgressPerTime/trace/audit
+                artifacts and appending one `RunManifest` ledger row
+                per request.
+  `service`   — `Service`: submit/status/result surface (in-process
+                and behind `server/http.py`'s `/w/batch/*` routes)
+                streaming progress from the on-device metrics plane.
+"""
+
+from .registry import CompileRegistry  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+from .service import Service  # noqa: F401
+from .spec import ENGINES, OBS_PLANES, ScenarioSpec  # noqa: F401

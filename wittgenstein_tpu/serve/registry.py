@@ -1,0 +1,150 @@
+"""`CompileRegistry` — compile-key -> jitted chunk program, warm-start.
+
+The request plane's latency story has two layers:
+
+  * in-process: the registry memoizes ONE jitted chunk callable per
+    (compile key, plane).  A repeat spec returns the SAME callable
+    object — jax's jit cache then reuses the compiled executable for a
+    previously-seen batch width, so a warm submit never re-traces or
+    re-compiles (tests/test_serve.py pins callable identity, the
+    `ab_plane_barrier` distinct-executables assert inverted);
+  * cross-process: construction enables the PR-2 persistent compile
+    cache (`harness.enable_persistent_cache`), so even a cold registry
+    in a fresh service process compiles a previously-seen shape from
+    the on-disk cache instead of from scratch.
+
+Hit/miss counters are exported through the obs block conventions
+(`registry_block()` — one flat JSON-able dict, like
+`engine_metrics_block`/`audit_block`).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.harness import enable_persistent_cache
+from .spec import ScenarioSpec
+
+
+class CompileRegistry:
+    """See module docstring.  Thread-compat: `chunk_fn` is called under
+    the scheduler's lock; the jitted callables themselves are safe to
+    call concurrently."""
+
+    def __init__(self, persistent: bool = True):
+        self.cache_dir = enable_persistent_cache() if persistent else None
+        self._programs: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- lookup
+
+    def chunk_fn(self, spec: ScenarioSpec, plane: str | None = None,
+                 proto=None):
+        """The jitted chunk program for a RESOLVED spec (superstep int —
+        `ScenarioSpec.validate` output) and one obs plane (None = the
+        plain uninstrumented engine).  `proto` lets a caller that has
+        already built the spec's protocol (the scheduler builds one per
+        GROUP) share it — construction is heavy host work at tier-2
+        sizes, so a cold multi-plane build must not repeat it.
+
+        Return convention follows the engine builders: ``(nets, ps)``
+        plain, ``(nets, ps, stats)`` fast-forward, with the plane's
+        carry appended last when a plane is on — callers index
+        ``out[0], out[1], out[-1]``."""
+        if not isinstance(spec.superstep, int):
+            raise ValueError("chunk_fn needs a resolved spec "
+                             "(ScenarioSpec.validate() output): "
+                             f"superstep={spec.superstep!r}")
+        key = (spec.compile_key(), plane)
+        fn = self._programs.get(key)
+        if fn is not None:
+            self.hits += 1
+            return fn
+        self.misses += 1
+        fn = self._build(spec, plane, proto=proto)
+        self._programs[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ builders
+
+    def _build(self, spec: ScenarioSpec, plane: str | None, proto=None):
+        proto = proto if proto is not None else spec.build_protocol()
+        ms, k, eng = spec.chunk_ms, spec.superstep, spec.engine
+        if plane is None:
+            from ..core.network import fast_forward_chunk, scan_chunk
+            if eng == "batched":
+                from ..core.batched import scan_chunk_batched
+                base = scan_chunk_batched(proto, ms, superstep=k)
+            elif eng == "fast_forward":
+                base = fast_forward_chunk(proto, ms, seed_axis=True,
+                                          superstep=k)
+            else:
+                base = jax.vmap(scan_chunk(proto, ms, superstep=k))
+        elif plane == "metrics":
+            from ..obs.engine import (fast_forward_chunk_metrics,
+                                      scan_chunk_batched_metrics,
+                                      scan_chunk_metrics)
+            from ..obs.spec import MetricsSpec
+            mspec = MetricsSpec(stat_each_ms=spec.stat_each_ms)
+            if eng == "batched":
+                base = scan_chunk_batched_metrics(proto, ms, mspec,
+                                                  superstep=k)
+            elif eng == "fast_forward":
+                base = fast_forward_chunk_metrics(proto, ms, mspec,
+                                                  seed_axis=True,
+                                                  superstep=k)
+            else:
+                base = jax.vmap(scan_chunk_metrics(proto, ms, mspec,
+                                                   superstep=k))
+        elif plane == "trace":
+            from ..obs.trace import (TraceSpec, fast_forward_chunk_trace,
+                                     scan_chunk_batched_trace,
+                                     scan_chunk_trace)
+            tspec = TraceSpec(capacity=spec.trace_capacity)
+            if eng == "batched":
+                base = scan_chunk_batched_trace(proto, ms, tspec,
+                                                superstep=k)
+            elif eng == "fast_forward":
+                base = fast_forward_chunk_trace(proto, ms, tspec,
+                                                seed_axis=True,
+                                                superstep=k)
+            else:
+                base = jax.vmap(scan_chunk_trace(proto, ms, tspec,
+                                                 superstep=k))
+        elif plane == "audit":
+            from ..obs.audit import (AuditSpec, fast_forward_chunk_audit,
+                                     scan_chunk_audit,
+                                     scan_chunk_batched_audit)
+            aspec = AuditSpec()
+            if eng == "batched":
+                base = scan_chunk_batched_audit(proto, ms, aspec,
+                                                superstep=k)
+            elif eng == "fast_forward":
+                base = fast_forward_chunk_audit(proto, ms, aspec,
+                                                seed_axis=True,
+                                                superstep=k)
+            else:
+                base = jax.vmap(scan_chunk_audit(proto, ms, aspec,
+                                                 superstep=k))
+        else:
+            raise ValueError(f"unknown obs plane {plane!r}; known: "
+                             "metrics trace audit (or None)")
+        return jax.jit(base)
+
+    # ------------------------------------------------------------- export
+
+    def stats(self) -> dict:
+        return {"entries": len(self._programs), "hits": self.hits,
+                "misses": self.misses,
+                "persistent_cache": self.cache_dir or "off"}
+
+    def registry_block(self, extra: dict | None = None) -> dict:
+        """The ``registry`` block for bench JSON / service status
+        (schema: BENCH_NOTES.md r11) — the warm/cold story of every
+        submit, in the same one-flat-dict convention as
+        `engine_metrics_block`."""
+        out = self.stats()
+        if extra:
+            out.update(extra)
+        return out

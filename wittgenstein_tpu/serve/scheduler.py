@@ -1,0 +1,456 @@
+"""Coalescing scheduler — many requests, few device programs.
+
+Requests whose specs share a `compile_key()` are the same compiled
+chunk program over different DATA (seeds, partitions, spans).  The
+scheduler exploits that: pending compatible requests are grouped and
+run as ONE vmapped seed-batched program, one chunk at a time, with
+continuous seed batching — on the `vmapped` engine, a compatible
+request submitted while a group is in flight joins at the next chunk
+boundary (freshly-initialized lanes concatenate onto the batch; each
+lane carries its own clock, so mixed entry times are sound for the
+per-lane dense engine).  The `batched` and `fast_forward` engines
+assume LOCKSTEP times across the batch (one fused mailbox / one shared
+jump), so their groups close at launch and later arrivals form the
+next group.
+
+Per chunk the scheduler advances state with the PRIMARY pass (the
+metrics-instrumented engine when the spec captures metrics — that is
+what streams progress — else the plain engine) and runs any remaining
+obs planes as SHADOW passes from the same entry state: every plane is
+bit-identical on the trajectory (tests/test_obs.py, test_trace.py,
+test_audit.py), so the shadows describe exactly the run that advanced.
+
+Each finished request gets per-request artifacts (ProgressPerTime-style
+`engine_metrics` block, `trace` block, `audit` block, final-state
+summary) and ONE `RunManifest` ledger row whose `config_digest` is the
+spec digest (obs/ledger.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import CompileRegistry
+from .spec import ScenarioSpec
+
+#: request lifecycle states
+STATUSES = ("queued", "running", "done", "error")
+
+
+@dataclasses.dataclass
+class Request:
+    """One submitted scenario (scheduler-internal mutable record)."""
+
+    id: str
+    spec: ScenarioSpec              # RESOLVED (validate() output)
+    compile_key: str
+    #: the spec AS SUBMITTED (e.g. superstep="auto" before resolution)
+    #: — provenance digests THIS one, like bench/bench_suite digest
+    #: their requested configs, so a client correlating by its own
+    #: spec digest always matches the ledger row
+    requested: ScenarioSpec | None = None
+    status: str = "queued"
+    submitted: float = dataclasses.field(default_factory=time.time)
+    started: float | None = None
+    finished: float | None = None
+    progress_ms: int = 0
+    progress: dict = dataclasses.field(default_factory=dict)
+    error: str | None = None
+    artifacts: dict | None = None
+    #: final (net, pstate) slices, seed axis kept — in-process consumers
+    final_state: tuple | None = None
+    manifest_path: str | None = None
+    #: EngineConfig captured at lane init (protocol construction is
+    #: heavy host work at tier-2 sizes — never rebuilt just for .cfg)
+    cfg: object = None
+
+    def status_json(self) -> dict:
+        out = {"id": self.id, "status": self.status,
+               "compile_key": self.compile_key,
+               "progress_ms": self.progress_ms,
+               "sim_ms": self.spec.sim_ms}
+        if self.progress:
+            out["progress"] = dict(self.progress)
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class _Lane:
+    """One request's slice of the running batch."""
+
+    def __init__(self, req: Request):
+        self.req = req
+        self.width = len(req.spec.seeds)
+        self.remaining = req.spec.sim_ms // req.spec.chunk_ms
+        self.carries: dict = {}     # plane -> [per-chunk carry slices]
+
+    def stash(self, plane: str, carry, lo: int):
+        sl = jax.tree.map(lambda x: x[lo:lo + self.width], carry)
+        self.carries.setdefault(plane, []).append(sl)
+
+
+class Scheduler:
+    """See module docstring.  Thread-compat: `submit`/`request`/
+    `status` are safe from any thread; `run_pending` drains from one
+    thread at a time (a second concurrent call returns immediately)."""
+
+    def __init__(self, registry: CompileRegistry | None = None,
+                 ledger_path=None, on_boundary=None, keep_done: int = 256):
+        self.registry = registry or CompileRegistry()
+        self.ledger_path = ledger_path      # None = the shared default
+        #: test/ops hook: called at every chunk boundary of a running
+        #: group, BEFORE admission — a callback may `submit()` and see
+        #: its request join this group (the continuous-batching pin)
+        self.on_boundary = on_boundary
+        #: finished-request retention bound: a long-lived service must
+        #: not pin every past request's final-state device arrays —
+        #: beyond this many done/errored records the OLDEST are evicted
+        #: (their ledger row is the durable artifact; status() then
+        #: answers unknown).  0 = unbounded (tests, short-lived tools).
+        self.keep_done = int(keep_done)
+        self._mu = threading.RLock()
+        self._requests: dict[str, Request] = {}
+        self._queue: list[str] = []         # FIFO of queued request ids
+        self._n = 0
+        self._draining = False
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, spec: ScenarioSpec) -> str:
+        """Validate (raises `ValueError` with remedy text — the HTTP
+        layer's 400) and enqueue; returns the request id."""
+        resolved = spec.validate()
+        key = resolved.compile_key()
+        with self._mu:
+            self._n += 1
+            rid = f"r{self._n:04d}"
+            self._requests[rid] = Request(id=rid, spec=resolved,
+                                          compile_key=key,
+                                          requested=spec)
+            self._queue.append(rid)
+        return rid
+
+    def request(self, rid: str) -> Request:
+        with self._mu:
+            if rid not in self._requests:
+                raise KeyError(f"unknown request {rid!r}")
+            return self._requests[rid]
+
+    def pending(self) -> list:
+        with self._mu:
+            return list(self._queue)
+
+    # -------------------------------------------------------------- drain
+
+    def run_pending(self) -> dict:
+        """Drain the queue: group compatible requests, run each group.
+        Returns ``{"processed": N, "registry": stats}``."""
+        with self._mu:
+            if self._draining:
+                return {"processed": 0, "registry": self.registry.stats()}
+            self._draining = True
+        processed = 0
+        try:
+            while True:
+                with self._mu:
+                    head = next((r for r in self._queue), None)
+                if head is None:
+                    break
+                key = self._requests[head].compile_key
+                try:
+                    processed += self._run_group(key)
+                except Exception as e:      # noqa: BLE001 — a broken
+                    # group must not wedge the whole queue
+                    self._fail_group(key, e)
+        finally:
+            with self._mu:
+                self._draining = False
+        return {"processed": processed, "registry": self.registry.stats()}
+
+    def _fail_group(self, key: str, e: Exception):
+        """Mark every unfinished request of this compile key errored —
+        including ones already popped from the queue but not yet
+        marked running (a group that dies in lane init)."""
+        msg = f"{type(e).__name__}: {e!s:.500}"
+        with self._mu:
+            for req in self._requests.values():
+                if req.compile_key == key and req.status in ("queued",
+                                                             "running"):
+                    if req.id in self._queue:
+                        self._queue.remove(req.id)
+                    req.status, req.error = "error", msg
+
+    # ----------------------------------------------------------- grouping
+
+    def _take_compatible(self, key: str) -> list:
+        """Pop every queued request with this compile key (FIFO order)."""
+        with self._mu:
+            taken = [rid for rid in self._queue
+                     if self._requests[rid].compile_key == key]
+            for rid in taken:
+                self._queue.remove(rid)
+            return [self._requests[rid] for rid in taken]
+
+    def _init_lanes(self, reqs: list, proto):
+        """Fresh state for each request's seeds (+ partition applied —
+        data, not program), concatenated along the seed axis.  `proto`
+        is the GROUP's shared protocol instance: requests in a group
+        have equal compile keys, hence equal protocol/params — one
+        construction serves them all (heavy host work at tier-2
+        sizes)."""
+        states = []
+        for req in reqs:
+            spec = req.spec
+            req.cfg = proto.cfg
+            seeds = jnp.asarray(spec.seeds, jnp.int32)
+            nets, ps = jax.vmap(proto.init)(seeds)
+            if spec.partition:
+                idx = jnp.asarray(spec.partition, jnp.int32)
+                nodes = nets.nodes
+                nets = nets.replace(nodes=nodes.replace(
+                    down=nodes.down.at[:, idx].set(True)))
+            k = spec.superstep
+            if k > 1:
+                t = np.asarray(jax.device_get(nets.time)).reshape(-1)
+                if (t % k).any():
+                    raise ValueError(
+                        f"request {req.id}: {spec.protocol}.init enters "
+                        f"at time(s) {sorted(set(t.tolist()))}, not "
+                        f"multiples of superstep={k} — the fused window "
+                        "contract needs a K-aligned entry. Fix: "
+                        "superstep=1 (or 'auto') for this protocol")
+            states.append((nets, ps))
+        return states
+
+    @staticmethod
+    def _concat(states: list):
+        if len(states) == 1:
+            return states[0]
+        return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                            *states)
+
+    @staticmethod
+    def _take_lanes(state, idx):
+        idx = jnp.asarray(idx, jnp.int32)
+        return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), state)
+
+    # ------------------------------------------------------------ the run
+
+    def _run_group(self, key: str) -> int:
+        reqs = self._take_compatible(key)
+        if not reqs:
+            return 0
+        spec0 = reqs[0].spec
+        planes = list(spec0.obs)
+        primary = "metrics" if "metrics" in planes else None
+        shadows = [p for p in planes if p != primary]
+        # Lockstep engines (one fused mailbox / one shared jump over the
+        # whole batch) close admission at launch; the per-lane dense
+        # engine admits late joiners at every chunk boundary.
+        admit_inflight = spec0.engine == "vmapped"
+        lanes = [_Lane(r) for r in reqs]
+        proto0 = spec0.build_protocol()     # ONE construction per group
+        state = self._concat(self._init_lanes(reqs, proto0))
+        now = time.time()
+        with self._mu:
+            for r in reqs:
+                r.status, r.started = "running", now
+        ff_stats = {"skipped_ms": 0, "jump_count": 0}
+        done = 0
+        # One registry lookup per plane per GROUP (the programs are
+        # constant across chunks) — hit/miss counters then reflect
+        # warm/cold submits, not chunk counts.
+        fn = self.registry.chunk_fn(spec0, primary, proto=proto0)
+        shadow_fns = [(p, self.registry.chunk_fn(spec0, p, proto=proto0))
+                      for p in shadows]
+        while lanes:
+            entry = state
+            out = fn(*entry)
+            state = (out[0], out[1])
+            if spec0.engine == "fast_forward":
+                st = out[2]
+                ff_stats["skipped_ms"] += int(np.asarray(
+                    jax.device_get(st["skipped_ms"])).reshape(-1)[0])
+                ff_stats["jump_count"] += int(np.asarray(
+                    jax.device_get(st["jump_count"])).reshape(-1)[0])
+            offsets = np.cumsum([0] + [ln.width for ln in lanes])
+            if primary is not None:
+                for ln, lo in zip(lanes, offsets):
+                    ln.stash(primary, out[-1], int(lo))
+            for plane, sfn in shadow_fns:
+                sout = sfn(*entry)
+                for ln, lo in zip(lanes, offsets):
+                    ln.stash(plane, sout[-1], int(lo))
+            # snapshots force a device sync — compute them OUTSIDE the
+            # lock (lane fields are drain-thread-private; only the
+            # request records need the lock) so submit/status threads
+            # never stall on a chunk's device_get
+            updates = []
+            for ln in lanes:
+                ln.remaining -= 1
+                t_ms = ln.req.progress_ms + spec0.chunk_ms
+                updates.append((ln.req, t_ms, self._snapshot(ln, t_ms)))
+            with self._mu:
+                for req, t_ms, snap in updates:
+                    req.progress_ms = t_ms
+                    req.progress = snap
+            finished = [ln for ln in lanes if ln.remaining == 0]
+            if finished:
+                for ln, lo in zip(lanes, offsets):
+                    if ln.remaining == 0:
+                        final = jax.tree.map(
+                            lambda x, lo=int(lo), w=ln.width: x[lo:lo + w],
+                            state)
+                        self._finalize(ln, final,
+                                       ff_stats if spec0.engine ==
+                                       "fast_forward" else None)
+                done += len(finished)
+                keep = [i for s, ln in zip(offsets, lanes)
+                        if ln.remaining > 0
+                        for i in range(int(s), int(s) + ln.width)]
+                lanes = [ln for ln in lanes if ln.remaining > 0]
+                if lanes:
+                    state = self._take_lanes(state, keep)
+            if self.on_boundary is not None:
+                self.on_boundary()
+            if admit_inflight:
+                joiners = self._take_compatible(key)
+                if joiners:
+                    now = time.time()
+                    with self._mu:
+                        for r in joiners:
+                            r.status, r.started = "running", now
+                    new = self._init_lanes(joiners, proto0)
+                    state = self._concat(
+                        ([state] if lanes else []) + new)
+                    lanes.extend(_Lane(r) for r in joiners)
+        return done
+
+    # ------------------------------------------------------- per-request
+
+    def _snapshot(self, ln: _Lane, t_ms: int) -> dict:
+        """Streaming-progress snapshot from the LAST metrics carry (the
+        on-device metrics plane is what status() streams); falls back
+        to the clock alone when metrics are off.  Forces a device sync
+        — callers run it outside the scheduler lock."""
+        snap = {"t_ms": t_ms, "sim_ms": ln.req.spec.sim_ms}
+        carries = ln.carries.get("metrics")
+        if carries:
+            from ..obs.export import MetricsFrame
+            from ..obs.spec import MetricsSpec
+            mspec = MetricsSpec(stat_each_ms=ln.req.spec.stat_each_ms)
+            totals = MetricsFrame.from_carry(mspec, carries[-1]).totals()
+            for name in ("done_count", "live_count", "msg_sent",
+                         "drop_count"):
+                if name in totals:
+                    snap[name] = totals[name]
+        return snap
+
+    def _finalize(self, ln: _Lane, final_state, ff_stats):
+        req, spec = ln.req, ln.req.spec
+        proto_cfg = req.cfg
+        requested = req.requested or spec
+        art = {"request": req.id, "compile_key": req.compile_key,
+               "spec_digest": requested.digest(),
+               "spec": requested.to_json(),
+               "seeds": list(spec.seeds), "sim_ms": spec.sim_ms,
+               "engine": spec.engine, "superstep": spec.superstep}
+        nodes = final_state[0].nodes
+        down = np.asarray(nodes.down)
+        done_at = np.asarray(nodes.done_at)
+        art["summary"] = {
+            "done_count": int(((done_at > 0) & ~down).sum()),
+            "live_count": int((~down).sum()),
+            "msg_sent": int(np.asarray(nodes.msg_sent).sum()),
+            "msg_received": int(np.asarray(nodes.msg_received).sum()),
+        }
+        if ff_stats is not None:
+            art["fast_forward"] = dict(ff_stats)    # group-level skips
+        line = {"metric": f"serve_{req.id}", "sim_ms": spec.sim_ms,
+                "superstep": spec.superstep, "batch": len(spec.seeds)}
+        if "metrics" in ln.carries:
+            from ..obs.export import MetricsFrame, engine_metrics_block
+            from ..obs.spec import MetricsSpec
+            mspec = MetricsSpec(stat_each_ms=spec.stat_each_ms)
+            frame = MetricsFrame.from_carries(mspec, ln.carries["metrics"])
+            art["engine_metrics"] = engine_metrics_block(
+                frame, extra={"metrics_seeds": len(spec.seeds)})
+            line["engine_metrics"] = art["engine_metrics"]
+        if "trace" in ln.carries:
+            from ..obs.decode import TraceFrame, trace_block
+            from ..obs.trace import TraceSpec
+            tspec = TraceSpec(capacity=spec.trace_capacity)
+            tframe = TraceFrame.from_carries(tspec, ln.carries["trace"])
+            art["trace"] = trace_block(tframe,
+                                       extra={"trace_seeds":
+                                              len(spec.seeds)})
+            line["trace"] = art["trace"]
+        if "audit" in ln.carries:
+            from ..obs.audit import AuditSpec, monitored_invariants
+            from ..obs.audit_report import AuditReport, audit_block
+            aspec = AuditSpec()
+            report = AuditReport.from_carries(
+                aspec, ln.carries["audit"],
+                monitored=monitored_invariants(aspec, proto_cfg))
+            art["audit"] = audit_block(report,
+                                       extra={"audit_seeds":
+                                              len(spec.seeds)})
+            line["audit"] = art["audit"]
+            if not report.clean:
+                import sys
+                print(f"serve: AUDIT VIOLATIONS in request {req.id}:\n"
+                      f"{report.format()}", file=sys.stderr)
+        now = time.time()
+        wall = now - (req.started or now)
+        line["wall_total_s"] = round(wall, 3)
+        path = self._append_ledger(req, line)
+        art["wall_s"] = round(wall, 3)
+        art["registry"] = self.registry.stats()
+        with self._mu:
+            req.artifacts = art
+            req.final_state = final_state
+            req.finished = now
+            req.manifest_path = path
+            req.progress_ms = spec.sim_ms
+            req.status = "done"
+            self._evict_old_done()
+
+    def _evict_old_done(self):
+        """Drop the oldest finished records past `keep_done` (caller
+        holds the lock).  Their ledger rows remain the durable
+        artifact; in-memory final_state/artifacts are what must not
+        accumulate in a long-lived service."""
+        if not self.keep_done:
+            return
+        done = sorted((r for r in self._requests.values()
+                       if r.status in ("done", "error")),
+                      key=lambda r: r.finished or r.submitted)
+        for victim in done[:max(0, len(done) - self.keep_done)]:
+            del self._requests[victim.id]
+
+    def _append_ledger(self, req: Request, line: dict) -> str | None:
+        """One `RunManifest` row per request; the config digest IS the
+        AS-SUBMITTED spec digest (the PR-6 ledger's promised
+        ScenarioSpec hookup — bench and the suite digest their
+        requested configs too, so rows correlate across all three).
+        The line's own engine/superstep fields carry the resolved
+        dispatch.  Never raises — provenance must not fail a finished
+        request."""
+        from ..obs import ledger
+        try:
+            mani = ledger.manifest_from_spec(
+                line, req.requested or req.spec,
+                label=f"serve:{req.id}", compile_key=req.compile_key)
+            return ledger.append(mani, self.ledger_path)
+        except Exception as e:      # noqa: BLE001 — provenance only
+            import sys
+            print(f"serve: ledger append failed for {req.id}: "
+                  f"{type(e).__name__}: {e!s:.200}", file=sys.stderr)
+            return None

@@ -1,0 +1,102 @@
+"""`Service` — the submit/status/result surface of the request plane.
+
+Transport-agnostic like `server/core.Server`: the HTTP layer
+(`server/http.py` `/w/batch/*`) and in-process callers (tests,
+`tools/serve_bench.py`, the bench_suite `serve_smoke` stage) drive the
+same object.  JSON in, JSON out:
+
+  submit(spec_json)  -> {"id", "status", "compile_key"}; a bad spec
+                        raises ValueError with remedy text (the HTTP
+                        layer's 400)
+  status(id)         -> lifecycle + the streaming-progress snapshot the
+                        scheduler refreshes from the on-device metrics
+                        plane at every chunk boundary
+  result(id)         -> the finished request's artifacts (engine_metrics
+                        / trace / audit blocks, summary, manifest path);
+                        a not-yet-done request answers with its status
+                        instead of an error (poll-friendly)
+  registry_stats()   -> compile-registry warm/cold counters
+
+``auto=True`` (the server default) drains the queue on a background
+worker thread, so submit returns immediately and status streams; with
+``auto=False`` (tests, benchmarks) the caller drains explicitly via
+`run_pending()` for deterministic scheduling.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .scheduler import Scheduler
+from .spec import ScenarioSpec
+
+
+class Service:
+    def __init__(self, scheduler: Scheduler | None = None,
+                 auto: bool = True):
+        self.scheduler = scheduler or Scheduler()
+        self._auto = auto
+        self._wake = threading.Event()
+        self._stop = False
+        self._worker = None
+
+    # ------------------------------------------------------------ worker
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._drain_loop,
+                                            daemon=True,
+                                            name="wtpu-serve-worker")
+            self._worker.start()
+
+    def _drain_loop(self):
+        while not self._stop:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stop:
+                return
+            if self.scheduler.pending():
+                self.scheduler.run_pending()
+
+    def close(self):
+        self._stop = True
+        self._wake.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+
+    # --------------------------------------------------------- endpoints
+
+    def submit(self, body: dict) -> dict:
+        """POST /w/batch/submit — body is a `ScenarioSpec` JSON object."""
+        spec = ScenarioSpec.from_json(body or {})
+        rid = self.scheduler.submit(spec)
+        if self._auto:
+            self._ensure_worker()
+            self._wake.set()
+        req = self.scheduler.request(rid)
+        return {"id": rid, "status": req.status,
+                "compile_key": req.compile_key}
+
+    def status(self, rid: str) -> dict:
+        """GET /w/batch/status/{id}."""
+        return self.scheduler.request(rid).status_json()
+
+    def result(self, rid: str) -> dict:
+        """GET /w/batch/result/{id} — artifacts when done, else the
+        status snapshot (poll until ``"status" == "done"``)."""
+        req = self.scheduler.request(rid)
+        if req.status != "done":
+            return req.status_json()
+        out = dict(req.artifacts)
+        out["status"] = "done"
+        if req.manifest_path:
+            out["manifest_path"] = req.manifest_path
+        return out
+
+    def run_pending(self) -> dict:
+        """POST /w/batch/run — synchronous drain (manual mode / ops)."""
+        return self.scheduler.run_pending()
+
+    def registry_stats(self) -> dict:
+        """GET /w/batch/registry."""
+        return self.scheduler.registry.registry_block()

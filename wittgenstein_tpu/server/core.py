@@ -53,6 +53,31 @@ def protocol_parameters(name: str) -> dict:
     return out
 
 
+def validate_parameters(name: str, params: dict | None):
+    """THE parameter gate: `protocol_parameters`'s template is the
+    single source for what a request may pass — an unknown kwarg is
+    refused here with the template echoed (the HTTP layer surfaces it
+    as a 400), instead of surfacing as a deep `TypeError` from the
+    protocol constructor.  Returns the protocol class on success.
+    `serve.spec.ScenarioSpec.validate` routes through the same gate, so
+    the interactive server and the batch plane agree on what a valid
+    parameter set is."""
+    import json
+
+    try:
+        cls = get_protocol(name)
+    except KeyError as e:
+        raise ValueError(str(e)) from None
+    template = protocol_parameters(name)
+    unknown = sorted(set(params or {}) - set(template))
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {unknown} for {name}; the template "
+            f"(GET /w/protocols/{name}) is: "
+            f"{json.dumps(template, sort_keys=True, default=str)}")
+    return cls
+
+
 class Server:
     """Mirrors wserver/Server.java's surface, state-pytree edition."""
 
@@ -67,7 +92,7 @@ class Server:
     # ---- lifecycle (IServer.init / runMs) ----
 
     def init(self, name: str, params: dict | None = None, seed: int = 0):
-        cls = get_protocol(name)
+        cls = validate_parameters(name, params)
         self.protocol = cls(**(params or {}))
         self.protocol_name = name
         self.net, self.pstate = self.protocol.init(seed)

@@ -21,6 +21,16 @@ role is played by ThreadingHTTPServer):
                                            EnvelopeInfo list, replies []
                                            (ws/ExternalWS.java:21-40)
 
+Batch request plane (wittgenstein_tpu/serve — README "Simulation as a
+service"; spec schema in serve/spec.py):
+
+    POST /w/batch/submit                   body: ScenarioSpec JSON ->
+                                           {"id", "status", "compile_key"}
+    GET  /w/batch/status/{id}              lifecycle + streaming progress
+    GET  /w/batch/result/{id}              artifacts when done
+    POST /w/batch/run                      manual queue drain
+    GET  /w/batch/registry                 compile-registry hit/miss
+
 Run: python -m wittgenstein_tpu.server.http [port]
 """
 
@@ -92,15 +102,42 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/w/network/send$",
          lambda s, m, b: s.srv.send(b["from"], b["to"], b.get("payload"),
                                     b.get("delay", 0))),
+        # ---- batch request plane (wittgenstein_tpu/serve): many
+        # scenario requests coalesced into few compiled device programs;
+        # spec schema in serve/spec.py (README "Simulation as a
+        # service").  These routes NEVER take the interactive sim lock —
+        # the Service locks its own scheduler, and a multi-second batch
+        # run must not block /w/network/* (nor vice versa).
+        ("POST", r"^/w/batch/submit$",
+         lambda s, m, b: s.batch.submit(b or {})),
+        ("GET", r"^/w/batch/status/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.status(m.group(1))),
+        ("GET", r"^/w/batch/result/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.result(m.group(1))),
+        ("POST", r"^/w/batch/run$",
+         lambda s, m, b: s.batch.run_pending()),
+        ("GET", r"^/w/batch/registry$",
+         lambda s, m, b: s.batch.registry_stats()),
     ]
 
     # Routes that must NOT take the sim lock (keyed by the ROUTES pattern,
     # so a route rename keeps its exemption).
-    NO_LOCK_PATTERNS = frozenset({r"^/w/external_sink$"})
+    NO_LOCK_PATTERNS = frozenset({
+        r"^/w/external_sink$",
+        r"^/w/batch/submit$",
+        r"^/w/batch/status/([A-Za-z0-9_-]+)$",
+        r"^/w/batch/result/([A-Za-z0-9_-]+)$",
+        r"^/w/batch/run$",
+        r"^/w/batch/registry$",
+    })
 
     @property
     def srv(self) -> core.Server:
         return self.server.sim_server
+
+    @property
+    def batch(self):
+        return self.server.batch_service
 
     def _external_sink(self, body):
         """Dummy external node (ExternalWS.java:21-40): print, reply []."""
@@ -111,7 +148,14 @@ class _Handler(BaseHTTPRequestHandler):
         body = None
         ln = int(self.headers.get("Content-Length") or 0)
         if ln:
-            body = json.loads(self.rfile.read(ln) or b"{}")
+            raw = self.rfile.read(ln) or b"{}"
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                # surface as a 400, not a closed socket: the batch
+                # plane's clients hand-author nontrivial JSON bodies
+                self._reply(400, {"error": f"malformed JSON body: {e}"})
+                return
         for meth, pattern, fn in self.ROUTES:
             if meth != method:
                 continue
@@ -154,10 +198,17 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def make_server(port: int = 0) -> ThreadingHTTPServer:
+def make_server(port: int = 0,
+                batch_auto: bool = True) -> ThreadingHTTPServer:
+    """`batch_auto=False` gives a manual-drain batch service (POST
+    /w/batch/run runs the queue) — deterministic for tests; the default
+    drains on a background worker so submits return immediately."""
+    from ..serve import Service
+
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     httpd.sim_server = core.Server()
     httpd.sim_lock = threading.Lock()
+    httpd.batch_service = Service(auto=batch_auto)
     return httpd
 
 
