@@ -33,6 +33,12 @@ pass with the compiled conservation-law monitors ON; a violated
 verdict is loud in the block AND on stderr); WTPU_AUDIT=0 skips it.
 WTPU_LEDGER=0 skips the per-line `RunManifest` provenance row appended
 under reports/ledger/ (obs/ledger.py; schema in BENCH_NOTES.md r10).
+WTPU_PALLAS_ROUTE=1 swaps the mailbox-ring sort/scatter binning for the
+fused Pallas routing megakernel (ops/pallas_route.py — bit-identical,
+interpret mode on CPU); every line records `route_kernel` (xla|pallas)
+plus the measured `sort_ops_per_sim_ms`/`scatter_ops_per_sim_ms` of the
+compiled chunk (WTPU_ROUTE_STATS=0 skips the count; schema in
+BENCH_NOTES.md r12).
 The WTPU_* scenario knobs are captured as ONE `ScenarioSpec`
 (wittgenstein_tpu/serve/spec.py — the request plane's config object);
 main() reads its knobs back out of the spec and the ledger row's
@@ -258,8 +264,45 @@ def _maybe_engine_audit(res, proto, total_ms, fast_forward=False):
     return res
 
 
+def _route_stats(base, init, eff_ss, engine):
+    """`route_kernel` (xla|pallas) + the MEASURED sort/scatter ops per
+    simulated ms of the compiled chunk, for the JSON metric line —
+    the number the `superstep_amortization` analysis rule ratchets,
+    read off the program the bench actually runs (post-optimization
+    HLO scan bodies, counted by the rule's own parser).  With the
+    Pallas routing megakernel ON (`WTPU_PALLAS_ROUTE=1`) the counts
+    drop to ~0: the binning lives inside one custom call.  The AOT
+    lowering compiles the same program the timed reps use (persistent
+    cache makes the second compile ~free); `WTPU_ROUTE_STATS=0`
+    skips.  Never raises — a failed count reports itself in the
+    line."""
+    from wittgenstein_tpu.ops.pallas_route import route_enabled
+    out = {"route_kernel": "pallas" if route_enabled() else "xla"}
+    if os.environ.get("WTPU_ROUTE_STATS", "1") == "0":
+        return out
+    try:
+        import types
+
+        from wittgenstein_tpu.analysis import hlo as _hlo
+        from wittgenstein_tpu.analysis import rules_superstep as _rs
+        shapes = jax.eval_shape(init)
+        txt = jax.jit(base).lower(*shapes).compile().as_text()
+        if _hlo.scan_bodies(txt):
+            tgt = types.SimpleNamespace(hlo_text=txt,
+                                        ms_per_iter=max(1, eff_ss),
+                                        engine=engine)
+            m = _rs.measure(tgt)
+            out["sort_ops_per_sim_ms"] = m["sort_ops_per_ms"]
+            out["scatter_ops_per_sim_ms"] = m["scatter_ops_per_ms"]
+    except Exception as e:      # noqa: BLE001 — the bench line must emit
+        print(f"bench: route-stats lowering failed: {type(e).__name__}: "
+              f"{e!s:.300}", file=sys.stderr)
+        out["route_stats_error"] = f"{type(e).__name__}: {e!s:.200}"
+    return out
+
+
 def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
-                  superstep, box_split=1):
+                  superstep, box_split=1, route_stats=False):
     """Build the benchmark's (step, init, steps, check, proto,
     superstep, engine) tuple for the reference default Handel scenario
     — `engine` names the dispatch actually taken ("batched" /
@@ -427,7 +470,9 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
         assert evicted == 0   # queue never overflowed
         return {}
 
-    return step, init, steps, check, proto, superstep, engine
+    rstats = (_route_stats(base, init, superstep, engine)
+              if route_stats else {})
+    return step, init, steps, check, proto, superstep, engine, rstats
 
 
 def _fixed_cost_estimate(n, seeds, chunk, mode, horizon, inbox_cap,
@@ -462,7 +507,7 @@ def _fixed_cost_estimate(n, seeds, chunk, mode, horizon, inbox_cap,
     try:
         cost_us = {}
         for ss in (1, eff_ss):
-            step, init, _, _, _, _, _ = _handel_setup(
+            step, init, _, _, _, _, _, _ = _handel_setup(
                 n, seeds, 2 * chunk, chunk, mode, horizon, inbox_cap, ss,
                 box_split=box_split)
             r = timed_chunks(step, init, 2, seeds, chunk,
@@ -499,13 +544,14 @@ def bench_handel(n=2048, seeds=8, sim_ms=1000, chunk=200, mode="exact",
     Returns a result dict (rate + provenance), not a bare float.
     """
     from wittgenstein_tpu.utils.measure import timed_chunks
-    step, init, steps, check, proto, eff_ss, engine = _handel_setup(
-        n, seeds, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
-        box_split=box_split)
+    step, init, steps, check, proto, eff_ss, engine, rstats = \
+        _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
+                      superstep, box_split=box_split, route_stats=True)
     _check_trace_cap(steps * chunk)
     res = timed_chunks(step, init, steps, seeds, chunk, check, reps=reps)
     res["superstep"] = eff_ss
     res["engine"] = engine
+    res.update(rstats)
     res.update(_fixed_cost_estimate(n, seeds, chunk, mode, horizon,
                                     inbox_cap, box_split, eff_ss))
     res.update(_ff_stats(step, steps, chunk))
@@ -532,9 +578,10 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
     import time
     assert total_seeds % seed_batch == 0
     n_batches = total_seeds // seed_batch
-    step, init, steps, check, proto, eff_ss, engine = _handel_setup(
-        n, seed_batch, sim_ms, chunk, mode, horizon, inbox_cap, superstep,
-        box_split=box_split)
+    step, init, steps, check, proto, eff_ss, engine, rstats = \
+        _handel_setup(n, seed_batch, sim_ms, chunk, mode, horizon,
+                      inbox_cap, superstep, box_split=box_split,
+                      route_stats=True)
     _check_trace_cap(steps * chunk)
 
     # compile + warm one chunk
@@ -568,6 +615,7 @@ def bench_handel_microbatched(n=2048, total_seeds=256, seed_batch=16,
         "crosscheck": "per_batch_materialization",
         "superstep": eff_ss,
         "engine": engine,
+        **rstats,
     }
     # All microbatches' chunks (warmup excluded by the tail slice);
     # skip_rate is then the average across the whole seed sweep.
@@ -601,9 +649,21 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     elif proto_name == "dfinity":
         from wittgenstein_tpu.models.dfinity import Dfinity
         proto = Dfinity()
+    elif proto_name == "p2pflood":
+        # Flood-shaped traffic: every live node fans out per ms — the
+        # binning-bound extreme, the routing-megakernel A/B workload
+        # (WTPU_BENCH_LATENCY picks the floor-rich model that licenses
+        # the K ladder; no-self-send floor = the model's).
+        from wittgenstein_tpu.models.p2pflood import P2PFlood
+        kw = {}
+        if os.environ.get("WTPU_BENCH_LATENCY"):
+            kw["network_latency_name"] = os.environ["WTPU_BENCH_LATENCY"]
+        proto = P2PFlood(node_count=n, dead_node_count=n // 10,
+                         peers_count=8, delay_before_resent=1,
+                         delay_between_sends=1, **kw)
     else:
         raise ValueError(f"unknown WTPU_BENCH_PROTO {proto_name!r}; "
-                         "known: handel pingpong dfinity")
+                         "known: handel pingpong dfinity p2pflood")
     # Largest provable K under the requested bound: PingPong and Dfinity
     # both self-send (witness self-pong / committee addressing), so
     # their window caps at the universal K = 2.
@@ -612,12 +672,12 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
         proto, chunk, t0=0,
         max_k=32 if superstep == "auto" else int(superstep))
     if fast_forward:
-        step = _ff_step_wrapper(
-            jax.jit(fast_forward_chunk(proto, chunk, seed_axis=True,
-                                       superstep=eff_ss)))
+        base = fast_forward_chunk(proto, chunk, seed_axis=True,
+                                  superstep=eff_ss)
+        step = _ff_step_wrapper(jax.jit(base))
     else:
-        step = jax.jit(jax.vmap(scan_chunk(proto, chunk,
-                                           superstep=eff_ss)))
+        base = jax.vmap(scan_chunk(proto, chunk, superstep=eff_ss))
+        step = jax.jit(base)
     steps = max(1, -(-sim_ms // chunk))
     _check_trace_cap(steps * chunk)
 
@@ -630,6 +690,8 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
         bc_dropped = int(np.asarray(nets.bc_dropped).sum())
         if proto_name == "pingpong":
             progress = int(np.asarray(ps.pongs).sum())
+        elif proto_name == "p2pflood":
+            progress = int((np.asarray(nets.nodes.done_at) > 0).sum())
         else:
             progress = int(np.asarray(ps.arena.height).max())
         assert progress > 0, f"{proto_name} made no progress"
@@ -641,6 +703,7 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
     res["node_count"] = proto.cfg.n
     res["superstep"] = eff_ss
     res["engine"] = "fast_forward" if fast_forward else "vmapped"
+    res.update(_route_stats(base, init, eff_ss, res["engine"]))
     return _maybe_engine_metrics(res, proto, seeds, steps * chunk,
                                  fast_forward=fast_forward)
 

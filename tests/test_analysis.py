@@ -150,6 +150,27 @@ def test_pick_block_raises_over_budget_at_blk1():
     assert _pick_block(256, _VMEM_BUDGET // 8) == 8
 
 
+def test_pick_block_lint_flags_missing_and_literal(tmp_path, monkeypatch):
+    """The PR-9 lint extension: a `_pick_block` call site may neither
+    omit the row-bytes estimate NOR paste a numeric literal over it —
+    both are the unbudgeted-launch failure mode.  A variable (fed by a
+    named *_row_bytes model) passes; the real ops tree is clean."""
+    from wittgenstein_tpu.analysis import rules_vmem
+
+    fake = tmp_path / "pallas_fake.py"
+    fake.write_text(
+        "def f(m):\n    return _pick_block(m, 12345)\n"
+        "def g(m):\n    return _pick_block(m)\n"
+        "def h(m):\n    return _pick_block(m, row_bytes=99)\n"
+        "def ok(m, row):\n    return _pick_block(m, row)\n")
+    monkeypatch.setattr(rules_vmem, "OPS_DIR", tmp_path)
+    bad = rules_vmem._unbudgeted_pick_block_calls()
+    assert len(bad) == 3
+    assert sum("literal row-bytes" in b for b in bad) == 2
+    monkeypatch.undo()
+    assert rules_vmem._unbudgeted_pick_block_calls() == []
+
+
 def test_dtype_rule_catches_f64_leaf():
     def chunk(x, t):
         return x * 2.0, t + 1

@@ -124,6 +124,53 @@ def test_spec_validation_refuses_with_remedy():
     assert isinstance(_spec(superstep="auto").validate().superstep, int)
 
 
+def test_spec_latency_model_field():
+    """ROADMAP-item-2 leftover: `latency_model` validates against the
+    registered models, folds into the built protocol, and moves the
+    digest AND the compile key (a latency change is a different
+    program)."""
+    base = dict(protocol="Slush",
+                params={"node_count": 64, "rounds": 4, "k": 5},
+                seeds=(0,), sim_ms=240, chunk_ms=120, obs=())
+    # unknown name -> refusal with the registry hint (HTTP 400 via the
+    # service's ValueError mapping)
+    with pytest.raises(ValueError, match="unknown latency_model"):
+        ScenarioSpec(**base, latency_model="NetworkMadeUp").validate()
+    # one latency selection per spec
+    with pytest.raises(ValueError, match="one latency selection"):
+        ScenarioSpec(**dict(base, params={
+            **base["params"],
+            "network_latency_name": "NetworkFixedLatency(4)"}),
+            latency_model="NetworkFixedLatency(4)").validate()
+    # a protocol without the kwarg refuses through the param template
+    with pytest.raises(ValueError, match="network_latency_name"):
+        _spec(latency_model="NetworkFixedLatency(4)").validate()
+    # the happy path folds the model into the constructor
+    sp = ScenarioSpec(**base, latency_model="NetworkFixedLatency(4)")
+    assert repr(sp.validate().build_protocol().latency) == \
+        "NetworkFixedLatency(4)"
+    plain = ScenarioSpec(**base)
+    assert sp.digest() != plain.digest()
+    assert sp.compile_key() != plain.compile_key()
+
+
+def test_spec_route_kernel_program_field():
+    """The WTPU_PALLAS_ROUTE knob as a per-spec program field: unknown
+    values refuse at construction, and the two kernels never share a
+    compile key (a coalesced group must compile the binning it
+    claims)."""
+    with pytest.raises(ValueError, match="route_kernel"):
+        _spec(route_kernel="mosaic")
+    pal = _spec(route_kernel="pallas")
+    assert pal.digest() != _spec().digest()
+    assert pal.compile_key() != _spec().compile_key()
+    assert _spec().route_kernel == "xla"
+    # env capture records the requested kernel
+    assert ScenarioSpec.from_env(
+        env={"WTPU_PALLAS_ROUTE": "1"}).route_kernel == "pallas"
+    assert ScenarioSpec.from_env(env={}).route_kernel == "xla"
+
+
 # ------------------------------------------------------------- registry
 
 

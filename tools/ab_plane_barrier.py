@@ -52,8 +52,8 @@ def main():
         return bench._handel_setup(n, seeds, sim_ms, chunk, "exact",
                                    256, 12, superstep=2)
 
-    step_on, init, steps, check, _, _, _ = build(True)
-    step_off, _, _, _, _, _, _ = build(False)
+    step_on, init, steps, check, _, _, _, _ = build(True)
+    step_off, _, _, _, _, _, _, _ = build(False)
     os.environ.pop("WTPU_PLANE_BARRIER", None)
 
     # Prove the knob reached the compiler: the on/off builds must be

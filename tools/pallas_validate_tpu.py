@@ -1,4 +1,5 @@
-"""On-chip bit-equality validation of the four fused Pallas kernels
+"""On-chip bit-equality validation of the five fused Pallas kernels
+(merge / score / gsf-score / gsf-merge + the PR-9 routing megakernel)
 (real Mosaic lowering — the pytest suite forces the CPU backend, where
 only the interpreter runs, so this is the script that turns
 "bit-equal in interpret mode" into "bit-equal on the chip").
@@ -124,6 +125,53 @@ def main():
         net, ps = Runner(p, donate=False).run_ms(net, ps, 300)
         outs.append(jax.tree.leaves((net, ps)))
     ok &= check("gsf_merge_e2e", outs[0], outs[1])
+
+    # 5. Routing megakernel (PR 9): direct `_bin_into_ring` equality at
+    # a headline-shaped ring (the `route_row_bytes` model's real Mosaic
+    # compile — the r9 half of its validation), then an end-to-end
+    # batched K=4 window pair.
+    from wittgenstein_tpu.core import builders
+    from wittgenstein_tpu.core.batched import scan_chunk_batched
+    from wittgenstein_tpu.core.network import _bin_into_ring
+    from wittgenstein_tpu.core.state import EngineConfig, init_net
+    from wittgenstein_tpu.ops.pallas_route import forced
+    cfg = EngineConfig(n=2048, horizon=256, inbox_cap=12,
+                       payload_words=2, out_deg=8, bcast_slots=0)
+    nodes_r = builders.NodeBuilder().build(0, cfg.n)
+    net_r = init_net(cfg, nodes_r, 0)
+    m = 4096
+    t_r = jnp.asarray(512, jnp.int32)
+    src_r = jnp.asarray(rng.integers(0, cfg.n, m).astype(np.int32))
+    dest_r = jnp.asarray(rng.integers(0, cfg.n, m).astype(np.int32))
+    rel_r = jnp.asarray(rng.integers(1, cfg.horizon - 1, m).astype(
+        np.int32))
+    pay_r = jnp.asarray(rng.integers(0, 1 << 20, (m, 2)).astype(np.int32))
+    size_r = jnp.asarray(rng.integers(1, 99, m).astype(np.int32))
+    valid_r = jnp.asarray(rng.random(m) < 0.8)
+    with forced("xla"):
+        ref_net, ref_drop = _bin_into_ring(cfg, net_r, t_r, src_r, dest_r,
+                                           t_r + rel_r, pay_r, size_r,
+                                           valid_r)
+    with forced("pallas"):
+        got_net, got_drop = _bin_into_ring(cfg, net_r, t_r, src_r, dest_r,
+                                           t_r + rel_r, pay_r, size_r,
+                                           valid_r)
+    ok &= check("route_bin", jax.tree.leaves((ref_net, ref_drop)),
+                jax.tree.leaves((got_net, got_drop)))
+
+    from wittgenstein_tpu.models.handel import Handel as HandelR
+    pr = HandelR(node_count=256, threshold=200, nodes_down=25,
+                 pairing_time=4, dissemination_period_ms=20,
+                 level_wait_time=50, fast_path=10, horizon=64,
+                 network_latency_name="NetworkFixedLatency(16)")
+    sd = jnp.arange(2, dtype=jnp.int32)
+    outs_r = []
+    for kind in ("xla", "pallas"):
+        with forced(kind):
+            fn = jax.jit(scan_chunk_batched(pr, 40, superstep=4))
+            nets_r, ps_r = jax.vmap(pr.init)(sd)
+            outs_r.append(jax.tree.leaves(fn(nets_r, ps_r)))
+    ok &= check("route_e2e_batched_k4", outs_r[0], outs_r[1])
 
     print("PALLAS_VALIDATE_ALL_OK" if ok else "PALLAS_VALIDATE_HAD_FAIL",
           flush=True)

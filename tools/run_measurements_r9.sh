@@ -1,0 +1,84 @@
+#!/bin/bash
+# Round-9 on-chip measurement session — run when .tpu_up appears.
+# ORDER IS THE POINT (VERDICT r4 #2): the official bench number first,
+# then this round's addition (the fused Pallas routing megakernel
+# A/B), then the deferred pallas VMEM cost-model validation carried
+# over from r8 (merge/score/gsf constants + the NEW route_row_bytes
+# model) — the host-side _pick_block gate ships in PR 1/5/9, the
+# on-chip Mosaic compile is the half only this session can do.
+#
+# Usage: nohup bash tools/run_measurements_r9.sh > reports/r9_onchip.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+R=reports
+mkdir -p "$R"
+stamp() { date -u +%H:%M:%S; }
+
+echo "=== r9 on-chip session start $(stamp)"
+
+# 1. OFFICIAL bench, unchanged engine defaults (batched superstep=2,
+#    XLA routing — route_kernel=xla in the line).  Directly comparable
+#    with r8; the new sort_ops_per_sim_ms field records the XLA
+#    baseline the kernel removes.
+echo "--- [1/6] official 2048x16 (xla route baseline) $(stamp)"
+timeout 3600 python bench.py 2>&1 | tee "$R/bench_r9_official.log"
+
+# 2. THE headline A/B: XLA vs Pallas route on the batched Handel
+#    headline at K in {1, 4, 8}.  K=1 isolates the per-ms kernel win;
+#    K=4/8 show what remains once superstep amortization already took
+#    its share (chunk 240 admits every K and keeps phase
+#    specialization on; the fixed-16 latency model licenses K=8).
+#    K=1 runs the vmapped engine (the batched twin is hard-wired to
+#    K>=2) — compare the xla/pallas pair WITHIN each K, not across
+#    engines.  Every line carries route_kernel + sort_ops_per_sim_ms,
+#    so the win is attributable from the JSON alone.
+echo "--- [2/6] route A/B Handel batched headline $(stamp)"
+for K in 1 4 8; do
+  for RK in 0 1; do
+    echo "--- K=$K pallas_route=$RK $(stamp)"
+    WTPU_SUPERSTEP=$K WTPU_BENCH_CHUNK=240 WTPU_PALLAS_ROUTE=$RK \
+      WTPU_BENCH_LATENCY='NetworkFixedLatency(16)' \
+      timeout 3600 python bench.py 2>&1 \
+      | tee "$R/bench_r9_handel_k${K}_route${RK}.log"
+  done
+done
+
+# 3. P2PFlood route A/B (the second acceptance protocol: flood-shaped
+#    traffic, every node fanning out per ms — the binning-bound
+#    extreme).  Quiet-proto bench path, K=4 on the floor-rich model.
+echo "--- [3/6] route A/B P2PFlood $(stamp)"
+for RK in 0 1; do
+  WTPU_BENCH_PROTO=p2pflood WTPU_BENCH_NODES=1024 WTPU_SUPERSTEP=4 \
+    WTPU_BENCH_LATENCY='NetworkFixedLatency(8)' WTPU_PALLAS_ROUTE=$RK \
+    timeout 1800 python bench.py 2>&1 \
+    | tee "$R/bench_r9_p2pflood_route${RK}.log" || true
+done
+
+# 4. Bit-identity ON CHIP (the CPU suite pins interpret mode; this
+#    pins the Mosaic lowering): the divergence bisector must exit 0
+#    for xla-vs-pallas route at the headline shape.
+echo "--- [4/6] route bit-identity bisector on-chip $(stamp)"
+timeout 1800 python tools/divergence.py --proto handel --nodes 2048 \
+  --ms 400 --a superstep=4,batched --b superstep=4,batched,pallas_route \
+  --latency 'NetworkFixedLatency(16)' 2>&1 \
+  | tee "$R/divergence_r9_route.log" || true
+
+# 5. Pallas VMEM cost-model validation — STILL PENDING FROM r8 (the
+#    r8 session never ran on-chip): merge/score/gsf constants PLUS the
+#    new route_row_bytes model.  tools/pallas_validate_tpu.py compiles
+#    the kernels at ladder block sizes and records requested
+#    scoped-vmem vs the named models; a model that underestimates
+#    shows up as a Mosaic OOM the host gate (_pick_block
+#    on_over="warn" leg) predicted would fit.
+echo "--- [5/6] pallas VMEM model validation (r8 backlog + route) $(stamp)"
+timeout 3600 python tools/pallas_validate_tpu.py 2>&1 \
+  | tee "$R/pallas_validate_r9.log"
+
+# 6. Tracked-config suite (serve smoke + audit smoke included) with
+#    the route kernel ON — ring_conservation must stay clean on real
+#    hardware, not just under the interpreter.
+echo "--- [6/6] bench_suite with pallas route $(stamp)"
+WTPU_PALLAS_ROUTE=1 timeout 7200 python tools/bench_suite.py 2>&1 \
+  | tee "$R/bench_suite_r9_route.log"
+
+echo "=== r9 on-chip session done $(stamp)"

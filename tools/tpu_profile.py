@@ -77,7 +77,7 @@ def main():
     superstep = int(os.environ.get("WTPU_BENCH_SUPERSTEP", 2))
     box_split = int(os.environ.get("WTPU_BENCH_BOX_SPLIT", 1))
     chunk = 200
-    step, init, _, _, _, _, _ = _handel_setup(
+    step, init, _, _, _, _, _, _ = _handel_setup(
         n, seeds, 1000, chunk, "exact", 256, 12, superstep,
         box_split=box_split)
 

@@ -38,6 +38,7 @@ def _kernel_models():
     score W = ceil(n/32) sig words."""
     from ..ops.pallas_gsf_merge import gsf_merge_row_bytes
     from ..ops.pallas_merge import merge_row_bytes
+    from ..ops.pallas_route import route_row_bytes
     from ..ops.pallas_score import score_row_bytes
 
     return [
@@ -54,12 +55,28 @@ def _kernel_models():
             (2048, dict(q_cap=16, w=64), "headline-2048n"),
             (32768, dict(q_cap=16, w=1024), "tier2-32k"),
         ]),
+        # routing megakernel: m is the per-sub-plane destination count
+        # (the grid's row axis); rows mirror the bench/test ring shapes
+        ("pallas_route.bin_into_ring_planes", route_row_bytes, [
+            (2048, dict(horizon=256, inbox_cap=12, payload_words=2),
+             "headline-2048n"),
+            (65536, dict(horizon=256, inbox_cap=12, payload_words=2),
+             "tier2-cardinal-65k"),
+            (64, dict(horizon=64, inbox_cap=12, payload_words=2),
+             "cpu-test"),
+        ]),
     ]
 
 
 def _unbudgeted_pick_block_calls() -> list[str]:
-    """`_pick_block(m)` call sites missing the row-bytes argument, as
-    "file:line" strings."""
+    """`_pick_block(m)` call sites missing the row-bytes argument — or
+    (the PR-9 extension) passing a bare numeric literal instead of a
+    named cost model — as "file:line[ reason]" strings.  A literal is
+    exactly the unbudgeted-launch failure mode with a number pasted
+    over it: nothing ties it to the kernel's real temporaries, so a
+    kernel change silently invalidates it; call sites must route
+    through a ``*_row_bytes`` model (directly or via a local
+    variable)."""
     bad = []
     for path in sorted(OPS_DIR.glob("pallas_*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -69,9 +86,16 @@ def _unbudgeted_pick_block_calls() -> list[str]:
             fn = node.func
             name = fn.id if isinstance(fn, ast.Name) else (
                 fn.attr if isinstance(fn, ast.Attribute) else "")
-            if name == "_pick_block" and len(node.args) < 2 and \
-                    not any(k.arg == "row_bytes" for k in node.keywords):
+            if name != "_pick_block":
+                continue
+            row_arg = node.args[1] if len(node.args) >= 2 else next(
+                (k.value for k in node.keywords
+                 if k.arg == "row_bytes"), None)
+            if row_arg is None:
                 bad.append(f"{path.name}:{node.lineno}")
+            elif isinstance(row_arg, ast.Constant):
+                bad.append(f"{path.name}:{node.lineno} "
+                           "(literal row-bytes)")
     return bad
 
 

@@ -357,6 +357,67 @@ SS_PROTOCOLS = {
 #: floor-rich latency override for the K > 2 targets (floor 8 >= K - 1)
 _SS_LATENCY = "NetworkFixedLatency(8)"
 
+#: Pallas-routing targets (PR 9): the SAME engine/K configs as the
+#: superstep targets but with the fused routing megakernel ON
+#: (ops/pallas_route.py, interpret mode on CPU) — the
+#: `superstep_amortization` budgets then pin the headline claim:
+#: compiled sort/scatter ops per simulated ms ~0 once the binning
+#: lives inside the kernel.  The Handel exact target additionally
+#: turns the delivery-merge/scoring Pallas kernels on
+#: (pallas_merge=True) so every remaining per-ms sort is accounted:
+#: the megakernel program is the all-Pallas one.  name -> (base, K,
+#: all_pallas).
+ROUTE_PROTOCOLS = {
+    "Handel+pallas_route": ("Handel", 4, True),
+    "HandelCardinal+pallas_route": ("HandelCardinal", 4, False),
+    "P2PFlood+pallas_route": ("P2PFlood", 4, False),
+}
+
+ROUTE_SUFFIX = "+pallas_route"
+
+
+def _route_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name, k, all_pallas = ROUTE_PROTOCOLS[name]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.batched import scan_chunk_batched
+        from ..core.network import scan_chunk
+        from ..ops.pallas_route import with_route
+
+        if base_name == "Handel":
+            kw = dict(network_latency_name=_SS_LATENCY)
+            if all_pallas:
+                kw["pallas_merge"] = True
+            proto = _handel(**kw)
+        elif base_name == "HandelCardinal":
+            from ..models.handel_cardinal import HandelCardinal
+            proto = HandelCardinal(
+                node_count=64, nodes_down=6, threshold=57, pairing_time=4,
+                dissemination_period_ms=20, fast_path=10,
+                network_latency_name=_SS_LATENCY)
+        else:
+            from ..models.p2pflood import P2PFlood
+            proto = P2PFlood(
+                node_count=64, dead_node_count=6, peers_count=8,
+                delay_before_resent=1, delay_between_sends=1,
+                network_latency_name=_SS_LATENCY)
+        try:
+            base = scan_chunk_batched(proto, chunk, superstep=k)
+            engine = f"batched+ss{k}+pallas_route"
+        except ValueError:
+            base = jax.vmap(scan_chunk(proto, chunk, superstep=k))
+            engine = f"vmapped+ss{k}+pallas_route"
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return with_route(base, "pallas"), args, proto, engine
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    t.ms_per_iter = k
+    return t
+
 
 def _ss_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     base_name, k = SS_PROTOCOLS[name]
@@ -477,13 +538,18 @@ def target_names() -> tuple:
                  sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS) +
                  sorted(f"{n}{TRACE_SUFFIX}" for n in TRACE_PROTOCOLS) +
                  sorted(f"{n}{AUDIT_SUFFIX}" for n in AUDIT_PROTOCOLS) +
-                 sorted(SS_PROTOCOLS))
+                 sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
 def get_target(name: str) -> AnalysisTarget:
     reg = _registry()
     if name in SS_PROTOCOLS:
         return _ss_target(name)
+    if name in ROUTE_PROTOCOLS:
+        return _route_target(name)
+    if name.endswith(ROUTE_SUFFIX):
+        raise KeyError(f"unknown pallas-route target {name!r}; known: "
+                       f"{sorted(ROUTE_PROTOCOLS)}")
     if name.endswith(AUDIT_SUFFIX):
         if name[:-len(AUDIT_SUFFIX)] not in AUDIT_PROTOCOLS:
             raise KeyError(

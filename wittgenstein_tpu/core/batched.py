@@ -45,7 +45,24 @@ def _batched_bin(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
                  payload, size, valid):
     """[R, m]-batched ring binning with the seed axis folded into the
     flat scatter index.  Mirrors network._bin_into_ring exactly per seed
-    (same keys, same stable order, same slot assignment)."""
+    (same keys, same stable order, same slot assignment).
+
+    ``WTPU_PALLAS_ROUTE=1`` swaps the folded sort/scatter for the fused
+    Pallas routing megakernel (ops/pallas_route.py, seed axis as a grid
+    dimension — bit-identical, tests/test_pallas_route.py)."""
+    from ..ops.pallas_route import route_enabled
+    if route_enabled():
+        from ..ops.pallas_route import bin_into_ring_planes
+        box_data, box_src, box_size, box_count, n_dropped = \
+            bin_into_ring_planes(
+                net.box_data, net.box_src, net.box_size, net.box_count,
+                arrival % cfg.horizon, dest, src, size, payload, valid,
+                horizon=cfg.horizon, cap=cfg.inbox_cap, n=cfg.n,
+                split=cfg.box_split, payload_words=cfg.payload_words,
+                seed_axis=True)
+        return net.replace(box_data=box_data, box_src=box_src,
+                           box_size=box_size, box_count=box_count), \
+            n_dropped
     n, c = cfg.n, cfg.inbox_cap
     p, ns = cfg.box_split, cfg.split_n
     r, m = src.shape

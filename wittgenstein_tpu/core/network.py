@@ -208,7 +208,28 @@ def _bin_into_ring(cfg: EngineConfig, net: NetState, t, src, dest, arrival,
     which is valid ONLY because step_kms clears all K consumed rows
     BEFORE binning (do not reorder).  Returns (net', n_dropped) —
     entries that found their (ms, dest) cell full.
+
+    ``WTPU_PALLAS_ROUTE=1`` (or the serve plane's `route_kernel` knob)
+    swaps the sort/scatter composition below for the fused Pallas
+    routing megakernel (ops/pallas_route.py — bit-identical,
+    tests/test_pallas_route.py; interpret mode on CPU).  The arrival
+    contract above is exactly what makes the kernel's (row, dest)
+    grouping coincide with the sort's (rel, dest) grouping: at most
+    horizon-1 distinct rel values per batch, so rel % horizon is
+    injective within it.
     """
+    from ..ops.pallas_route import route_enabled
+    if route_enabled():
+        from ..ops.pallas_route import bin_into_ring_planes
+        box_data, box_src, box_size, box_count, n_dropped = \
+            bin_into_ring_planes(
+                net.box_data, net.box_src, net.box_size, net.box_count,
+                arrival % cfg.horizon, dest, src, size, payload, valid,
+                horizon=cfg.horizon, cap=cfg.inbox_cap, n=cfg.n,
+                split=cfg.box_split, payload_words=cfg.payload_words)
+        return net.replace(box_data=box_data, box_src=box_src,
+                           box_size=box_size, box_count=box_count), \
+            n_dropped
     n, c = cfg.n, cfg.inbox_cap
     m = src.shape[0]
     rel = arrival - t

@@ -53,8 +53,10 @@ from .decode import TraceFrame
 from .trace import TraceSpec, fast_forward_chunk_trace, \
     scan_chunk_batched_trace, scan_chunk_trace
 
-#: variant-dict keys understood by `build_variant` / the CLI
-VARIANT_KEYS = ("superstep", "batched", "fast_forward")
+#: variant-dict keys understood by `build_variant` / the CLI;
+#: `pallas_route` pins the routing-kernel selection (ops/pallas_route)
+#: for THIS variant's build — the xla-vs-pallas bisector hook
+VARIANT_KEYS = ("superstep", "batched", "fast_forward", "pallas_route")
 
 
 def variant_granularity(variant: dict) -> int:
@@ -80,6 +82,18 @@ def build_variant(protocol, ms: int, variant: dict, trace_spec=None):
     if unknown:
         raise ValueError(f"unknown variant keys {sorted(unknown)}; "
                          f"known: {VARIANT_KEYS}")
+    from ..ops.pallas_route import with_route
+
+    def finish(fn):
+        """Pin the variant's routing-kernel selection around the
+        jitted callable (tracing happens inside the first call): a
+        variant that says nothing keeps the env default, so existing
+        A/Bs are unchanged."""
+        if "pallas_route" not in variant:
+            return fn
+        return with_route(fn, "pallas" if variant["pallas_route"]
+                          else "xla")
+
     k = int(variant.get("superstep", 1) or 1)
     if variant.get("batched"):
         if trace_spec is not None:
@@ -87,7 +101,7 @@ def build_variant(protocol, ms: int, variant: dict, trace_spec=None):
                                             superstep=max(k, 2))
         else:
             base = scan_chunk_batched(protocol, ms, superstep=max(k, 2))
-        return jax.jit(base)
+        return finish(jax.jit(base))
     if variant.get("fast_forward"):
         if trace_spec is not None:
             traced = fast_forward_chunk_trace(protocol, ms, trace_spec,
@@ -97,7 +111,7 @@ def build_variant(protocol, ms: int, variant: dict, trace_spec=None):
                 nets, ps, _, tc = traced(nets, ps)
                 return nets, ps, tc
 
-            return jax.jit(run_t)
+            return finish(jax.jit(run_t))
         base_ff = fast_forward_chunk(protocol, ms, seed_axis=True,
                                      superstep=k)
 
@@ -105,11 +119,12 @@ def build_variant(protocol, ms: int, variant: dict, trace_spec=None):
             nets, ps, _ = base_ff(nets, ps)
             return nets, ps
 
-        return jax.jit(run)
+        return finish(jax.jit(run))
     if trace_spec is not None:
-        return jax.jit(jax.vmap(scan_chunk_trace(protocol, ms, trace_spec,
-                                                 superstep=k)))
-    return jax.jit(jax.vmap(scan_chunk(protocol, ms, superstep=k)))
+        return finish(jax.jit(jax.vmap(
+            scan_chunk_trace(protocol, ms, trace_spec, superstep=k))))
+    return finish(jax.jit(jax.vmap(scan_chunk(protocol, ms,
+                                              superstep=k))))
 
 
 class FaultInjector:

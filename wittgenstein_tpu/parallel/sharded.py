@@ -545,43 +545,60 @@ class ShardedRunner:
             n_clamped = jnp.sum(ok & (raw_total != total)).astype(jnp.int32)
             net = net.replace(clamped=net.clamped + n_clamped)
             arrival = t + r_toff + 1 + total
-            mx = S * K * xcap
-            big = jnp.int32(0x7FFFFFFF)
-            rel_k = jnp.where(ok, arrival - t, big)
-            d_k = jnp.where(ok, dl, big)
-            o1 = jnp.argsort(d_k, stable=True)
-            order2 = o1[jnp.argsort(rel_k[o1], stable=True)]
-            rel_s, d_s = rel_k[order2], d_k[order2]
-            idx2 = jnp.arange(mx, dtype=jnp.int32)
-            ng = ((rel_s != jnp.roll(rel_s, 1)) |
-                  (d_s != jnp.roll(d_s, 1))).at[0].set(True)
-            rank2 = idx2 - jax.lax.cummax(jnp.where(ng, idx2, 0))
-            h_s = ((t + rel_s) % cfg.horizon)
-            ok2 = (rel_s < big) & (rank2 + net.box_count[
-                jnp.clip(h_s, 0, cfg.horizon - 1),
-                jnp.clip(d_s, 0, nl - 1)] < cfg.inbox_cap)
-            slot2 = net.box_count[jnp.clip(h_s, 0, cfg.horizon - 1),
-                                  jnp.clip(d_s, 0, nl - 1)] + rank2
-            hnc = cfg.horizon * nl * cfg.inbox_cap
-            flat = (jnp.clip(h_s, 0, cfg.horizon - 1) * nl +
-                    jnp.clip(d_s, 0, nl - 1)) * cfg.inbox_cap + \
-                jnp.where(ok2, slot2, 0)
-            flat_w = jnp.where(ok2, flat, hnc)
-            pl_s = r_payload[order2]
-            box_data = tuple(
-                net.box_data[fi].at[flat_w].set(pl_s[:, fi], mode="drop",
-                                                unique_indices=True)
-                for fi in range(fw))
-            box_src = (net.box_src[0].at[flat_w].set(
-                r_src[order2], mode="drop", unique_indices=True),)
-            box_size = (net.box_size[0].at[flat_w].set(
-                r_size[order2], mode="drop", unique_indices=True),)
-            box_count = net.box_count.at[
-                jnp.clip(h_s, 0, cfg.horizon - 1),
-                jnp.clip(d_s, 0, nl - 1)].add(ok2.astype(jnp.int32),
-                                              mode="drop")
-            dropped = net.dropped + jnp.sum((rel_s < big) & ~ok2).astype(
-                jnp.int32)
+            from ..ops.pallas_route import route_enabled
+            if route_enabled():
+                # Fused Pallas binning of the received window — same
+                # cells, same slot order (the local-ring half of the
+                # WTPU_PALLAS_ROUTE megakernel; the origin-ms-major
+                # reorder above already put the input in the per-ms
+                # path's stable order).
+                from ..ops.pallas_route import bin_into_ring_planes
+                box_data, box_src, box_size, box_count, n_drop = \
+                    bin_into_ring_planes(
+                        net.box_data, net.box_src, net.box_size,
+                        net.box_count, arrival % cfg.horizon, dl,
+                        r_src, r_size, r_payload, ok,
+                        horizon=cfg.horizon, cap=cfg.inbox_cap, n=nl,
+                        split=1, payload_words=fw)
+                dropped = net.dropped + n_drop
+            else:
+                mx = S * K * xcap
+                big = jnp.int32(0x7FFFFFFF)
+                rel_k = jnp.where(ok, arrival - t, big)
+                d_k = jnp.where(ok, dl, big)
+                o1 = jnp.argsort(d_k, stable=True)
+                order2 = o1[jnp.argsort(rel_k[o1], stable=True)]
+                rel_s, d_s = rel_k[order2], d_k[order2]
+                idx2 = jnp.arange(mx, dtype=jnp.int32)
+                ng = ((rel_s != jnp.roll(rel_s, 1)) |
+                      (d_s != jnp.roll(d_s, 1))).at[0].set(True)
+                rank2 = idx2 - jax.lax.cummax(jnp.where(ng, idx2, 0))
+                h_s = ((t + rel_s) % cfg.horizon)
+                ok2 = (rel_s < big) & (rank2 + net.box_count[
+                    jnp.clip(h_s, 0, cfg.horizon - 1),
+                    jnp.clip(d_s, 0, nl - 1)] < cfg.inbox_cap)
+                slot2 = net.box_count[jnp.clip(h_s, 0, cfg.horizon - 1),
+                                      jnp.clip(d_s, 0, nl - 1)] + rank2
+                hnc = cfg.horizon * nl * cfg.inbox_cap
+                flat = (jnp.clip(h_s, 0, cfg.horizon - 1) * nl +
+                        jnp.clip(d_s, 0, nl - 1)) * cfg.inbox_cap + \
+                    jnp.where(ok2, slot2, 0)
+                flat_w = jnp.where(ok2, flat, hnc)
+                pl_s = r_payload[order2]
+                box_data = tuple(
+                    net.box_data[fi].at[flat_w].set(
+                        pl_s[:, fi], mode="drop", unique_indices=True)
+                    for fi in range(fw))
+                box_src = (net.box_src[0].at[flat_w].set(
+                    r_src[order2], mode="drop", unique_indices=True),)
+                box_size = (net.box_size[0].at[flat_w].set(
+                    r_size[order2], mode="drop", unique_indices=True),)
+                box_count = net.box_count.at[
+                    jnp.clip(h_s, 0, cfg.horizon - 1),
+                    jnp.clip(d_s, 0, nl - 1)].add(ok2.astype(jnp.int32),
+                                                  mode="drop")
+                dropped = net.dropped + jnp.sum(
+                    (rel_s < big) & ~ok2).astype(jnp.int32)
 
             net = net.replace(
                 box_data=box_data, box_src=box_src, box_size=box_size,

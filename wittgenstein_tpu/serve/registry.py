@@ -130,7 +130,12 @@ class CompileRegistry:
         else:
             raise ValueError(f"unknown obs plane {plane!r}; known: "
                              "metrics trace audit (or None)")
-        return jax.jit(base)
+        # Pin the spec's routing-kernel selection around every call —
+        # tracing happens inside the FIRST call, and a process-level
+        # WTPU_PALLAS_ROUTE must never flip what this compile key
+        # claims was built (route_kernel is a program field).
+        from ..ops.pallas_route import with_route
+        return with_route(jax.jit(base), spec.route_kernel)
 
     # ------------------------------------------------------------- export
 

@@ -37,8 +37,16 @@ from __future__ import annotations
 import dataclasses
 import json
 
-#: spec schema version (bump on field changes; readers key on it)
-SCHEMA = 1
+#: spec schema version (bump on field changes; readers key on it).
+#: 2 (PR 9): + `latency_model` (registry-validated, program-affecting)
+#: and `route_kernel` ("xla" | "pallas" — the WTPU_PALLAS_ROUTE knob
+#: as a per-spec program field); digests of schema-1 specs change.
+SCHEMA = 2
+
+#: routing-kernel selection the registry honors per spec
+#: (ops/pallas_route.py): the fused Pallas binning megakernel or the
+#: default XLA sort/scatter path
+ROUTE_KERNELS = ("xla", "pallas")
 
 #: engine variants the registry can build a chunk program for
 ENGINES = ("vmapped", "batched", "fast_forward")
@@ -97,6 +105,8 @@ class ScenarioSpec:
     trace_capacity: int = 1 << 16
     attack: dict | None = None   # {"at_ms", "leaf", "node", "delta"}
     partition: tuple = ()        # node ids down at entry (data, not program)
+    latency_model: str | None = None   # registry name; None = protocol default
+    route_kernel: str = "xla"    # "xla" | "pallas" (ops/pallas_route.py)
     schema: int = SCHEMA
 
     def __post_init__(self):
@@ -119,6 +129,12 @@ class ScenarioSpec:
                            tuple(sorted(int(n) for n in self.partition)))
         if self.attack is not None:
             object.__setattr__(self, "attack", dict(self.attack))
+        if self.route_kernel not in ROUTE_KERNELS:
+            # same rationale as the unknown-obs refusal: a typo'd
+            # kernel silently coerced would compile a program the
+            # requester never meant (and mislabel the A/B)
+            raise _err(f"unknown route_kernel {self.route_kernel!r}; "
+                       f"known: {ROUTE_KERNELS}")
 
     # ------------------------------------------------------- serialization
 
@@ -185,6 +201,8 @@ class ScenarioSpec:
             "trace_capacity": spec.trace_capacity
             if "trace" in spec.obs else None,
             "attack": spec.attack,
+            "latency_model": spec.latency_model,
+            "route_kernel": spec.route_kernel,
         })
 
     # ---------------------------------------------------------- validation
@@ -202,7 +220,27 @@ class ScenarioSpec:
                                     pick_superstep)
         from ..server.core import validate_parameters
 
-        validate_parameters(self.protocol, self.params)
+        if self.latency_model is not None:
+            # validated against the registered models (core/latency.py
+            # get_by_name — the reference's RegistryNetworkLatencies)
+            # BEFORE the protocol builds: an unknown name must 400
+            # with the registry hint, not surface as a deep KeyError
+            from ..core.latency import get_by_name
+            if "network_latency_name" in self.params:
+                raise _err(
+                    "latency_model and params['network_latency_name'] "
+                    "both set: one latency selection per spec (the "
+                    "field is the canonical spelling; drop the param)")
+            try:
+                get_by_name(self.latency_model)
+            except (KeyError, ValueError) as e:
+                raise _err(
+                    f"unknown latency_model {self.latency_model!r}: {e} "
+                    "(registered: NetworkFixedLatency(ms), "
+                    "NetworkUniformLatency(max), class names from "
+                    "core/latency.py, e.g. "
+                    "NetworkLatencyByDistanceWJitter)") from None
+        validate_parameters(self.protocol, self._effective_params())
         if self.engine not in ENGINES:
             raise _err(f"unknown engine {self.engine!r}; known: {ENGINES}")
         if not self.seeds:
@@ -307,13 +345,22 @@ class ScenarioSpec:
 
     # ------------------------------------------------------------ builders
 
+    def _effective_params(self) -> dict:
+        """Constructor params with the `latency_model` field folded in
+        as the protocols' `network_latency_name` kwarg (one latency
+        selection path; protocols that do not take the kwarg refuse
+        through the parameter template, naming it)."""
+        if self.latency_model is None:
+            return self.params
+        return {**self.params, "network_latency_name": self.latency_model}
+
     def build_protocol(self, wrap_attack: bool = True):
         """Instantiate the protocol (plus the `FaultInjector` wrap when
         an attack is configured — the wrap is part of the compiled
         program, which is why `attack` is in the compile key)."""
         from ..core.protocol import get_protocol
 
-        proto = get_protocol(self.protocol)(**self.params)
+        proto = get_protocol(self.protocol)(**self._effective_params())
         if wrap_attack and self.attack is not None:
             from ..obs.diff import FaultInjector
             proto = FaultInjector(proto, at_ms=int(self.attack["at_ms"]),
@@ -354,6 +401,16 @@ class ScenarioSpec:
             protocol, params = "PingPong", {"node_count": n}
         elif proto_sel == "dfinity":
             protocol, params = "Dfinity", {}
+        elif proto_sel == "p2pflood":
+            # mirrors bench_quiet's construction (the routing-kernel
+            # A/B workload) — program-affecting latency override folds
+            # in exactly like the Handel branch's str_knobs
+            protocol = "P2PFlood"
+            params = {"node_count": n, "dead_node_count": n // 10,
+                      "peers_count": 8, "delay_before_resent": 1,
+                      "delay_between_sends": 1}
+            if env.get("WTPU_BENCH_LATENCY") is not None:
+                params["network_latency_name"] = env["WTPU_BENCH_LATENCY"]
         else:
             # Unknown proto_sel values also land here; bench.py routes
             # them to bench_quiet, whose refusal fires BEFORE any
@@ -427,4 +484,9 @@ class ScenarioSpec:
             chunk_ms=chunk,               # like the bench's own accounting
             engine=engine, superstep=superstep, obs=tuple(obs),
             stat_each_ms=_int("WTPU_METRICS_EACH_MS", 10),
-            trace_capacity=_int("WTPU_TRACE_CAP", 1 << 16))
+            trace_capacity=_int("WTPU_TRACE_CAP", 1 << 16),
+            # program-affecting routing-kernel knob (ops/pallas_route):
+            # the env's trace-time default, recorded so two runs of
+            # different binning programs never share a config digest
+            route_kernel="pallas"
+            if env.get("WTPU_PALLAS_ROUTE", "0") != "0" else "xla")
