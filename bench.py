@@ -261,6 +261,61 @@ def _maybe_engine_audit(res, proto, total_ms, fast_forward=False):
     if os.environ.get("WTPU_AUDIT", "1") != "0":
         res["audit"] = _collect_engine_audit(proto, total_ms,
                                              fast_forward=fast_forward)
+    return _maybe_chaos(res, proto, total_ms)
+
+
+def _collect_chaos(proto, total_ms):
+    """Un-timed chaos-plane pass for the JSON line's `chaos` block
+    (wittgenstein_tpu/chaos; schema in BENCH_NOTES.md r13).
+
+    ``WTPU_CHAOS`` carries a `FaultSchedule` as inline JSON; a
+    MALFORMED or out-of-range schedule refuses loudly (the
+    WTPU_TRACE_CAP pattern — a silently dropped schedule would emit a
+    `chaos` block for a run that never saw adversity).  The pass wraps
+    the bench protocol in `ChaosProtocol`, runs the dense audited
+    engine over the FAULTED trajectory (audit verdicts must stay clean
+    under churn/partition — a violation is loud in the block and on
+    stderr), then one fault-free twin pass for the impact deltas
+    (done/live/message totals, faulted vs baseline).  Single seed,
+    after the timed reps — the measured hot path never carries the
+    wrap."""
+    from wittgenstein_tpu.chaos import ChaosProtocol, FaultSchedule
+    from wittgenstein_tpu.obs.audit import AuditSpec
+    from wittgenstein_tpu.obs.audit_report import (audit_block,
+                                                   audit_variant)
+
+    # refusal half: outside the try — a bad schedule must kill the
+    # bench loudly, not degrade into an error field
+    sched = FaultSchedule.from_json(os.environ["WTPU_CHAOS"]).validate(
+        n=proto.cfg.n, sim_ms=total_ms)
+    try:
+        from wittgenstein_tpu.chaos import impact_summary
+        cp = ChaosProtocol(proto, sched)
+        spec = AuditSpec()
+        report, (nets, _) = audit_variant(cp, total_ms,
+                                          {"superstep": 1}, spec)
+        _, (nets0, _) = audit_variant(proto, total_ms,
+                                      {"superstep": 1}, spec)
+        blk = {"schedule": sched.counts(),
+               "transitions": len(sched.transition_times()),
+               "audit": audit_block(report),
+               "faulted": impact_summary(nets),
+               "baseline": impact_summary(nets0)}
+        if not report.clean:
+            print(f"bench: AUDIT VIOLATIONS under the chaos schedule:\n"
+                  f"{report.format()}", file=sys.stderr)
+        return blk
+    except Exception as e:      # noqa: BLE001 — the bench line must emit
+        print(f"bench: chaos pass failed: {type(e).__name__}: "
+              f"{e!s:.300}", file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e!s:.200}",
+                "schedule": sched.counts()}
+
+
+def _maybe_chaos(res, proto, total_ms):
+    raw = os.environ.get("WTPU_CHAOS")
+    if raw and raw != "0":
+        res["chaos"] = _collect_chaos(proto, total_ms)
     return res
 
 

@@ -1,0 +1,371 @@
+"""The chaos plane (wittgenstein_tpu/chaos).
+
+Invariants, per the package contract:
+
+  * bit-determinism: one (FaultSchedule, seed) yields bit-identical
+    trajectories across the dense per-ms engine, the superstep-K
+    window engine, the batched seed-folded twin, the fast-forward
+    while loop (fault-aware jump clamping) and the sharded runner;
+  * zero residue: the chaos wrap with an EMPTY schedule is
+    bit-identical to the unwrapped protocol;
+  * obs planes compose: audit verdicts stay CLEAN under
+    churn/partition (and a planted FaultInjector counter fault is
+    still caught in its own window), churn drives the flight
+    recorder's node_down/node_up kinds at their exact ms, and the
+    metrics plane sees the outage;
+  * refusal with remedy: malformed/overlapping windows and
+    K-misaligned transitions are refused, never silently coerced.
+
+Protocol configs mirror tests/test_superstep.py / test_sharded.py so
+compiles share the suite's persistent-cache entries where possible.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.chaos import ChaosProtocol, FaultSchedule
+from wittgenstein_tpu.core.network import (check_chunk_config,
+                                           fast_forward_chunk,
+                                           pick_superstep, scan_chunk,
+                                           superstep_ok)
+from wittgenstein_tpu.models.pingpong import PingPong
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+#: the canonical small adversity: two crash/recover outages, one
+#: mid-run partition that heals, lossy links, a delay window — all
+#: transitions even (K=2-aligned)
+SCHED = FaultSchedule(churn=((3, 20, 60), (5, 40, 100)),
+                      partitions=((30, 90, 1, 0, 32),),
+                      loss=((0, 120, 250, 0, 64, 0, 64),),
+                      delay=((10, 50, 3, 0, 64, 0, 64),))
+
+
+# ----------------------------------------------------------- validation
+
+
+def test_schedule_refusals():
+    with pytest.raises(ValueError, match="down_ms < up_ms"):
+        FaultSchedule(churn=((3, 60, 20),)).validate()
+    with pytest.raises(ValueError, match="out of range"):
+        FaultSchedule(churn=((99, 0, 10),)).validate(n=64)
+    with pytest.raises(ValueError, match="overlap on node"):
+        FaultSchedule(churn=((3, 0, 50), (3, 40, 80))).validate()
+    with pytest.raises(ValueError, match="ONE partition at a time"):
+        FaultSchedule(partitions=((10, 50, 1, 0, 32),
+                                  (20, 60, 2, 16, 48))).validate()
+    with pytest.raises(ValueError, match="reserved"):
+        FaultSchedule(partitions=((10, 50, 0, 0, 32),)).validate()
+    with pytest.raises(ValueError, match="permille"):
+        FaultSchedule(loss=((0, 10, 2000, 0, 8, 0, 8),)).validate()
+    with pytest.raises(ValueError, match="never fire"):
+        SCHED.validate(n=64, sim_ms=10)
+    with pytest.raises(ValueError, match="unknown fault class"):
+        FaultSchedule.from_json({"churns": [[1, 0, 10]]})
+    with pytest.raises(ValueError, match="must be"):
+        FaultSchedule.from_json({"churn": [[1, 0]]})
+    # non-iterable rows/classes are ValueError too (the remedy-text
+    # refusal contract — never a bare TypeError)
+    with pytest.raises(ValueError, match="churn\\[0\\] must be"):
+        FaultSchedule.from_json({"churn": [5]})
+    with pytest.raises(ValueError, match="churn must be a list"):
+        FaultSchedule.from_json({"churn": 5})
+    # disjoint partitions (in time OR node range) are fine
+    FaultSchedule(partitions=((10, 50, 1, 0, 32),
+                              (10, 50, 2, 32, 64),
+                              (50, 60, 3, 0, 64))).validate(n=64)
+
+
+def test_schedule_roundtrip_and_alignment():
+    assert FaultSchedule.from_json(SCHED.to_json()) == SCHED
+    assert SCHED.transition_times() == (20, 30, 40, 60, 90, 100)
+    assert SCHED.superstep_aligned(2)
+    assert not SCHED.superstep_aligned(4)       # 30/90 misalign
+    assert SCHED.align_gcd() == 10
+    assert FaultSchedule().empty and FaultSchedule().superstep_aligned(8)
+
+
+def test_superstep_gate_and_demotion():
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, FaultSchedule(churn=((3, 21, 60),)))
+    with pytest.raises(ValueError, match="window boundary"):
+        check_chunk_config(cp, 120, superstep=2)
+    assert not superstep_ok(cp, 2)
+    # pick_superstep silently demotes to the per-ms path
+    assert pick_superstep(cp, 120, t0=0) == 1
+    # an aligned schedule keeps K=2
+    cp2 = ChaosProtocol(proto, FaultSchedule(churn=((3, 20, 60),)))
+    assert pick_superstep(cp2, 120, t0=0) == 2
+
+
+# ----------------------------------------------------- engine identity
+
+
+def test_empty_schedule_zero_residue():
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, FaultSchedule())
+    a = jax.jit(scan_chunk(proto, 120))(*proto.init(0))
+    b = jax.jit(scan_chunk(cp, 120))(*cp.init(0))
+    _trees_equal(a, b)
+
+
+def test_dense_superstep_ff_bit_identity():
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, SCHED)
+    ref = jax.jit(scan_chunk(cp, 120))(*cp.init(0))
+    k2 = jax.jit(scan_chunk(cp, 120, superstep=2))(*cp.init(0))
+    _trees_equal(ref, k2)
+    net, ps, stats = jax.jit(
+        lambda n, p: fast_forward_chunk(cp, 120)(n, p))(*cp.init(0))
+    _trees_equal(ref, (net, ps))
+    # the quiet-heavy protocol must actually have jumped — i.e. the
+    # fault-aware clamp was exercised, not bypassed by a dense run
+    assert int(stats["skipped_ms"]) > 0
+    # determinism: a second run is bit-identical
+    _trees_equal(ref, jax.jit(scan_chunk(cp, 120))(*cp.init(0)))
+
+
+def test_batched_bit_identity():
+    from wittgenstein_tpu.core.batched import scan_chunk_batched
+    from wittgenstein_tpu.models.handel import Handel
+
+    sched = FaultSchedule(churn=((3, 20, 60), (9, 40, 104)),
+                          partitions=((40, 80, 1, 0, 32),),
+                          loss=((0, 120, 200, 0, 64, 0, 64),))
+    proto = Handel(node_count=64, threshold=50, nodes_down=6,
+                   pairing_time=4,
+                   network_latency_name="NetworkFixedLatency(16)")
+    cp = ChaosProtocol(proto, sched)
+    nets, ps = jax.vmap(cp.init)(jnp.arange(3, dtype=jnp.int32))
+    a = jax.jit(jax.vmap(scan_chunk(cp, 120, superstep=4)))(nets, ps)
+    b = jax.jit(scan_chunk_batched(cp, 120, superstep=4))(nets, ps)
+    _trees_equal(a, b)
+
+
+def test_sharded_bit_identity():
+    from jax.sharding import Mesh
+
+    from wittgenstein_tpu.parallel.sharded import RingForward, ShardedRunner
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8] if len(devs) >= 8 else devs[:1]),
+                ("sp",))
+    sched = FaultSchedule(churn=((5, 2, 20), (17, 4, 30)),
+                          partitions=((6, 24, 1, 0, 16),),
+                          loss=((0, 40, 300, 0, 64, 0, 64),))
+    cp = ChaosProtocol(RingForward(n=64, stride=9, latency=10), sched)
+    sr = ShardedRunner(cp, mesh)
+    snet, sps = sr.init(0)
+    snet, sps = sr.run_ms(snet, sps, 40)
+    gn = sr.gather_nodes(snet)
+    net, ps = jax.jit(scan_chunk(cp, 40))(*cp.init(0))
+    for name in ("down", "partition", "msg_sent", "msg_received",
+                 "done_at"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(gn, name)),
+            np.asarray(getattr(net.nodes, name)), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(sps.received).reshape(-1), np.asarray(ps.received))
+
+
+# ------------------------------------------------------------ adversary
+
+
+def test_total_loss_blocks_unicasts():
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, FaultSchedule(
+        loss=((0, 120, 1000, 0, 64, 0, 64),)))
+    net, ps = jax.jit(scan_chunk(cp, 120))(*cp.init(0))
+    net0, ps0 = jax.jit(scan_chunk(proto, 120))(*proto.init(0))
+    # every unicast on every link lost: the witness's sendAll ping (a
+    # broadcast — loss is unicast-only by design) still lands, but no
+    # pong ever makes it back, while the baseline clearly converges
+    assert int(np.asarray(ps.pongs).sum()) == 0
+    assert int(np.asarray(ps0.pongs).sum()) > 0
+    assert (int(np.asarray(net.nodes.msg_received).sum()) <
+            int(np.asarray(net0.nodes.msg_received).sum()))
+
+
+def test_delay_inflation_shifts_arrivals_exactly():
+    from wittgenstein_tpu.core.latency import NetworkFixedLatency
+    from wittgenstein_tpu.obs.decode import TraceFrame
+    from wittgenstein_tpu.obs.trace import TraceSpec, scan_chunk_trace
+
+    proto = PingPong(node_count=8, latency=NetworkFixedLatency(5))
+    cp = ChaosProtocol(proto, FaultSchedule(
+        delay=((0, 200, 7, 0, 8, 0, 8),)))
+    spec = TraceSpec(capacity=2048, events=("send", "deliver"))
+    _, _, tc0 = jax.jit(scan_chunk_trace(proto, 60, spec))(*proto.init(0))
+    _, _, tc1 = jax.jit(scan_chunk_trace(cp, 60, spec))(*cp.init(0))
+
+    def first_pong_ms(tc):
+        # the pong is the UNICAST leg (the ping is a broadcast, which
+        # delay inflation deliberately leaves alone): a delivery whose
+        # source is not the witness (node 0)
+        fr = TraceFrame.from_carry(spec, tc).filter(kinds=("deliver",))
+        t = fr.column("time_ms")[fr.column("src") != 0]
+        assert t.size > 0
+        return int(t.min())
+
+    # fixed latency + constant inflation: the first pong lands EXACTLY
+    # extra_ms later than the baseline's
+    assert first_pong_ms(tc1) == first_pong_ms(tc0) + 7
+
+
+# ------------------------------------------------------------ obs planes
+
+
+def test_trace_node_down_up_kinds():
+    from wittgenstein_tpu.obs.decode import TraceFrame
+    from wittgenstein_tpu.obs.trace import TraceSpec, scan_chunk_trace
+
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, SCHED)
+    spec = TraceSpec(capacity=4096)
+    run = jax.jit(scan_chunk_trace(cp, 120, spec))
+    net, ps, tc = run(*cp.init(0))
+    fr = TraceFrame.from_carry(spec, tc)
+    dn = fr.filter(kinds=("node_down",))
+    up = fr.filter(kinds=("node_up",))
+    assert [(int(t), int(s)) for t, s in
+            zip(dn.column("time_ms"), dn.column("src"))] == \
+        [(20, 3), (40, 5)]
+    assert [(int(t), int(s)) for t, s in
+            zip(up.column("time_ms"), up.column("src"))] == \
+        [(60, 3), (100, 5)]
+    # trace-ON is bit-identical on the faulted trajectory
+    _trees_equal(jax.jit(scan_chunk(cp, 120))(*cp.init(0)), (net, ps))
+    # decode/export round trip covers the new kind
+    assert len(fr.rows()) == fr.n_events
+    from wittgenstein_tpu.obs.export import trace_to_perfetto
+    p = trace_to_perfetto(fr)
+    assert sum(1 for e in p["traceEvents"]
+               if e.get("ph") == "X") == fr.n_events
+    # K=2 window engine records the identical event stream
+    _, _, tc2 = jax.jit(scan_chunk_trace(cp, 120, spec, superstep=2))(
+        *cp.init(0))
+    np.testing.assert_array_equal(np.asarray(tc.buf), np.asarray(tc2.buf))
+    assert int(tc.cursor) == int(tc2.cursor)
+
+
+def test_audit_clean_under_chaos_and_fault_still_caught():
+    from wittgenstein_tpu.obs.audit import AuditSpec
+    from wittgenstein_tpu.obs.audit_report import audit_variant
+    from wittgenstein_tpu.obs.diff import FaultInjector
+
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, SCHED)
+    report, states = audit_variant(cp, 120, {"superstep": 1},
+                                   AuditSpec())
+    assert report.clean, report.format()
+    # audited trajectory == unaudited faulted trajectory
+    plain = jax.jit(jax.vmap(scan_chunk(cp, 120)))(
+        *jax.vmap(cp.init)(jnp.arange(1, dtype=jnp.int32)))
+    _trees_equal(plain, states)
+    # a planted counter fault under the SAME chaos is still flagged, in
+    # its own window (the audit catalogue stays sharp under adversity)
+    planted = ChaosProtocol(
+        FaultInjector(proto, at_ms=37, leaf="nodes.msg_sent", node=5,
+                      delta=-(1 << 20)), SCHED)
+    rep2, _ = audit_variant(planted, 120, {"superstep": 1}, AuditSpec())
+    assert not rep2.clean
+    assert rep2.first is not None
+    assert rep2.first["invariant"] == "counter_monotone"
+    assert rep2.first["ms"] == 37
+
+
+def test_metrics_plane_sees_the_outage():
+    from wittgenstein_tpu.obs.engine import scan_chunk_metrics
+    from wittgenstein_tpu.obs.export import MetricsFrame
+    from wittgenstein_tpu.obs.spec import MetricsSpec
+
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, SCHED)
+    mspec = MetricsSpec(stat_each_ms=10)
+    net, ps, mc = jax.jit(scan_chunk_metrics(cp, 120, mspec))(*cp.init(0))
+    frame = MetricsFrame.from_carry(mspec, mc)
+    live = frame.series[:, list(mspec.columns).index("live_count")]
+    # both nodes down in [40, 60); one in [20, 40) and [60, 100)
+    assert int(live.min()) == 62
+    assert int(live[-1]) == 64          # both recovered by the end
+    _trees_equal(jax.jit(scan_chunk(cp, 120))(*cp.init(0)), (net, ps))
+
+
+# ---------------------------------------------------------- serve plane
+
+
+def test_scenario_spec_fault_schedule():
+    import wittgenstein_tpu.models  # noqa: F401 — fill the registry
+    from wittgenstein_tpu.serve import ScenarioSpec
+
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0,), sim_ms=120, chunk_ms=60)
+    plain = ScenarioSpec(**base)
+    spec = ScenarioSpec(**base, fault_schedule=SCHED.to_json())
+    # program-affecting: folds into BOTH digest and compile key
+    assert spec.digest() != plain.digest()
+    assert spec.compile_key() != plain.compile_key()
+    # canonical normalization: dict-order / empty-class variants of the
+    # same adversity digest equal
+    noisy = dict(SCHED.to_json())
+    noisy["delay"] = list(noisy["delay"])
+    assert ScenarioSpec(**base, fault_schedule=noisy).digest() == \
+        spec.digest()
+    assert ScenarioSpec(**base, fault_schedule={}).digest() == \
+        plain.digest()
+    # round trip through canonical JSON
+    assert ScenarioSpec.from_json(spec.canonical_json()) == spec
+    resolved = spec.validate()
+    assert isinstance(resolved.superstep, int)
+    proto = resolved.build_protocol()
+    assert isinstance(proto, ChaosProtocol)
+
+    # refusal with remedy -> the HTTP layer's 400
+    with pytest.raises(ValueError, match="ONE partition at a time"):
+        ScenarioSpec(**base, fault_schedule={
+            "partitions": [[10, 50, 1, 0, 32],
+                           [20, 60, 2, 16, 48]]}).validate()
+    with pytest.raises(ValueError, match="out of range"):
+        ScenarioSpec(**base,
+                     fault_schedule={"churn": [[999, 0, 10]]}).validate()
+    with pytest.raises(ValueError, match="never fire"):
+        ScenarioSpec(**base, fault_schedule={
+            "churn": [[3, 500, 600]]}).validate()
+    with pytest.raises(ValueError, match="unknown fault class"):
+        ScenarioSpec(**base, fault_schedule={"zaps": []})
+    # churn OWNS its nodes' liveness — a node also named down-at-entry
+    # would be silently revived at ms 0, so the clash is refused
+    with pytest.raises(ValueError, match="churn owns"):
+        ScenarioSpec(**base, partition=(3,), fault_schedule={
+            "churn": [[3, 100, 120]]}).validate()
+    # misaligned transitions refuse an explicit superstep with remedy
+    with pytest.raises(ValueError, match="window boundary"):
+        ScenarioSpec(**base, superstep=2, fault_schedule={
+            "churn": [[3, 21, 60]]}).validate()
+    # ... while "auto" demotes to the per-ms path
+    auto = ScenarioSpec(**base, superstep="auto", fault_schedule={
+        "churn": [[3, 21, 60]]}).validate()
+    assert auto.superstep == 1
+
+
+def test_from_env_captures_chaos():
+    from wittgenstein_tpu.serve.spec import ScenarioSpec
+
+    env = {"WTPU_BENCH_PROTO": "pingpong", "WTPU_BENCH_NODES": "64",
+           "WTPU_CHAOS": '{"churn": [[3, 20, 60]]}'}
+    spec = ScenarioSpec.from_env(env)
+    assert spec.fault_schedule == {"churn": [[3, 20, 60]]}
+    env2 = dict(env, WTPU_CHAOS="{broken")
+    assert ScenarioSpec.from_env(env2).fault_schedule is None
+    assert ScenarioSpec.from_env(
+        dict(env, WTPU_CHAOS="{}")).fault_schedule is None

@@ -1,11 +1,21 @@
 """Checkpoint/resume tests: a resumed run must be bit-identical to an
-uninterrupted one."""
+uninterrupted one — dense, batched and fast-forward engine variants,
+with and without the chaos plane (PR 10 chunk-boundary round trips)."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from wittgenstein_tpu.core.network import Runner
 from wittgenstein_tpu.models.handel import Handel
 from wittgenstein_tpu.utils import checkpoint
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
 def test_checkpoint_roundtrip(tmp_path):
@@ -36,3 +46,81 @@ def test_checkpoint_roundtrip(tmp_path):
     assert np.array_equal(np.asarray(ps_a.last_agg),
                           np.asarray(ps_c.last_agg))
     assert int(net_a.time) == int(net_c.time) == 1000
+
+
+def _roundtrip(proto, run, init, chunks=3, tmpdir="/tmp"):
+    """Run `chunks` chunks straight; run half, save at the chunk
+    boundary, restore, run the rest: full-pytree equality."""
+    import os
+    import tempfile
+
+    state_a = init()
+    for _ in range(chunks):
+        state_a = run(*state_a)
+
+    state_b = init()
+    state_b = run(*state_b)
+    fd, path = tempfile.mkstemp(suffix=".npz", dir=str(tmpdir))
+    os.close(fd)
+    try:
+        checkpoint.save(path, state_b[0], state_b[1])
+        net_c, ps_c, _ = checkpoint.load(path, proto, seed=0)
+    finally:
+        os.unlink(path)
+    state_c = (net_c, ps_c)
+    for _ in range(chunks - 1):
+        state_c = run(*state_c)
+    _trees_equal(state_a, state_c)
+
+
+def test_chunk_boundary_roundtrip_dense(tmp_path):
+    from wittgenstein_tpu.core.network import scan_chunk
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    proto = PingPong(node_count=64)
+    _roundtrip(proto, jax.jit(scan_chunk(proto, 40)),
+               lambda: proto.init(0), tmpdir=tmp_path)
+
+
+def test_chunk_boundary_roundtrip_batched(tmp_path):
+    from wittgenstein_tpu.core.batched import scan_chunk_batched
+
+    proto = Handel(node_count=64, threshold=50, nodes_down=6,
+                   pairing_time=4,
+                   network_latency_name="NetworkFixedLatency(16)")
+    _roundtrip(proto, jax.jit(scan_chunk_batched(proto, 40, superstep=4)),
+               lambda: jax.vmap(proto.init)(
+                   jnp.arange(2, dtype=jnp.int32)), tmpdir=tmp_path)
+
+
+def test_chunk_boundary_roundtrip_fast_forward(tmp_path):
+    from wittgenstein_tpu.core.network import fast_forward_chunk
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    proto = PingPong(node_count=64)
+    base = fast_forward_chunk(proto, 40)
+
+    @jax.jit
+    def run(net, ps):
+        net, ps, _ = base(net, ps)
+        return net, ps
+
+    _roundtrip(proto, run, lambda: proto.init(0), tmpdir=tmp_path)
+
+
+def test_chunk_boundary_roundtrip_chaos(tmp_path):
+    """A restored chaos run continues bit-identically: the fault state
+    is a stateless function of t, so the restore needs nothing beyond
+    the (net, pstate) pair — mid-outage, mid-partition included (the
+    save at ms 40 lands inside both windows)."""
+    from wittgenstein_tpu.chaos import ChaosProtocol, FaultSchedule
+    from wittgenstein_tpu.core.network import scan_chunk
+    from wittgenstein_tpu.models.pingpong import PingPong
+
+    proto = PingPong(node_count=64)
+    cp = ChaosProtocol(proto, FaultSchedule(
+        churn=((3, 20, 60), (5, 40, 100)),
+        partitions=((30, 90, 1, 0, 32),),
+        loss=((0, 120, 250, 0, 64, 0, 64),)))
+    _roundtrip(cp, jax.jit(scan_chunk(cp, 40)), lambda: cp.init(0),
+               tmpdir=tmp_path)

@@ -1,0 +1,206 @@
+"""Serve-plane resilience (PR 10): retry-with-backoff, batch-width
+degradation, chunk-boundary checkpoint/resume — driven by a
+fault-injected launcher (the `Scheduler(launcher=)` seam), with
+bit-identity to an uninterrupted run as the acceptance bar, plus the
+chaos plane riding the request plane end to end.
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fill the registry
+from wittgenstein_tpu.serve import ScenarioSpec, Scheduler, Service
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0, 1), sim_ms=120, chunk_ms=40,
+                obs=("metrics",))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """One uninterrupted run of the canonical spec — the bit-identity
+    reference every resilience path is compared against."""
+    sched = Scheduler(ledger_path=str(
+        tmp_path_factory.mktemp("led") / "ref.jsonl"))
+    rid = sched.submit(_spec())
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    return req.final_state
+
+
+def test_retry_with_backoff(reference):
+    calls = {"n": 0}
+
+    def flaky(fn, *args):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("injected launch failure")
+        return fn(*args)
+
+    sched = Scheduler(launcher=flaky, retry_backoff_s=0.0)
+    rid = sched.submit(_spec())
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    assert sched.resilience["retries"] == 2
+    assert req.artifacts["resilience"]["retries"] == 2
+    _trees_equal(reference, req.final_state)
+
+
+def test_retries_exhausted_fails_group():
+    def dead(fn, *args):
+        raise RuntimeError("device gone")
+
+    sched = Scheduler(launcher=dead, retry_backoff_s=0.0, max_retries=1)
+    rid = sched.submit(_spec())
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "error"
+    assert "device gone" in req.error
+
+
+def test_width_degradation(reference):
+    """A launcher that faults at full batch width (the OOM shape):
+    the scheduler halves the lane batch and runs the halves
+    sequentially instead of dropping requests — per-lane results
+    bit-identical to the full-width run."""
+    def narrow(fn, *args):
+        if int(args[0].time.shape[0]) > 1:
+            raise RuntimeError("injected OOM at full width")
+        return fn(*args)
+
+    sched = Scheduler(launcher=narrow, retry_backoff_s=0.0, max_retries=0)
+    r1 = sched.submit(_spec(seeds=(0,)))
+    r2 = sched.submit(_spec(seeds=(1,)))
+    assert sched.request(r1).compile_key == sched.request(r2).compile_key
+    sched.run_pending()
+    assert sched.request(r1).status == "done", sched.request(r1).error
+    assert sched.request(r2).status == "done", sched.request(r2).error
+    assert sched.resilience["demotions"] > 0
+    _trees_equal(jax.tree.map(lambda x: x[:1], reference),
+                 sched.request(r1).final_state)
+    _trees_equal(jax.tree.map(lambda x: x[1:], reference),
+                 sched.request(r2).final_state)
+
+
+def test_checkpoint_resume_bit_identical(reference, tmp_path):
+    """Kill the scheduler after one chunk, resume from the checkpoint
+    in a FRESH scheduler: the continuation is bit-identical to the
+    uninterrupted run and the ledger row records the resume point."""
+    ck = str(tmp_path / "ck")
+    state = {"n": 0}
+
+    def killer(fn, *args):
+        if state["n"] >= 1:
+            raise RuntimeError("KILLED")
+        state["n"] += 1
+        return fn(*args)
+
+    crashed = Scheduler(launcher=killer, retry_backoff_s=0.0,
+                        max_retries=0, checkpoint_dir=ck)
+    rid = crashed.submit(_spec())
+    crashed.run_pending()
+    assert crashed.request(rid).status == "error"
+    key = crashed.request(rid).compile_key
+    assert os.path.exists(os.path.join(ck, f"group-{key[:16]}.npz"))
+
+    from wittgenstein_tpu.obs import ledger
+    led = str(tmp_path / "resumed.jsonl")
+    fresh = Scheduler(checkpoint_dir=ck, ledger_path=led)
+    rids = fresh.resume_checkpoints()
+    assert len(rids) == 1
+    fresh.run_pending()
+    req = fresh.request(rids[0])
+    assert req.status == "done", req.error
+    assert req.resumed_from_ms == 40
+    assert req.artifacts["resumed_from_ms"] == 40
+    # THE acceptance pin: full-pytree equality with the uninterrupted
+    # run (the first_divergence criterion, evaluated directly — the
+    # final states are the whole trajectory's fingerprint for a
+    # deterministic pure engine)
+    _trees_equal(reference, req.final_state)
+    # the finished group's checkpoint is gone; the ledger row carries
+    # the resume provenance
+    assert not os.path.exists(os.path.join(ck, f"group-{key[:16]}.npz"))
+    rows = ledger.read_all(led)
+    assert len(rows) == 1
+
+
+def test_submit_never_overwrites_restored_ids():
+    """Checkpoint-restored requests keep their original ids, which can
+    sit AHEAD of a fresh scheduler's counter — submit() must allocate
+    around them, never overwrite one."""
+    from wittgenstein_tpu.serve.scheduler import Request
+
+    sched = Scheduler()
+    restored = _spec().validate()
+    with sched._mu:
+        # what resume_checkpoints leaves behind: a preserved id the
+        # counter has not reached yet
+        sched._requests["r0001"] = Request(
+            id="r0001", spec=restored, compile_key=restored.compile_key())
+    rid = sched.submit(_spec(seeds=(5, 6)))
+    assert rid == "r0002"
+    assert sched.request("r0001").spec is restored
+
+
+def test_resume_empty_dir_is_noop(tmp_path):
+    sched = Scheduler(checkpoint_dir=str(tmp_path / "none"))
+    assert sched.resume_checkpoints() == []
+    assert Scheduler().resume_checkpoints() == []
+
+
+def test_chaos_spec_through_service(tmp_path):
+    """A fault_schedule spec rides the whole request plane: coalesced
+    by compile key (adversity is program), audited clean under
+    churn/partition, and a planted counter attack is STILL flagged in
+    its own window through the serve path."""
+    fs = {"churn": [[3, 20, 60]], "partitions": [[30, 90, 1, 0, 32]]}
+    spec = _spec(obs=("metrics", "audit"), fault_schedule=fs)
+    svc = Service(scheduler=Scheduler(
+        ledger_path=str(tmp_path / "l.jsonl")), auto=False)
+    a = svc.submit(spec.to_json())
+    b = svc.submit(dataclasses.replace(spec, seeds=(2, 3)).to_json())
+    assert a["compile_key"] == b["compile_key"]      # same adversity
+    plain = svc.submit(_spec(obs=("metrics", "audit")).to_json())
+    assert plain["compile_key"] != a["compile_key"]  # program differs
+    svc.run_pending()
+    ra = svc.result(a["id"])
+    assert ra["status"] == "done"
+    assert ra["audit"]["clean"], ra["audit"]
+    assert ra["spec"]["fault_schedule"] == fs
+
+    # chaos + attack: the planted fault must still be caught
+    attacked = dataclasses.replace(
+        spec, seeds=(9,),
+        attack={"at_ms": 37, "leaf": "nodes.msg_sent", "node": 5,
+                "delta": -(1 << 20)})
+    c = svc.submit(attacked.to_json())
+    svc.run_pending()
+    rc = svc.result(c["id"])
+    assert rc["status"] == "done"
+    assert not rc["audit"]["clean"]
+    assert rc["audit"]["first"]["invariant"] == "counter_monotone"
+    assert rc["audit"]["first"]["ms"] == 37
+
+    # a malformed schedule 400s at submit with remedy text
+    with pytest.raises(ValueError, match="ONE partition at a time"):
+        svc.submit(_spec(fault_schedule={
+            "partitions": [[10, 50, 1, 0, 32],
+                           [20, 60, 2, 16, 48]]}).to_json())

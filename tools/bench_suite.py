@@ -320,6 +320,94 @@ def bench_serve_smoke():
         os.unlink(tmp)
 
 
+#: the chaos_smoke stage's schedule — module-level so the stage and its
+#: `_stage_spec` digest entry can never drift apart (transitions all
+#: even: the K=2 cross-variant pin needs window-aligned times)
+CHAOS_SMOKE_SCHEDULE = {
+    "churn": [[3, 20, 60], [5, 40, 100]],
+    "partitions": [[30, 90, 1, 0, 32]],
+    "loss": [[0, 120, 250, 0, 64, 0, 64]],
+}
+
+
+def bench_chaos_smoke():
+    """Chaos-plane smoke stage (PR 10): a tiny PingPong run under a
+    churn + mid-run-partition + message-loss schedule — cross-variant
+    bit-identity (dense vs superstep-2), a clean audit verdict over the
+    faulted trajectory, the `node_down`/`node_up` flight-recorder
+    kinds, a real impact vs the fault-free baseline, and one
+    `RunManifest` ledger row round-tripped (isolated temp file, the
+    audit_smoke convention) — the whole chaos path (FaultSchedule ->
+    ChaosProtocol -> engine hooks -> obs planes -> ledger) exercised
+    end to end in seconds."""
+    import dataclasses
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from wittgenstein_tpu.chaos import ChaosProtocol, FaultSchedule
+    from wittgenstein_tpu.models.pingpong import PingPong
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.obs.audit import AuditSpec
+    from wittgenstein_tpu.obs.audit_report import audit_block, audit_variant
+    from wittgenstein_tpu.obs.diff import first_divergence
+    from wittgenstein_tpu.obs.trace import TraceSpec, scan_chunk_trace
+    from wittgenstein_tpu.obs.decode import TraceFrame
+
+    proto = PingPong(node_count=64)
+    sched = FaultSchedule.from_json(CHAOS_SMOKE_SCHEDULE).validate(
+        n=64, sim_ms=120)
+    cp = ChaosProtocol(proto, sched)
+
+    # cross-variant bit-identity under faults (the chaos contract)
+    div = first_divergence(cp, {"superstep": 1}, {"superstep": 2}, 120)
+    assert div is None, f"chaos cross-variant divergence:\n{div.format()}"
+
+    # clean audit verdict over the FAULTED trajectory + impact
+    report, (nets, _) = audit_variant(cp, 120, {"superstep": 1},
+                                      AuditSpec())
+    assert report.clean, report.format()
+    blk = audit_block(report)
+    _, (nets0, _) = audit_variant(proto, 120, {"superstep": 1},
+                                  AuditSpec())
+    lost = (int(np.asarray(nets0.nodes.msg_received).sum()) -
+            int(np.asarray(nets.nodes.msg_received).sum()))
+    assert lost > 0, "the schedule had no observable impact"
+
+    # churn drives the node_down/node_up trace kinds at their exact ms
+    tspec = TraceSpec(capacity=4096)
+    _, _, tc = jax.jit(scan_chunk_trace(cp, 120, tspec))(*cp.init(0))
+    counts = TraceFrame.from_carry(tspec, tc).counts()
+    assert counts.get("node_down") == 2 and counts.get("node_up") == 2, \
+        counts
+
+    # ledger row round trip (isolated file; the real ledger still gets
+    # this stage's row via the suite's _append_ledger)
+    res = {"metric": "chaos_smoke_lost_msgs", "value": lost,
+           "unit": "messages", "sim_ms": 120, "superstep": 1,
+           "audit": blk, "schedule": sched.counts(),
+           "trace_counts": {k: counts[k]
+                            for k in ("node_down", "node_up")},
+           "platform": jax.default_backend()}
+    spec = _stage_spec("chaos_smoke")
+    fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        mani = ledger.manifest_from_spec(res, spec, label="chaos_smoke")
+        assert ledger.append(mani, tmp) == tmp, "ledger append failed"
+        rows = ledger.read_all(tmp)
+        assert len(rows) == 1 and rows[0].audit_clean, rows
+        assert rows[0].config_digest == spec.digest()
+        assert dataclasses.asdict(rows[0]) == dataclasses.asdict(mani), \
+            "ledger round-trip mismatch"
+    finally:
+        os.unlink(tmp)
+    res["ledger_round_trip"] = "ok"
+    json.dumps(res)                         # one-line-JSON embeddable
+    return res
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -328,6 +416,7 @@ CONFIGS = {
     "trace_smoke": bench_trace_smoke,
     "audit_smoke": bench_audit_smoke,
     "serve_smoke": bench_serve_smoke,
+    "chaos_smoke": bench_chaos_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -335,7 +424,8 @@ CONFIGS = {
 # on it never sees the failure line.
 METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "audit_smoke": "audit_smoke_violations",
-                "serve_smoke": "serve_smoke_requests"}
+                "serve_smoke": "serve_smoke_requests",
+                "chaos_smoke": "chaos_smoke_lost_msgs"}
 
 
 def _stage_spec(name):
@@ -395,6 +485,10 @@ def _stage_spec(name):
             protocol="PingPong", params={"node_count": 64}, seeds=(0,),
             sim_ms=120, chunk_ms=120, obs=("metrics", "audit"),
             superstep=1),
+        "chaos_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=120, obs=("audit",), superstep=1,
+            fault_schedule=CHAOS_SMOKE_SCHEDULE),
     }
     cfg = table.get(name)
     if cfg is None:

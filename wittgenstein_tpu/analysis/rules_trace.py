@@ -26,7 +26,7 @@ from .framework import Rule, register_rule
 from .rules_metrics import zero_cost_findings
 
 #: TraceCarry contributes this many pytree leaves (buf, cursor, dropped).
-_TRACE_CARRY_LEAVES = 3
+_TRACE_CARRY_LEAVES = 4     # buf, cursor, dropped, down (PR 10)
 
 #: analysis target-name suffix of the flight-recorder builds
 TRACE_SUFFIX = "+trace"

@@ -341,6 +341,48 @@ def _audit_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: The chaos-plane build (wittgenstein_tpu/chaos) audited under
+#: "<name>+chaos": the `ChaosProtocol` wrap is a different compiled
+#: program — the window-entry fault application and the per-ms outbox
+#: adversaries (loss draw + delay inflation) ride the scan body — so
+#: its host-sync profile, carry copies and carry width are gated
+#: separately, while every OTHER target's pinned carry width proves
+#: the chaos-OFF engine carries zero residue (the engine hook is a
+#: python-level getattr, never traced).  PingPong: broadcast protocol
+#: (partition state feeds the per-ms bc recompute) and the
+#: fast-forward clamp's main consumer.
+CHAOS_PROTOCOLS = ("PingPong",)
+CHAOS_SUFFIX = "+chaos"
+
+
+def _chaos_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(CHAOS_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..chaos import ChaosProtocol, FaultSchedule
+        from ..core.network import scan_chunk
+
+        inner = _registry()[base_name]()
+        n = inner.cfg.n
+        # every fault class live inside the CHUNK=8 ms window, all
+        # transitions even (superstep-2-compatible shape)
+        proto = ChaosProtocol(inner, FaultSchedule(
+            churn=((1, 2, 6),),
+            partitions=((2, 6, 1, 0, max(1, n // 2)),),
+            loss=((0, chunk, 250, 0, n, 0, n),),
+            delay=((2, 6, 1, 0, n, 0, n),)))
+        base = jax.vmap(scan_chunk(proto, chunk))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+chaos"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -538,6 +580,7 @@ def target_names() -> tuple:
                  sorted(f"{n}{FFM_SUFFIX}" for n in FFM_PROTOCOLS) +
                  sorted(f"{n}{TRACE_SUFFIX}" for n in TRACE_PROTOCOLS) +
                  sorted(f"{n}{AUDIT_SUFFIX}" for n in AUDIT_PROTOCOLS) +
+                 sorted(f"{n}{CHAOS_SUFFIX}" for n in CHAOS_PROTOCOLS) +
                  sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
@@ -550,6 +593,12 @@ def get_target(name: str) -> AnalysisTarget:
     if name.endswith(ROUTE_SUFFIX):
         raise KeyError(f"unknown pallas-route target {name!r}; known: "
                        f"{sorted(ROUTE_PROTOCOLS)}")
+    if name.endswith(CHAOS_SUFFIX):
+        if name[:-len(CHAOS_SUFFIX)] not in CHAOS_PROTOCOLS:
+            raise KeyError(
+                f"unknown chaos target {name!r}; known: "
+                f"{sorted(f'{n}{CHAOS_SUFFIX}' for n in CHAOS_PROTOCOLS)}")
+        return _chaos_target(name)
     if name.endswith(AUDIT_SUFFIX):
         if name[:-len(AUDIT_SUFFIX)] not in AUDIT_PROTOCOLS:
             raise KeyError(

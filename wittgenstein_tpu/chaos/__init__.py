@@ -1,0 +1,22 @@
+"""wittgenstein_tpu.chaos — the chaos plane: declarative fault
+schedules compiled into every engine variant.
+
+  FaultSchedule  — adversity as data: node crash/recover churn,
+                   mid-run partition/heal windows, per-link message
+                   loss and delay inflation, all bit-deterministic
+                   from (schedule, seed) (chaos/schedule.py);
+  ChaosProtocol  — the protocol proxy that compiles a schedule into
+                   the dense, superstep-K, batched, fast-forward and
+                   sharded engines through the window-entry
+                   `apply_faults` hook and the per-ms outbox adversary
+                   (chaos/wrap.py).
+
+Serve carries schedules as the `ScenarioSpec.fault_schedule` field
+(program-affecting: in digest + compile key); `tools/chaos.py` is the
+one-command cross-engine identity check and impact report.
+"""
+
+from .schedule import FaultSchedule
+from .wrap import ChaosProtocol, impact_summary
+
+__all__ = ["FaultSchedule", "ChaosProtocol", "impact_summary"]

@@ -1,0 +1,272 @@
+"""`ChaosProtocol` — compile a `FaultSchedule` into any protocol.
+
+The wrapper threads the schedule through every engine variant by the
+same two seams the observability planes use, so no engine grows a
+chaos-specific code path:
+
+  * `apply_faults(net, t, gids=None)` — the engine's window-entry
+    mutation hook (`core/network.step_ms` / `step_kms`, the batched
+    twin, the sharded step): churn down-state and partition membership
+    are STATELESS functions of t, evaluated and written at every
+    window entry.  Statelessness is what makes the fast-forward engine
+    sound (a landing window applies the cumulative state directly) and
+    what makes window-entry application bit-identical to per-ms
+    application whenever transitions are K-aligned (the
+    `superstep_aligned` contract, gated in `check_chunk_config`).
+  * `step` / `step_sharded` — the per-ms protocol step: the inner
+    step's outbox is post-processed with the loss/delay adversaries.
+    A lost unicast has its dest slot cleared (modeling link-level loss
+    before the NIC counts it; the engine then never routes it), a
+    delayed one gets `extra_ms` added to its sender-chosen delay (the
+    engine's own sendArriveAt lane).  Both are per-ms exact in every
+    variant because every engine runs the protocol step once per
+    simulated ms.
+
+Loss draws are counter-based (`ops/prng`) on (run seed, emit ms,
+stable full-width outbox slot id) — exactly the keying discipline of
+the engine's latency draws — so the realization is independent of
+batch/shard layout: dense, vmapped, batched, fast-forward and sharded
+runs of one (schedule, seed) agree bit for bit (tests/test_chaos.py).
+The per-step PRNG key the engine already passes in (a raw
+``fold_in(PRNGKey(seed), t)`` pair) is folded to the scalar stream
+seed, so the wrapper needs no state of its own.
+
+Fast-forward: the wrapper overrides `next_action_time` to clamp the
+quiet-window oracle at the next churn/partition transition — a jump
+may never cross one, because the oracle's delivery-validity reasoning
+(e.g. a cross-partition broadcast arrival it excluded) is evaluated
+under the CURRENT fault state and a transition can expand validity.
+Landing ON a transition is fine: the landing window's `apply_faults`
+evaluates the stateless fault state at the landing time.  Protocols
+without the oracle keep not having one (fast-forward then never
+jumps, which is trivially sound).
+
+Composes with `obs.diff.FaultInjector` (wrap in either order) and with
+every obs plane: taps observe the post-application state the engine
+actually runs, so audit verdicts stay clean under churn/partition
+(tests/test_chaos.py) and the flight recorder's `node_down`/`node_up`
+kinds record each churn transition at its exact ms (obs/trace.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.protocol import FAR_FUTURE
+from ..ops import prng
+from .schedule import FaultSchedule
+
+#: domain-separation tag for the loss draws (see ops/prng tags)
+TAG_CHAOS = 0x43484153      # "CHAS"
+
+
+def impact_summary(net) -> dict:
+    """The 4-counter impact fingerprint of a (possibly seed-batched)
+    final NetState — THE shared definition the bench `chaos` block and
+    `tools/chaos.py` both report, so the two impact-vs-baseline views
+    can never silently disagree."""
+    nodes = net.nodes
+    down = np.asarray(nodes.down)
+    return {
+        "done_count": int(((np.asarray(nodes.done_at) > 0) & ~down).sum()),
+        "live_count": int((~down).sum()),
+        "msg_sent": int(np.asarray(nodes.msg_sent).sum()),
+        "msg_received": int(np.asarray(nodes.msg_received).sum()),
+    }
+
+
+def _key_seed(key) -> jnp.ndarray:
+    """Fold the engine's per-step raw PRNG key (``fold_in(PRNGKey(seed),
+    t)``, a [2] uint32 pair) to one uint32 stream seed.  A pure
+    function of (run seed, t) — identical in every engine variant,
+    since they all derive the step key the same way."""
+    kd = jnp.asarray(key, jnp.uint32).reshape(-1)
+    return prng.hash2(kd[0] ^ kd[-1], TAG_CHAOS)
+
+
+class ChaosProtocol:
+    """Protocol proxy carrying a `FaultSchedule` (module docstring).
+    Everything not chaos-related delegates to the wrapped protocol, so
+    the pair satisfies the same contract (`cfg`, `latency`, `init`,
+    `schedule_lcm`/`phase_hints`, `may_self_send`, ...)."""
+
+    def __init__(self, inner, schedule: FaultSchedule):
+        if isinstance(schedule, dict):
+            schedule = FaultSchedule.from_json(schedule)
+        self._inner = inner
+        #: the engine gates key on this attribute (`superstep_ok`,
+        #: `check_chunk_config`) — one canonical name
+        self.chaos_schedule = schedule.validate(n=inner.cfg.n)
+        n = inner.cfg.n
+        sch = self.chaos_schedule
+
+        # -- churn: static (node, window) arrays + the owned-node mask
+        if sch.churn:
+            self._ch_node = jnp.asarray([e[0] for e in sch.churn],
+                                        jnp.int32)
+            self._ch_dm = jnp.asarray([e[1] for e in sch.churn], jnp.int32)
+            self._ch_um = jnp.asarray([e[2] for e in sch.churn], jnp.int32)
+            owned = np.zeros((n,), bool)
+            owned[[e[0] for e in sch.churn]] = True
+            self._ch_owned = jnp.asarray(owned)
+        # -- partitions: per-event static range masks (few events — the
+        # python loop in apply_faults stays tiny and fully unrolled)
+        if sch.partitions:
+            ever = np.zeros((n,), bool)
+            masks = []
+            for s, e, pid, lo, hi in sch.partitions:
+                m = np.zeros((n,), bool)
+                m[lo:hi] = True
+                ever |= m
+                masks.append(jnp.asarray(m))
+            self._pt_masks = masks
+            self._pt_ever = jnp.asarray(ever)
+        # -- link adversary windows keep their python tuples (static,
+        # unrolled in _mutate_outbox); precompute [n] range masks
+        if sch.loss or sch.delay:
+            self._link_masks = {}
+            for kind in ("loss", "delay"):
+                for ev in getattr(sch, kind):
+                    for lo, hi in ((ev[3], ev[4]), (ev[5], ev[6])):
+                        if (lo, hi) not in self._link_masks:
+                            m = np.zeros((n,), bool)
+                            m[lo:hi] = True
+                            self._link_masks[(lo, hi)] = jnp.asarray(m)
+        #: fault-state transition times for the fast-forward clamp
+        times = sch.transition_times()
+        self._trans = jnp.asarray(times, jnp.int32) if times else None
+        # a protocol without the quiet-window oracle must stay without
+        # one (next_work then treats every ms as active — the instance
+        # attribute shadows the class method below)
+        if getattr(inner, "next_action_time", None) is None:
+            self.next_action_time = None
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # --------------------------------------------- window-entry mutation
+
+    def apply_faults(self, net, t, gids=None):
+        """Write the schedule's churn/partition state for absolute time
+        `t` into `net.nodes` — the engine's window-entry hook.  Pure
+        and stateless in t; a no-op (bitwise) at every non-transition
+        ms.  `gids` (sharded engine) maps this shard's local rows to
+        global node ids; batched states ([R, N] node leaves) broadcast
+        against the [N] fault vectors.
+
+        Ownership contract: a node NAMED in a churn event has its down
+        flag fully owned by the schedule — outside its outage windows
+        it is UP, including at entry, overriding any down state the
+        protocol's init (or the spec's `partition` field) gave it.
+        Statelessness requires this: an OR against the carried flag
+        could never recover (the carried flag absorbs the outage).
+        Express an entry outage as a window starting at ms 0;
+        `ScenarioSpec.validate` refuses the partition-field clash."""
+        sch = self.chaos_schedule
+        if not sch.mutates_state:
+            return net
+        t = jnp.asarray(t, jnp.int32)
+        nodes = net.nodes
+        if sch.churn:
+            active = (self._ch_dm <= t) & (t < self._ch_um)      # [E]
+            down_vec = jnp.zeros((self.cfg.n,), bool).at[
+                self._ch_node].max(active)
+            owned = self._ch_owned
+            if gids is not None:
+                down_vec, owned = down_vec[gids], owned[gids]
+            nodes = nodes.replace(
+                down=jnp.where(owned, down_vec, nodes.down))
+        if sch.partitions:
+            part_vec = jnp.zeros((self.cfg.n,), jnp.int32)
+            managed = jnp.zeros((self.cfg.n,), bool)
+            for (s, e, pid, lo, hi), m in zip(sch.partitions,
+                                              self._pt_masks):
+                act = (t >= s) & (t < e)
+                hit = act & m
+                part_vec = jnp.where(hit, jnp.int32(pid), part_vec)
+                managed = managed | hit
+            ever = self._pt_ever
+            if gids is not None:
+                part_vec, managed, ever = (part_vec[gids], managed[gids],
+                                           ever[gids])
+            # inside a window: the window's id; outside every window: a
+            # managed node heals to the global partition 0 (the
+            # reference's endPartition); unmanaged nodes keep whatever
+            # partition the underlying state carries
+            nodes = nodes.replace(partition=jnp.where(
+                managed, part_vec,
+                jnp.where(ever, jnp.int32(0), nodes.partition)))
+        return net.replace(nodes=nodes)
+
+    # ------------------------------------------------- per-ms adversary
+
+    def _mutate_outbox(self, out, t, key, gids=None):
+        sch = self.chaos_schedule
+        if not (sch.loss or sch.delay):
+            return out
+        t = jnp.asarray(t, jnp.int32)
+        nl, ke = out.dest.shape
+        gid = gids if gids is not None \
+            else jnp.arange(self.cfg.n, dtype=jnp.int32)
+        dest = out.dest
+        live = dest >= 0
+        dst_c = jnp.clip(dest, 0, self.cfg.n - 1)
+
+        def link_match(ev):
+            s, e, _val, slo, shi, dlo, dhi = ev
+            act = (t >= s) & (t < e)
+            src_in = self._link_masks[(slo, shi)][gid][:, None]
+            dst_in = self._link_masks[(dlo, dhi)][dst_c]
+            return act & src_in & dst_in & live
+
+        if sch.delay:
+            extra = jnp.zeros((nl, ke), jnp.int32)
+            for ev in sch.delay:
+                extra = extra + jnp.where(link_match(ev),
+                                          jnp.int32(ev[2]), 0)
+            out = out.replace(delay=out.delay + extra)
+        if sch.loss:
+            keep = jnp.ones((nl, ke), jnp.float32)
+            for ev in sch.loss:
+                keep = keep * jnp.where(link_match(ev),
+                                        jnp.float32(1.0 - ev[2] / 1000.0),
+                                        jnp.float32(1.0))
+            # stable full-width slot id — the same id the engine keys
+            # the latency draw on (`_route_unicast`), so the draw is
+            # layout-independent
+            midx = gid[:, None] * self.cfg.out_deg + out.slot0 + \
+                jnp.arange(ke, dtype=jnp.int32)[None, :]
+            u = prng.uniform_float(_key_seed(key), midx)
+            lost = live & (u < (jnp.float32(1.0) - keep))
+            out = out.replace(dest=jnp.where(lost, jnp.int32(-1), dest))
+        return out
+
+    # ------------------------------------------------- protocol contract
+
+    def step(self, pstate, nodes, inbox, t, key, **kw):
+        pstate, nodes, out = self._inner.step(pstate, nodes, inbox, t,
+                                              key, **kw)
+        return pstate, nodes, self._mutate_outbox(out, t, key)
+
+    def step_sharded(self, pstate, nodes, inbox, t, key, gids):
+        inner = getattr(self._inner, "step_sharded", None)
+        if inner is not None:
+            pstate, nodes, out = inner(pstate, nodes, inbox, t, key, gids)
+        else:
+            pstate, nodes, out = self._inner.step(pstate, nodes, inbox,
+                                                  t, key)
+        return pstate, nodes, self._mutate_outbox(out, t, key, gids=gids)
+
+    def next_action_time(self, pstate, nodes, t):
+        """The inner oracle clamped at the next churn/partition
+        transition >= t (module docstring) — only defined when the
+        inner protocol has the oracle (see __init__)."""
+        nxt = self._inner.next_action_time(pstate, nodes, t)
+        if self._trans is None:
+            return nxt
+        t = jnp.asarray(t, jnp.int32)
+        nxt_f = jnp.min(jnp.where(self._trans >= t, self._trans,
+                                  jnp.int32(FAR_FUTURE)))
+        return jnp.minimum(jnp.asarray(nxt, jnp.int32),
+                           nxt_f).astype(jnp.int32)

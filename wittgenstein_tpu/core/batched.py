@@ -191,6 +191,12 @@ def step_kms_batched(protocol, net: NetState, pstate, k: int,
                          f"{len(hints_k)}")
     r = net.box_count.shape[0]
     t = net.time[0]
+    # Chaos-plane hook (see network.step_kms): one stateless window-entry
+    # application; the [N] fault vectors broadcast over the [R, N] node
+    # leaves, and K-aligned transitions keep the window state constant.
+    af = getattr(protocol, "apply_faults", None)
+    if af is not None:
+        net = af(net, t)
 
     inboxes = []
     for i in range(k):

@@ -468,6 +468,14 @@ def step_ms(protocol, net: NetState, pstate, hints=None, tap=None):
     """
     cfg, model = protocol.cfg, protocol.latency
     t = net.time
+    # Chaos-plane hook (wittgenstein_tpu/chaos): churn/partition state
+    # is a stateless function of t, written at every ms entry BEFORE
+    # anything observes or delivers — the tap then sees exactly the
+    # liveness the engine runs under.  Protocols without the hook trace
+    # zero extra operations (the zero-cost lints stay pinned).
+    af = getattr(protocol, "apply_faults", None)
+    if af is not None:
+        net = af(net, t)
     if tap is not None:
         tap(t, net, None)
     if cfg.bcast_slots > 0:
@@ -552,6 +560,14 @@ def step_kms(protocol, net: NetState, pstate, k: int, hints_k=None,
         raise ValueError("step_kms requires spill_cap == 0 (spill drain "
                          "is inherently per-ms)")
     t = net.time
+    # Chaos-plane hook: ONE stateless application per window.  Sound
+    # because `check_chunk_config` requires every churn/partition
+    # transition to be K-aligned, so the fault state is constant across
+    # the window — each in-window ms (inbox validity, routing validity,
+    # taps) sees exactly what the per-ms engine would.
+    af = getattr(protocol, "apply_faults", None)
+    if af is not None:
+        net = af(net, t)
     # Entry tap for the window's FIRST ms: before retire, matching the
     # per-ms path's observation point.  Later ms tap inside the loop —
     # their ring rows are untouched until the window's deferred clear,
@@ -721,12 +737,14 @@ def superstep_ok(protocol, superstep: int = 2) -> bool:
     eligibility predicate: scan_chunk raises on violations,
     Runner/harness demote to the largest valid K (`pick_superstep`)."""
     cfg = protocol.cfg
+    sched = getattr(protocol, "chaos_schedule", None)
     return (cfg.spill_cap == 0
             and superstep >= 1
             and cfg.horizon % superstep == 0
             and superstep < cfg.horizon
             and superstep <= unicast_floor_ms(protocol) + 1
-            and not getattr(protocol, "mutates_liveness", False))
+            and not getattr(protocol, "mutates_liveness", False)
+            and (sched is None or sched.superstep_aligned(superstep)))
 
 
 def fast_forward_ok(protocol) -> bool:
@@ -804,6 +822,19 @@ def check_chunk_config(protocol, ms, t0_mod=None, superstep=1,
                 "rows are read and cleared as one contiguous window. "
                 f"Fix: pad the horizon to a multiple of {k} (at least "
                 f"{2 * k}), or lower K")
+        sched = getattr(protocol, "chaos_schedule", None)
+        if sched is not None and not sched.superstep_aligned(k):
+            bad = [t for t in sched.transition_times() if t % k]
+            raise ValueError(
+                f"superstep={k} needs every chaos churn/partition "
+                f"transition on a K-ms window boundary (misaligned: "
+                f"{bad[:8]}): liveness/partition state is applied at "
+                "window entry, so a mid-window transition would be "
+                "visible to the per-ms engine but not the fused window. "
+                f"Fix: align the FaultSchedule times to multiples of "
+                f"{k}, pick a superstep dividing "
+                f"gcd={sched.align_gcd() or 1} of the transition times, "
+                "or fall back to superstep=1")
         floor = unicast_floor_ms(protocol)
         if k > floor + 1:
             self_send = getattr(protocol, "may_self_send", True)

@@ -231,14 +231,15 @@ def trace_to_perfetto(frame, path: str | None = None,
     so a flight-recorder capture, the metrics interval lane and the XLA
     op traces `tools/tpu_profile.py` parses all load on ONE Perfetto
     timeline.  Track assignment: sends/drops/spill parks on the SOURCE
-    node's track, deliveries/unparks on the DESTINATION's, node_down on
-    the node's own; engine-global events (bc_retire, ff_jump) on tid 0.
+    node's track, deliveries/unparks on the DESTINATION's,
+    node_down/node_up on the node's own; engine-global events
+    (bc_retire, ff_jump) on tid 0.
     `path` (optional) writes the JSON; a ``.gz`` suffix gzips it.
     """
     from .trace import EVENTS, KIND
 
     src_side = {KIND["send"], KIND["drop"], KIND["spill_park"],
-                KIND["node_down"]}
+                KIND["node_down"], KIND["node_up"]}
     events = [
         {"ph": "M", "pid": TRACE_PID, "name": "process_name",
          "args": {"name": f"{name} (simulated time)"}},

@@ -33,7 +33,13 @@ payload_bytes, aux)``.  Kinds (``EVENTS``; aux semantics per kind):
                 aux = table slot, dst = -1)
   ff_jump       quiet-window fast-forward jump (src = dst = -1,
                 aux = skipped ms; time = jump origin)
-  node_down     node observed newly down after a step (src = dst = id)
+  node_down     node newly down (src = dst = id): a chaos-plane churn
+                crash observed at ms entry (wittgenstein_tpu/chaos —
+                the carry tracks the last observed down state), or a
+                protocol-step liveness mutation observed right after
+                the step
+  node_up       node newly recovered (src = dst = id) — the churn
+                recovery twin of node_down
 
 Observation happens through the engine's `tap` hook
 (`core/network.step_ms` / `step_kms`): ``tap(t, net, None)`` at ms
@@ -70,7 +76,7 @@ from ..ops import prng
 #: Canonical event kinds; the kind CODE is the index here and is stable
 #: regardless of which subset a spec enables (decode uses this table).
 EVENTS = ("send", "deliver", "drop", "spill_park", "spill_unpark",
-          "bc_retire", "ff_jump", "node_down")
+          "bc_retire", "ff_jump", "node_down", "node_up")
 KIND = {name: i for i, name in enumerate(EVENTS)}
 
 #: Event record columns, in buffer order.
@@ -123,19 +129,30 @@ class TraceSpec:
 class TraceCarry:
     """The on-device event ring: ``buf[i]`` is the i-th recorded event
     (FIELDS order) for ``i < cursor``; `dropped` counts events that
-    found the ring full (saturating — never wraps negative)."""
+    found the ring full (saturating — never wraps negative); `down` is
+    the last OBSERVED per-node down state — the reference the
+    node_down/node_up churn detection differences against at every ms
+    entry ([0]-shaped when the builder passes no entry state, e.g. the
+    sharded recorder, whose scope note excludes liveness kinds)."""
 
     buf: jnp.ndarray        # int32 [capacity, 6]
     cursor: jnp.ndarray     # int32 scalar — rows written (<= capacity)
     dropped: jnp.ndarray    # int32 scalar
+    down: jnp.ndarray       # bool [N] (or [0] — churn detection off)
 
 
-def init_trace(spec: TraceSpec) -> TraceCarry:
-    """Fresh empty ring."""
+def init_trace(spec: TraceSpec, down=None) -> TraceCarry:
+    """Fresh empty ring.  `down` seeds the churn-detection reference
+    with the chunk ENTRY down state (builders pass ``net.nodes.down``),
+    so a fault landing exactly on the chunk's first ms is recorded and
+    a node already down at entry is not."""
+    if down is None:
+        down = jnp.zeros((0,), bool)
     return TraceCarry(
         buf=jnp.zeros((spec.capacity, len(FIELDS)), jnp.int32),
         cursor=jnp.asarray(0, jnp.int32),
-        dropped=jnp.asarray(0, jnp.int32))
+        dropped=jnp.asarray(0, jnp.int32),
+        down=jnp.asarray(down, bool))
 
 
 def _append(spec: TraceSpec, tc: TraceCarry, t, kind: int, src, dst,
@@ -208,6 +225,21 @@ def _entry_events(spec: TraceSpec, cfg, model, tc: TraceCarry, t,
     n = cfg.n
     t = jnp.asarray(t, jnp.int32)
     node_idx = jnp.arange(n, dtype=jnp.int32)
+    if tc.down.shape[0] > 0 and (spec.enabled("node_down")
+                                 or spec.enabled("node_up")):
+        # churn transitions: the engine's window-entry fault application
+        # (chaos plane) ran before this tap, so the liveness delta vs
+        # the last observed state IS the transition, at its exact ms —
+        # recorded first (the cause precedes the deliveries it gates)
+        cur = nodes.down
+        zero = jnp.zeros((n,), jnp.int32)
+        if spec.enabled("node_down"):
+            tc = _append(spec, tc, t, KIND["node_down"], node_idx,
+                         node_idx, zero, zero, cur & ~tc.down)
+        if spec.enabled("node_up"):
+            tc = _append(spec, tc, t, KIND["node_up"], node_idx,
+                         node_idx, zero, zero, (~cur) & tc.down)
+        tc = tc.replace(down=cur)
     if spec.enabled("deliver"):
         src, size, valid = _unicast_row(cfg, net, t)
         dst = jnp.broadcast_to(node_idx[:, None], (n, cfg.inbox_cap))
@@ -295,12 +327,21 @@ def _post_events(spec: TraceSpec, cfg, model, tc: TraceCarry, t, net,
                                jnp.where(nodes.down[dest_c], 2, 3))
             tc = _append(spec, tc, t, KIND["drop"], src, dest_c, size,
                          reason, want & ~valid)
-    if spec.enabled("node_down") and down0 is not None:
-        newly = nodes.down & ~down0
+    liveness = spec.enabled("node_down") or spec.enabled("node_up")
+    if liveness and down0 is not None:
+        # protocol-step liveness mutations (mutates_liveness protocols,
+        # FaultInjector plants) — the chaos plane's transitions are
+        # caught by the entry-tap detection instead
         node_idx = jnp.arange(n, dtype=jnp.int32)
         zero = jnp.zeros((n,), jnp.int32)
-        tc = _append(spec, tc, t, KIND["node_down"], node_idx, node_idx,
-                     zero, zero, newly)
+        if spec.enabled("node_down"):
+            tc = _append(spec, tc, t, KIND["node_down"], node_idx,
+                         node_idx, zero, zero, nodes.down & ~down0)
+        if spec.enabled("node_up"):
+            tc = _append(spec, tc, t, KIND["node_up"], node_idx,
+                         node_idx, zero, zero, (~nodes.down) & down0)
+    if liveness and tc.down.shape[0] > 0:
+        tc = tc.replace(down=nodes.down)
     return tc
 
 
@@ -374,7 +415,8 @@ def scan_chunk_trace(protocol, ms: int, spec: TraceSpec,
             return step(*carry), ()
 
         (net2, p2, tc), _ = jax.lax.scan(
-            body, (net, pstate, init_trace(spec)), length=ms // superstep)
+            body, (net, pstate, init_trace(spec, net.nodes.down)),
+            length=ms // superstep)
         return net2, p2, tc
 
     return run
@@ -399,7 +441,7 @@ def scan_chunk_batched_trace(protocol, ms: int, spec: TraceSpec,
     step = _step_window_trace(protocol, spec, superstep)
 
     def run(net, pstate):
-        tc0 = jax.vmap(lambda _: init_trace(spec))(net.time)
+        tc0 = jax.vmap(lambda n_: init_trace(spec, n_.nodes.down))(net)
 
         def body(carry, _):
             return jax.vmap(step)(*carry), ()
@@ -429,9 +471,9 @@ def fast_forward_chunk_trace(protocol, ms: int, spec: TraceSpec,
         t0 = net.time[0] if seed_axis else net.time
         t_end = t0 + ms
         if seed_axis:
-            tc0 = jax.vmap(lambda _: init_trace(spec))(net.time)
+            tc0 = jax.vmap(lambda n_: init_trace(spec, n_.nodes.down))(net)
         else:
-            tc0 = init_trace(spec)
+            tc0 = init_trace(spec, net.nodes.down)
 
         def cond(carry):
             t = carry[0].time[0] if seed_axis else carry[0].time

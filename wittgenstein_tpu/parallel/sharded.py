@@ -170,9 +170,15 @@ class ShardedRunner:
         uc_valid = jnp.arange(c)[None, :] < net.box_count[h][:, None]
         uc_valid = uc_valid & (~nodes.down[:, None])
         if part_all is not None:
-            # cross-partition unicasts were already filtered at enqueue;
-            # broadcasts are filtered here (delivery-time, like build_inbox)
-            pass
+            # delivery-time partition check, like build_inbox: enqueue
+            # already filtered cross-partition sends, so with STATIC
+            # partitions this is a no-op — but a mid-run partition
+            # (chaos plane) opening while a message is in flight must
+            # drop it at delivery exactly as the single-chip engine
+            # does (box_src carries global ids; empty slots are already
+            # masked by the count check above)
+            uc_valid = uc_valid & (part_all[uc_src] ==
+                                   nodes.partition[:, None])
 
         # broadcast recompute over GLOBAL destination ids
         gids = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
@@ -290,6 +296,15 @@ class ShardedRunner:
         def one_shard(snet: ShardedNet, pstate, tc=None):
             net = snet.net
             t = net.time
+            gids0 = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
+            # Chaos-plane hook (see network.step_kms): the window-entry
+            # fault application runs on the LOCAL node slice (gids map
+            # local rows to the schedule's global ids) BEFORE the
+            # replicated-table gathers below, so every shard's view of
+            # down/partition state is the post-fault one.
+            af = getattr(proto, "apply_faults", None)
+            if af is not None:
+                net = af(net, t, gids=gids0)
             # replicated per-node tables for cross-shard checks (one [N]
             # all_gather each; rides the same ICI exchange)
             part_all = jax.lax.all_gather(net.nodes.partition,
@@ -309,7 +324,6 @@ class ShardedRunner:
             else:
                 tables = None
             snet = snet.replace(net=net)
-            gids0 = snet.shard_id * nl + jnp.arange(nl, dtype=jnp.int32)
             step = getattr(proto, "step_sharded", None)
             aobs = None
             if audit_spec is not None:

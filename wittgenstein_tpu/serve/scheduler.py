@@ -24,6 +24,22 @@ Each finished request gets per-request artifacts (ProgressPerTime-style
 `engine_metrics` block, `trace` block, `audit` block, final-state
 summary) and ONE `RunManifest` ledger row whose `config_digest` is the
 spec digest (obs/ledger.py).
+
+Resilience (PR 10): every device-program launch goes through
+`_launch` — a failed chunk is retried with exponential backoff
+(`max_retries`, `retry_backoff_s`; `launcher` is the injectable seam
+the fault-injection tests drive), and a launch that keeps failing at
+full batch width DEGRADES instead of dropping requests: the lane batch
+is split in half and the halves run sequentially (recursively, down to
+one lane), then re-concatenated — bit-identical state for the scan
+engines, since the per-lane trajectory never depends on its batch
+neighbors.  With `checkpoint_dir` set, the group state is written at
+every chunk boundary (utils/checkpoint .npz + request metadata) and
+`resume_checkpoints()` re-enqueues interrupted groups after a crash:
+the resumed trajectory is bit-identical to an uninterrupted run
+(chunk-boundary restore of a deterministic pure engine is exact —
+tests/test_serve_resilience.py), with obs-plane artifacts covering the
+post-restore span and the ledger row carrying `resumed_from_ms`.
 """
 
 from __future__ import annotations
@@ -69,6 +85,11 @@ class Request:
     #: EngineConfig captured at lane init (protocol construction is
     #: heavy host work at tier-2 sizes — never rebuilt just for .cfg)
     cfg: object = None
+    #: checkpoint-restored (net, pstate) lane slices — consumed by
+    #: `_init_lanes` instead of a fresh init (see resume_checkpoints)
+    restored_state: tuple | None = None
+    #: chunk boundary this request resumed from (0 = never resumed)
+    resumed_from_ms: int = 0
 
     def status_json(self) -> dict:
         out = {"id": self.id, "status": self.status,
@@ -88,7 +109,10 @@ class _Lane:
     def __init__(self, req: Request):
         self.req = req
         self.width = len(req.spec.seeds)
-        self.remaining = req.spec.sim_ms // req.spec.chunk_ms
+        # a checkpoint-restored request re-enters with progress already
+        # made — only the remaining chunks run
+        self.remaining = (req.spec.sim_ms -
+                          req.progress_ms) // req.spec.chunk_ms
         self.carries: dict = {}     # plane -> [per-chunk carry slices]
 
     def stash(self, plane: str, carry, lo: int):
@@ -102,9 +126,23 @@ class Scheduler:
     thread at a time (a second concurrent call returns immediately)."""
 
     def __init__(self, registry: CompileRegistry | None = None,
-                 ledger_path=None, on_boundary=None, keep_done: int = 256):
+                 ledger_path=None, on_boundary=None, keep_done: int = 256,
+                 launcher=None, max_retries: int = 2,
+                 retry_backoff_s: float = 0.05, checkpoint_dir=None):
         self.registry = registry or CompileRegistry()
         self.ledger_path = ledger_path      # None = the shared default
+        #: the device-program launch seam: ``launcher(fn, *args)``
+        #: (default: call fn).  Tests inject flaky/width-limited
+        #: launchers to drive the retry and degradation paths.
+        self.launcher = launcher
+        #: failed-launch retries per width level before degrading
+        self.max_retries = int(max_retries)
+        #: base backoff (doubles per attempt); 0 disables sleeping
+        self.retry_backoff_s = float(retry_backoff_s)
+        #: directory for chunk-boundary group checkpoints (None = off)
+        self.checkpoint_dir = checkpoint_dir
+        #: resilience accounting, surfaced in per-request artifacts
+        self.resilience = {"retries": 0, "demotions": 0, "resumed": 0}
         #: test/ops hook: called at every chunk boundary of a running
         #: group, BEFORE admission — a callback may `submit()` and see
         #: its request join this group (the continuous-batching pin)
@@ -131,6 +169,12 @@ class Scheduler:
         with self._mu:
             self._n += 1
             rid = f"r{self._n:04d}"
+            while rid in self._requests:
+                # checkpoint-restored requests keep their original ids
+                # (resume_checkpoints), which may sit ahead of this
+                # scheduler's counter — never overwrite one
+                self._n += 1
+                rid = f"r{self._n:04d}"
             self._requests[rid] = Request(id=rid, spec=resolved,
                                           compile_key=key,
                                           requested=spec)
@@ -209,6 +253,12 @@ class Scheduler:
         for req in reqs:
             spec = req.spec
             req.cfg = proto.cfg
+            if req.restored_state is not None:
+                # checkpoint-restored lanes re-enter with their saved
+                # chunk-boundary state (partition/faults already in it)
+                states.append(req.restored_state)
+                req.restored_state = None
+                continue
             seeds = jnp.asarray(spec.seeds, jnp.int32)
             nets, ps = jax.vmap(proto.init)(seeds)
             if spec.partition:
@@ -241,6 +291,167 @@ class Scheduler:
         idx = jnp.asarray(idx, jnp.int32)
         return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), state)
 
+    # --------------------------------------------------------- resilience
+
+    @staticmethod
+    def _split_state(state, w: int):
+        left = jax.tree.map(lambda x: x[:w], state)
+        right = jax.tree.map(lambda x: x[w:], state)
+        return left, right
+
+    @staticmethod
+    def _combine(a, b, engine: str, has_plane: bool):
+        """Re-concatenate two half-batch chunk results into the full-
+        width result tuple the callers index (``out[0], out[1],
+        out[2]=stats (ff), out[-1]=carry``).  State and plane carries
+        concatenate on the seed axis; the fast-forward skip stats are
+        batch-level scalars and sum."""
+        def cat(x, y):
+            return jnp.concatenate([jnp.asarray(x), jnp.asarray(y)],
+                                   axis=0)
+
+        state = jax.tree.map(cat, (a[0], a[1]), (b[0], b[1]))
+        out = [state[0], state[1]]
+        if engine == "fast_forward":
+            out.append(jax.tree.map(lambda x, y: x + y, a[2], b[2]))
+        if has_plane:
+            out.append(jax.tree.map(cat, a[-1], b[-1]))
+        return tuple(out)
+
+    def _launch(self, fn, entry, widths, engine: str, has_plane: bool):
+        """Run one chunk program with retry-with-backoff and batch-width
+        degradation (module docstring).  `entry` is the concatenated
+        (net, pstate) batch; `widths` the per-lane seed counts — the
+        only legal split points (a lane's seeds stay together so carry
+        slicing by lane offset keeps working)."""
+        call = self.launcher or (lambda f, *a: f(*a))
+        last = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                return call(fn, *entry)
+            except Exception as e:      # noqa: BLE001 — retry any launch
+                last = e
+                if attempt < self.max_retries:
+                    self.resilience["retries"] += 1
+                    if self.retry_backoff_s:
+                        time.sleep(self.retry_backoff_s * (2 ** attempt))
+        if len(widths) > 1:
+            # graceful degradation: halve the lane batch and run the
+            # halves sequentially instead of dropping the requests
+            self.resilience["demotions"] += 1
+            mid = len(widths) // 2
+            w_left = int(sum(widths[:mid]))
+            left, right = self._split_state(entry, w_left)
+            out_l = self._launch(fn, left, widths[:mid], engine,
+                                 has_plane)
+            out_r = self._launch(fn, right, widths[mid:], engine,
+                                 has_plane)
+            return self._combine(out_l, out_r, engine, has_plane)
+        raise last
+
+    # -------------------------------------------------------- checkpoints
+
+    def _ckpt_path(self, key: str) -> str | None:
+        if not self.checkpoint_dir:
+            return None
+        import os
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, f"group-{key[:16]}.npz")
+
+    def _save_checkpoint(self, key: str, lanes: list, state):
+        """Write the group's chunk-boundary state + request metadata
+        (atomic replace — a crash mid-write leaves the previous
+        boundary's file intact).  Never raises into the drain loop:
+        checkpointing is insurance, not a dependency."""
+        path = self._ckpt_path(key)
+        if path is None:
+            return
+        import os
+
+        from ..utils import checkpoint
+        meta = {"compile_key": key, "schema": 1,
+                "requests": [
+                    {"id": ln.req.id,
+                     "spec": ln.req.spec.to_json(),
+                     "requested": (ln.req.requested
+                                   or ln.req.spec).to_json(),
+                     "progress_ms": ln.req.progress_ms,
+                     "width": ln.width} for ln in lanes]}
+        try:
+            tmp = path + ".tmp.npz"
+            checkpoint.save(tmp, state[0], state[1], meta=meta)
+            os.replace(tmp, path)
+        except Exception as e:      # noqa: BLE001 — insurance only
+            import sys
+            print(f"serve: checkpoint write failed for group {key[:8]}: "
+                  f"{type(e).__name__}: {e!s:.200}", file=sys.stderr)
+
+    def _drop_checkpoint(self, key: str):
+        path = self._ckpt_path(key)
+        if path is None:
+            return
+        import contextlib
+        import os
+        with contextlib.suppress(OSError):
+            os.remove(path)
+
+    def resume_checkpoints(self) -> list:
+        """Re-enqueue every interrupted group found in
+        `checkpoint_dir`; returns the re-created request ids.  Each
+        request resumes from its group's last written chunk boundary —
+        the continuation is bit-identical to an uninterrupted run
+        (chunk-boundary restore of the deterministic pure engine), so
+        `first_divergence`-style full-pytree comparison passes
+        (tests/test_serve_resilience.py).  Run `run_pending()` (or the
+        service worker) afterwards to drive them to completion."""
+        import glob
+        import os
+        if not self.checkpoint_dir:
+            return []
+        resumed = []
+        for path in sorted(glob.glob(os.path.join(
+                self.checkpoint_dir, "group-*.npz"))):
+            try:
+                resumed += self._resume_one(path)
+            except Exception as e:      # noqa: BLE001 — one bad file
+                # must not block the others
+                import sys
+                print(f"serve: checkpoint resume failed for {path}: "
+                      f"{type(e).__name__}: {e!s:.300}", file=sys.stderr)
+        return resumed
+
+    def _resume_one(self, path: str) -> list:
+        from ..utils import checkpoint
+        specs_meta = checkpoint.peek_meta(path)
+        reqs_meta = specs_meta["requests"]
+        spec0 = ScenarioSpec.from_json(reqs_meta[0]["spec"])
+        proto = spec0.build_protocol()
+        net, ps, _ = checkpoint.load(path, proto, seed=0)
+        rids = []
+        lo = 0
+        with self._mu:
+            for rm in reqs_meta:
+                spec = ScenarioSpec.from_json(rm["spec"])
+                w = int(rm["width"])
+                sl = jax.tree.map(lambda x, lo=lo, w=w: x[lo:lo + w],
+                                  (net, ps))
+                lo += w
+                self._n += 1
+                rid = rm["id"] if rm["id"] not in self._requests \
+                    else f"r{self._n:04d}"
+                req = Request(
+                    id=rid, spec=spec,
+                    compile_key=specs_meta["compile_key"],
+                    requested=ScenarioSpec.from_json(rm["requested"]))
+                req.progress_ms = int(rm["progress_ms"])
+                req.resumed_from_ms = int(rm["progress_ms"])
+                req.restored_state = sl
+                self._requests[rid] = req
+                self._queue.append(rid)
+                rids.append(rid)
+            self.resilience["resumed"] += len(rids)
+        return rids
+
     # ------------------------------------------------------------ the run
 
     def _run_group(self, key: str) -> int:
@@ -248,6 +459,18 @@ class Scheduler:
         if not reqs:
             return 0
         spec0 = reqs[0].spec
+        if spec0.engine != "vmapped" and len(reqs) > 1:
+            # lockstep engines (one fused mailbox / one shared jump)
+            # need equal clocks across the batch: a checkpoint-resumed
+            # request's progress differs from a fresh one's, so only
+            # same-progress requests group together; the rest go back
+            # to the queue head and form the next group
+            head_prog = reqs[0].progress_ms
+            defer = [r for r in reqs if r.progress_ms != head_prog]
+            if defer:
+                with self._mu:
+                    self._queue[0:0] = [r.id for r in defer]
+                reqs = [r for r in reqs if r.progress_ms == head_prog]
         planes = list(spec0.obs)
         primary = "metrics" if "metrics" in planes else None
         shadows = [p for p in planes if p != primary]
@@ -272,7 +495,9 @@ class Scheduler:
                       for p in shadows]
         while lanes:
             entry = state
-            out = fn(*entry)
+            widths = [ln.width for ln in lanes]
+            out = self._launch(fn, entry, widths, spec0.engine,
+                               primary is not None)
             state = (out[0], out[1])
             if spec0.engine == "fast_forward":
                 st = out[2]
@@ -285,7 +510,8 @@ class Scheduler:
                 for ln, lo in zip(lanes, offsets):
                     ln.stash(primary, out[-1], int(lo))
             for plane, sfn in shadow_fns:
-                sout = sfn(*entry)
+                sout = self._launch(sfn, entry, widths, spec0.engine,
+                                    True)
                 for ln, lo in zip(lanes, offsets):
                     ln.stash(plane, sout[-1], int(lo))
             # snapshots force a device sync — compute them OUTSIDE the
@@ -318,6 +544,11 @@ class Scheduler:
                 lanes = [ln for ln in lanes if ln.remaining > 0]
                 if lanes:
                     state = self._take_lanes(state, keep)
+            if self.checkpoint_dir:
+                if lanes:
+                    self._save_checkpoint(key, lanes, state)
+                else:
+                    self._drop_checkpoint(key)
             if self.on_boundary is not None:
                 self.on_boundary()
             if admit_inflight:
@@ -373,8 +604,15 @@ class Scheduler:
         }
         if ff_stats is not None:
             art["fast_forward"] = dict(ff_stats)    # group-level skips
+        art["resilience"] = dict(self.resilience)   # scheduler-level
         line = {"metric": f"serve_{req.id}", "sim_ms": spec.sim_ms,
                 "superstep": spec.superstep, "batch": len(spec.seeds)}
+        if req.resumed_from_ms:
+            # the obs-plane blocks below cover the post-restore span;
+            # the trajectory itself is bit-identical to an
+            # uninterrupted run (module docstring)
+            art["resumed_from_ms"] = req.resumed_from_ms
+            line["resumed_from_ms"] = req.resumed_from_ms
         if "metrics" in ln.carries:
             from ..obs.export import MetricsFrame, engine_metrics_block
             from ..obs.spec import MetricsSpec

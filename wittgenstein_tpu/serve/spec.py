@@ -41,7 +41,13 @@ import json
 #: 2 (PR 9): + `latency_model` (registry-validated, program-affecting)
 #: and `route_kernel` ("xla" | "pallas" — the WTPU_PALLAS_ROUTE knob
 #: as a per-spec program field); digests of schema-1 specs change.
-SCHEMA = 2
+#: 3 (PR 10): + `fault_schedule` (a `chaos.FaultSchedule` JSON object —
+#: churn/partition/loss/delay adversity as data; program-affecting:
+#: the `ChaosProtocol` wrap is part of the compiled program, so it
+#: folds into BOTH digest and compile_key).  The entry-only
+#: `partition` field (nodes down at entry) keeps its data-only role;
+#: mid-run partition/heal windows live in the schedule.
+SCHEMA = 3
 
 #: routing-kernel selection the registry honors per spec
 #: (ops/pallas_route.py): the fused Pallas binning megakernel or the
@@ -107,6 +113,10 @@ class ScenarioSpec:
     partition: tuple = ()        # node ids down at entry (data, not program)
     latency_model: str | None = None   # registry name; None = protocol default
     route_kernel: str = "xla"    # "xla" | "pallas" (ops/pallas_route.py)
+    #: chaos.FaultSchedule JSON: churn [[node, down, up]], partitions
+    #: [[start, end, pid, lo, hi]], loss/delay windows — mid-run
+    #: adversity as data (program-affecting; schema 3)
+    fault_schedule: dict | None = None
     schema: int = SCHEMA
 
     def __post_init__(self):
@@ -135,6 +145,20 @@ class ScenarioSpec:
             # requester never meant (and mislabel the A/B)
             raise _err(f"unknown route_kernel {self.route_kernel!r}; "
                        f"known: {ROUTE_KERNELS}")
+        if self.fault_schedule is not None:
+            # normalize through the schedule's own canonical form so
+            # equal adversity always digests equal (key order, empty
+            # fault classes, int coercion); a malformed schedule is
+            # refused at construction with the schedule's remedy text
+            # (unknown fault classes, wrong arity — the 400 path)
+            from ..chaos import FaultSchedule
+            try:
+                canon = FaultSchedule.from_json(self.fault_schedule)
+            except ValueError as e:
+                raise _err(str(e)) from None
+            object.__setattr__(self, "fault_schedule",
+                               canon.to_json() if not canon.empty
+                               else None)
 
     # ------------------------------------------------------- serialization
 
@@ -203,6 +227,10 @@ class ScenarioSpec:
             "attack": spec.attack,
             "latency_model": spec.latency_model,
             "route_kernel": spec.route_kernel,
+            # the ChaosProtocol wrap is compiled into the chunk program
+            # (window-entry fault application + outbox adversaries), so
+            # two specs differing only in adversity must never coalesce
+            "fault_schedule": spec.fault_schedule,
         })
 
     # ---------------------------------------------------------- validation
@@ -268,6 +296,32 @@ class ScenarioSpec:
                            f"{sorted(self.attack)}")
         proto = self.build_protocol(wrap_attack=False)
         n = proto.cfg.n
+        if self.fault_schedule is not None:
+            # full refusal-with-remedy pass over the adversity windows
+            # (overlapping partition claims, out-of-range nodes/links,
+            # windows outside the simulated span) — the 400 path for
+            # mid-run partition/endPartition as data
+            from ..chaos import FaultSchedule
+            try:
+                fs = FaultSchedule.from_json(self.fault_schedule)
+                fs.validate(n=n, sim_ms=self.sim_ms)
+            except ValueError as e:
+                raise _err(str(e)) from None
+            clash = sorted({node for node, _, _ in fs.churn}
+                           & set(self.partition))
+            if clash:
+                # churn OWNS its named nodes' down flag (a stateless
+                # function of t — outside an outage window the node is
+                # UP, entry included), so a node both down-at-entry and
+                # churn-managed would be silently revived at ms 0
+                raise _err(
+                    f"node(s) {clash} appear in both `partition` (down "
+                    "at entry) and the fault_schedule's churn: churn "
+                    "owns its nodes' liveness for the whole run, which "
+                    "would override the entry outage. Fix: express the "
+                    "entry outage as a churn window starting at ms 0 "
+                    "(e.g. [node, 0, up_ms]), or drop the node from "
+                    "`partition`")
         bad_nodes = [i for i in self.partition if not 0 <= i < n]
         if bad_nodes:
             raise _err(f"partition node id(s) {bad_nodes} out of range "
@@ -356,8 +410,14 @@ class ScenarioSpec:
 
     def build_protocol(self, wrap_attack: bool = True):
         """Instantiate the protocol (plus the `FaultInjector` wrap when
-        an attack is configured — the wrap is part of the compiled
-        program, which is why `attack` is in the compile key)."""
+        an attack is configured, plus the `ChaosProtocol` wrap when a
+        fault schedule is — both wraps are part of the compiled
+        program, which is why `attack` AND `fault_schedule` are in the
+        compile key).  The chaos wrap is outermost and always applied
+        (it carries the engine-gating `chaos_schedule` attribute the
+        superstep/fast-forward eligibility checks consult), so the
+        `wrap_attack=False` validation build judges the same program
+        shape the scheduler runs."""
         from ..core.protocol import get_protocol
 
         proto = get_protocol(self.protocol)(**self._effective_params())
@@ -367,6 +427,10 @@ class ScenarioSpec:
                                   leaf=str(self.attack["leaf"]),
                                   node=int(self.attack["node"]),
                                   delta=self.attack.get("delta", 1))
+        if self.fault_schedule is not None:
+            from ..chaos import ChaosProtocol, FaultSchedule
+            proto = ChaosProtocol(
+                proto, FaultSchedule.from_json(self.fault_schedule))
         return proto
 
     # ------------------------------------------------------- env capture
@@ -477,7 +541,25 @@ class ScenarioSpec:
             obs.append("audit")
         sim_ms = _int("WTPU_BENCH_MS", 1000)
         chunk = _int("WTPU_BENCH_CHUNK", 200)
+        # WTPU_CHAOS carries a FaultSchedule as inline JSON — program-
+        # affecting (the ChaosProtocol wrap), so it must fold into the
+        # digest when set.  Tolerant like every capture here: a
+        # malformed value warns and is dropped (bench's own chaos
+        # block refuses loudly before any ledger append).
+        fault_schedule = None
+        chaos_raw = env.get("WTPU_CHAOS")
+        if chaos_raw and chaos_raw != "0":
+            import sys
+            try:
+                from ..chaos import FaultSchedule
+                canon = FaultSchedule.from_json(chaos_raw)
+                fault_schedule = canon.to_json() if not canon.empty \
+                    else None
+            except (ValueError, TypeError) as e:
+                print(f"bench: ignoring malformed WTPU_CHAOS: {e}",
+                      file=sys.stderr)
         return cls(
+            fault_schedule=fault_schedule,
             protocol=protocol, params=params,
             seeds=tuple(range(_int("WTPU_BENCH_SEEDS", 16))),
             sim_ms=max(1, -(-sim_ms // chunk)) * chunk,   # chunk-rounded,

@@ -31,10 +31,23 @@ def save(path: str, net, pstate, meta: dict | None = None) -> None:
     np.savez_compressed(path, **arrays)
 
 
+def peek_meta(path: str) -> dict:
+    """Read ONLY the metadata dict of a checkpoint — the serve plane's
+    resume path needs the stored request specs to rebuild the pytree
+    template before it pays for the leaf arrays."""
+    with np.load(path) as z:
+        return json.loads(bytes(z["__meta__"]).decode()) \
+            if "__meta__" in z else {}
+
+
 def load(path: str, protocol, seed=0):
     """Restore (net, pstate, meta).  `protocol` must be constructed with
     the same parameters as at save time — its `init` supplies the pytree
-    structure the stored leaves are poured back into."""
+    structure the stored leaves are poured back into.  Only the TREE
+    STRUCTURE comes from the template (leaf shapes/dtypes restore from
+    the file), so vmap-batched states — the serve scheduler's
+    concatenated lane batches, the bench's seed batches — round-trip
+    through the same single-seed template."""
     net0, ps0 = protocol.init(seed)
     _, treedef = jax.tree.flatten((net0, ps0))
     with np.load(path) as z:
