@@ -356,6 +356,16 @@ def _route_stats(base, init, eff_ss, engine):
     return out
 
 
+def _latency_env():
+    """The run's latency-model registry name, or None.  One resolution
+    rule for every bench branch: legacy WTPU_BENCH_LATENCY wins over
+    canonical WTPU_LATENCY (ScenarioSpec.from_env refuses the
+    double-set loudly), and '0' means unset — the from_env convention."""
+    lat = (os.environ.get("WTPU_BENCH_LATENCY")
+           or os.environ.get("WTPU_LATENCY"))
+    return lat if lat and lat != "0" else None
+
+
 def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
                   superstep, box_split=1, route_stats=False):
     """Build the benchmark's (step, init, steps, check, proto,
@@ -407,11 +417,16 @@ def _handel_setup(n, seeds, sim_ms, chunk, mode, horizon, inbox_cap,
             kw["state_split"] = _int_env("WTPU_BENCH_STATE_SPLIT", 1)
         if os.environ.get("WTPU_BENCH_PALLAS"):
             kw["pallas_merge"] = os.environ["WTPU_BENCH_PALLAS"] == "1"
-    # WTPU_BENCH_LATENCY overrides the latency model by registry name —
-    # the floor-rich A/B lever (e.g. "NetworkFixedLatency(16)" licenses
-    # the superstep-K ladder; the default distance model floors at 2).
-    if os.environ.get("WTPU_BENCH_LATENCY"):
-        kw["network_latency_name"] = os.environ["WTPU_BENCH_LATENCY"]
+    # WTPU_BENCH_LATENCY / WTPU_LATENCY override the latency model by
+    # registry name — the floor-rich A/B lever (e.g.
+    # "NetworkFixedLatency(16)" licenses the superstep-K ladder; the
+    # default distance model floors at 2).  WTPU_LATENCY is the
+    # canonical spelling captured into the spec's `latency_model` field
+    # (ScenarioSpec.from_env refuses unknown names AND a double-set
+    # loudly), so the ledger row records the model this setup builds.
+    lat = _latency_env()
+    if lat:
+        kw["network_latency_name"] = lat
     proto = Handel(node_count=n, threshold=int(0.99 * (n - down)),
                    nodes_down=down, pairing_time=4, level_wait_time=50,
                    dissemination_period_ms=20, fast_path=10, mode=mode,
@@ -698,24 +713,27 @@ def bench_quiet(proto_name, n=256, seeds=4, sim_ms=1000, chunk=200,
                                                scan_chunk)
     from wittgenstein_tpu.utils.measure import timed_chunks
     fast_forward = os.environ.get("WTPU_FAST_FORWARD") == "1"
+    # WTPU_LATENCY (the canonical spec-field spelling — from_env
+    # refuses unknown names) / legacy WTPU_BENCH_LATENCY: the quiet
+    # protocols honor the selection too, so the ledger row's
+    # latency_model is always the model the run compiled.
+    lat = _latency_env()
+    lat_kw = {"network_latency_name": lat} if lat else {}
     if proto_name == "pingpong":
         from wittgenstein_tpu.models.pingpong import PingPong
-        proto = PingPong(node_count=n)
+        proto = PingPong(node_count=n, **lat_kw)
     elif proto_name == "dfinity":
         from wittgenstein_tpu.models.dfinity import Dfinity
-        proto = Dfinity()
+        proto = Dfinity(**lat_kw)
     elif proto_name == "p2pflood":
         # Flood-shaped traffic: every live node fans out per ms — the
         # binning-bound extreme, the routing-megakernel A/B workload
-        # (WTPU_BENCH_LATENCY picks the floor-rich model that licenses
-        # the K ladder; no-self-send floor = the model's).
+        # (the latency override picks the floor-rich model that
+        # licenses the K ladder; no-self-send floor = the model's).
         from wittgenstein_tpu.models.p2pflood import P2PFlood
-        kw = {}
-        if os.environ.get("WTPU_BENCH_LATENCY"):
-            kw["network_latency_name"] = os.environ["WTPU_BENCH_LATENCY"]
         proto = P2PFlood(node_count=n, dead_node_count=n // 10,
                          peers_count=8, delay_before_resent=1,
-                         delay_between_sends=1, **kw)
+                         delay_between_sends=1, **lat_kw)
     else:
         raise ValueError(f"unknown WTPU_BENCH_PROTO {proto_name!r}; "
                          "known: handel pingpong dfinity p2pflood")

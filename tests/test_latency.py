@@ -10,7 +10,8 @@ import pytest
 from wittgenstein_tpu.core import builders, geo
 from wittgenstein_tpu.core.latency import (
     AWS_RTT, AwsRegionNetworkLatency, MathisNetworkThroughput,
-    MeasuredNetworkLatency, NetworkLatencyByCity, NetworkLatencyByCityWJitter,
+    MeasuredNetworkLatency, NetworkHeterogeneousLatency,
+    NetworkLatencyByCity, NetworkLatencyByCityWJitter,
     NetworkLatencyByDistanceWJitter, NetworkFixedLatency, estimate_latency,
     full_latency, get_by_name)
 
@@ -97,6 +98,74 @@ def test_mathis_throughput():
     # Mathis bound: rate = MSS*8/(RTT*sqrt(loss)) ~= 1847 bits/ms at
     # RTT=100 -> 8e7-bit transfer ~= 43.3 s + 50 ms.
     assert 40_000 <= int(big[0]) <= 47_000
+
+
+def test_heterogeneous_latency_model():
+    """The per-link heterogeneous/asymmetric model (PR 12): stable
+    seed-keyed link map, direction skew, registry round-trip, and the
+    refuse-with-remedy paths the spec's 400 depends on."""
+    nodes = builders.NodeBuilder().build(0, 64)
+    m = get_by_name("NetworkHeterogeneousLatency(20,10,6,3)")
+    assert isinstance(m, NetworkHeterogeneousLatency)
+    assert repr(m) == "NetworkHeterogeneousLatency(20,10,6,3)"
+    src = jnp.arange(64, dtype=jnp.int32)
+    dst = jnp.roll(src, 1)
+    delta = jnp.zeros(64, jnp.int32)
+    fwd = np.asarray(full_latency(m, nodes, src, dst, delta))
+    rev = np.asarray(full_latency(m, nodes, dst, src, delta))
+    # bounds: base <= extended <= base + spread + skew
+    assert fwd.min() >= 20 and fwd.max() <= 20 + 10 + 6
+    # heterogeneous: different links draw different latencies
+    assert len(set(fwd.tolist())) > 1
+    # ASYMMETRIC: some ordered pair differs from its reverse
+    assert (fwd != rev).any()
+    # deterministic: same call, same map; delta is unused by design
+    again = np.asarray(full_latency(m, nodes, src, dst,
+                                    jnp.full(64, 37, jnp.int32)))
+    np.testing.assert_array_equal(fwd, again)
+    # seed-keyed: a different seed is a different stable topology
+    m2 = get_by_name("NetworkHeterogeneousLatency(20,10,6,4)")
+    assert (np.asarray(full_latency(m2, nodes, src, dst, delta))
+            != fwd).any()
+    # spread=0, skew=0 degenerates to the fixed model
+    flat = get_by_name("NetworkHeterogeneousLatency(25)")
+    np.testing.assert_array_equal(
+        np.asarray(full_latency(flat, nodes, src, dst, delta)),
+        np.asarray(full_latency(NetworkFixedLatency(25), nodes, src,
+                                dst, delta)))
+    # refusals: bad values, bad arity, garbage args — the 400 path
+    with pytest.raises(ValueError, match="base >= 1"):
+        NetworkHeterogeneousLatency(0, 5)
+    with pytest.raises(ValueError, match="bad parameters"):
+        get_by_name("NetworkHeterogeneousLatency(20,10,6,3,9)")
+    with pytest.raises(ValueError, match="bad parameters"):
+        get_by_name("NetworkHeterogeneousLatency(fast)")
+    with pytest.raises(ValueError, match="base >= 1"):
+        get_by_name("NetworkHeterogeneousLatency(0,5)")
+    with pytest.raises(KeyError, match="unknown parametrised"):
+        get_by_name("NetworkMadeUpLatency(3)")
+
+
+def test_heterogeneous_latency_spec_integration():
+    """`latency_model` carries the model through the request plane:
+    digest + compile key move, a bad parameterisation is the 400."""
+    import wittgenstein_tpu.models  # noqa: F401
+    from wittgenstein_tpu.serve import ScenarioSpec
+
+    base = dict(protocol="PingPong", params={"node_count": 32},
+                seeds=(0,), sim_ms=120, chunk_ms=120, obs=())
+    sp = ScenarioSpec(**base,
+                      latency_model="NetworkHeterogeneousLatency(20,10,6)")
+    rs = sp.validate()
+    assert repr(rs.build_protocol().latency) == \
+        "NetworkHeterogeneousLatency(20,10,6,0)"
+    plain = ScenarioSpec(**base)
+    assert sp.digest() != plain.digest()
+    assert sp.compile_key() != plain.compile_key()
+    with pytest.raises(ValueError, match="unknown latency_model"):
+        ScenarioSpec(**base,
+                     latency_model="NetworkHeterogeneousLatency(0,5)"
+                     ).validate()
 
 
 def test_estimate_latency_roundtrip():
@@ -210,6 +279,7 @@ def _floor_models():
         (NetworkNoLatency(), positioned),
         (NetworkFixedLatency(25), positioned),
         (NetworkUniformLatency(80), positioned),
+        (NetworkHeterogeneousLatency(20, 10, 6, 3), positioned),
         (NetworkLatencyByDistanceWJitter(), positioned),
         (AwsRegionNetworkLatency(), aws),
         (EthScanNetworkLatency(), positioned),

@@ -1,0 +1,431 @@
+"""Sweep-grid subsystem (wittgenstein_tpu/matrix) — the PR-12 battery.
+
+Acceptance pins:
+  * expansion determinism + grid-digest stability/sensitivity;
+  * compile-key group-count: a grid whose cells differ only in
+    seeds/partition/sim_ms plans exactly ONE compile group;
+  * exclusion-rule filtering;
+  * a run's program builds == the plan's expected builds (asserted
+    inside the driver, re-checked here), per-cell ledger rows carrying
+    the grid digest, and a pinned subset of cells bit-identical (full
+    pytree + metrics/audit blocks) to sequential `Runner` runs;
+  * a >= 1000-cell grid expands deterministically and plans to exactly
+    its distinct compile keys (slow: the full run).
+"""
+
+import importlib.util
+import json
+import pathlib
+import urllib.request
+
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+from wittgenstein_tpu.matrix import (MatrixReport, SweepGrid,
+                                     pick_spot_cells, plan, run_grid,
+                                     verify_cell)
+from wittgenstein_tpu.obs import ledger
+from wittgenstein_tpu.serve import Scheduler
+
+#: a small loss window — every cell under it receives fewer messages
+#: than its fault-free twin (the impact-delta pin)
+LOSS_SCHEDULE = {"loss": [[0, 120, 400, 0, 32, 0, 32]]}
+
+
+def _grid(**kw):
+    base = dict(
+        name="t",
+        base={"protocol": "PingPong", "params": {"node_count": 32},
+              "seeds": [0], "sim_ms": 120, "chunk_ms": 120,
+              "obs": ["metrics", "audit"]},
+        axes=({"name": "seed", "field": "seeds",
+               "values": [[0], [1]]},))
+    base.update(kw)
+    return SweepGrid(**base)
+
+
+def _cli():
+    path = pathlib.Path(__file__).parent.parent / "tools" / "matrix.py"
+    spec = importlib.util.spec_from_file_location("matrix_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------- grid
+
+
+def test_grid_roundtrip_and_digest_stability():
+    g = _grid()
+    again = SweepGrid.from_json(g.canonical_json())
+    assert again == g
+    assert again.canonical_json() == g.canonical_json()
+    assert again.grid_digest() == g.grid_digest()
+    # dict-ordering never moves the digest
+    shuffled = SweepGrid.from_json(
+        json.loads(json.dumps(g.to_json(), sort_keys=True)))
+    assert shuffled.grid_digest() == g.grid_digest()
+    # every structural change moves it: base, axis values, axis ORDER,
+    # labels, exclusions, name
+    two_axes = _grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkFixedLatency(30)"]}))
+    flipped = _grid(axes=tuple(reversed(
+        [a.to_json() for a in two_axes.axes])))
+    digests = {g.grid_digest(), two_axes.grid_digest(),
+               flipped.grid_digest(),
+               _grid(name="other").grid_digest(),
+               _grid(base=dict(g.base, sim_ms=240)).grid_digest(),
+               _grid(axes=({"name": "seed", "field": "seeds",
+                            "values": [[0], [2]]},)).grid_digest(),
+               SweepGrid.from_json(
+                   dict(two_axes.to_json(),
+                        exclude=[{"seed": "0", "lat": "none"}])
+               ).grid_digest()}
+    assert len(digests) == 7, "a structural change failed to move the digest"
+
+
+def test_expansion_determinism():
+    g = _grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1], [2]]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkFixedLatency(30)"]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, LOSS_SCHEDULE], "labels": ["clean", "loss"]},
+    ))
+    a = g.expand()
+    b = SweepGrid.from_json(json.loads(g.canonical_json())).expand()
+    assert [c.id for c in a] == [c.id for c in b]
+    assert [c.spec.digest() for c in a] == [c.spec.digest() for c in b]
+    assert [c.labels for c in a] == [c.labels for c in b]
+    assert len(a) == 12
+    # cell ids are the label path, in declared axis order
+    assert a[0].id == "seed=0/lat=none/chaos=clean"
+
+
+def test_grid_validation_refuses_with_remedy():
+    with pytest.raises(ValueError, match="unknown override path"):
+        _grid(axes=({"name": "x", "field": "nodes",
+                     "values": [1, 2]},))
+    with pytest.raises(ValueError, match="duplicate axis name"):
+        _grid(axes=({"name": "a", "field": "sim_ms", "values": [120]},
+                    {"name": "a", "field": "sim_ms", "values": [240]}))
+    with pytest.raises(ValueError, match="duplicate labels"):
+        _grid(axes=({"name": "a", "field": "sim_ms",
+                     "values": [120, 240], "labels": ["x", "x"]},))
+    with pytest.raises(ValueError, match="cannot label themselves"):
+        _grid(axes=({"name": "chaos", "field": "fault_schedule",
+                     "values": [None, LOSS_SCHEDULE]},))
+    with pytest.raises(ValueError, match="unknown axis"):
+        _grid(exclude=({"nope": "0"},))
+    with pytest.raises(ValueError, match="not a label"):
+        _grid(exclude=({"seed": "99"},))
+    with pytest.raises(ValueError, match="unknown field"):
+        SweepGrid.from_json({"base": {"protocol": "PingPong"},
+                             "axes": [], "bogus": 1})
+    with pytest.raises(ValueError, match="unsupported schema"):
+        SweepGrid.from_json({"schema": 2,
+                             "base": {"protocol": "PingPong"},
+                             "axes": []})
+    with pytest.raises(ValueError, match="at least one axis"):
+        _grid(axes=())
+    with pytest.raises(ValueError, match="removed every cell"):
+        _grid(exclude=({"seed": "0"}, {"seed": "1"})).expand()
+    # a structurally-malformed CELL refuses at EXPANSION, named
+    with pytest.raises(ValueError, match="cell .*obs plane"):
+        _grid(axes=({"name": "o", "field": "obs",
+                     "values": [["metrics"], ["Metrics"]],
+                     "labels": ["ok", "typo"]},)).expand()
+    # a semantically-bad cell refuses at PLAN (the full validate pass),
+    # still named
+    with pytest.raises(ValueError, match="cell .span=250.*chunk_ms"):
+        plan(_grid(axes=({"name": "span", "field": "sim_ms",
+                          "values": [120, 250]},)))
+    # paired axes (no field) demand {path: value} dicts
+    with pytest.raises(ValueError, match="paired-axis"):
+        _grid(axes=({"name": "ek", "values": [1, 2],
+                     "labels": ["a", "b"]},))
+
+
+def test_compile_key_group_count_pin():
+    """THE planning pin: cells differing only in seeds / partition /
+    sim_ms are DATA — the whole grid plans exactly ONE compile group,
+    and expected builds == that one key's obs planes."""
+    g = _grid(axes=(
+        {"name": "seed", "field": "seeds",
+         "values": [[0], [1], [2, 3]]},
+        {"name": "part", "field": "partition",
+         "values": [[], [3], [3, 5]], "labels": ["p0", "p1", "p2"]},
+        {"name": "span", "field": "sim_ms", "values": [120, 240]},
+    ))
+    p = plan(g)
+    assert len(p.cells) == 18
+    assert p.planned_compiles == 1, \
+        "seeds/partition/sim_ms are data and must coalesce"
+    assert p.expected_builds == 2       # metrics primary + audit shadow
+    # a program axis splits the plan
+    g2 = _grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkFixedLatency(30)"]},
+    ))
+    p2 = plan(g2)
+    assert p2.planned_compiles == 2 and p2.expected_builds == 4
+
+
+def test_exclusion_rules_and_twins():
+    g = _grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, LOSS_SCHEDULE], "labels": ["clean", "loss"]},
+    ), exclude=({"seed": "1", "chaos": "loss"},))
+    cells = g.expand()
+    ids = [c.id for c in cells]
+    assert len(cells) == 3
+    assert "seed=1/chaos=loss" not in ids
+    # twin resolution: the adverse cell maps to its clean sibling
+    assert g.twin_id({"seed": "0", "chaos": "loss"}) == \
+        "seed=0/chaos=clean"
+    assert g.twin_id({"seed": "0", "chaos": "clean"}) is None
+    # a twin punched out by exclusion resolves to None, not a phantom
+    g3 = _grid(axes=g.axes, exclude=({"seed": "1", "chaos": "clean"},))
+    assert g3.twin_id({"seed": "1", "chaos": "loss"}) is None
+
+
+def test_paired_axis_moves_both_fields():
+    g = _grid(base={"protocol": "PingPong",
+                    "params": {"node_count": 32}, "seeds": [0],
+                    "sim_ms": 120, "chunk_ms": 120, "obs": []},
+              axes=({"name": "engineK",
+                     "values": [{"engine": "vmapped", "superstep": 1},
+                                {"engine": "vmapped", "superstep": 2}],
+                     "labels": ["k1", "k2"]},))
+    cells = g.expand()
+    assert cells[0].spec.superstep == 1 and cells[1].spec.superstep == 2
+    assert plan(g).planned_compiles == 2
+
+
+def test_thousand_cell_grid_plans_deterministically():
+    """>= 1000 cells expand deterministically and plan to exactly the
+    distinct-compile-key count (planning only — the full run is the
+    slow test below)."""
+    g = _grid(base={"protocol": "PingPong", "params": {"node_count": 16},
+                    "seeds": [0], "sim_ms": 120, "chunk_ms": 120,
+                    "obs": []},
+              axes=(
+        {"name": "N", "field": "params.node_count", "values": [16, 24]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkHeterogeneousLatency(8,6,4)"]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, {"loss": [[0, 120, 300, 0, 16, 0, 16]]}],
+         "labels": ["clean", "loss"]},
+        {"name": "seed", "field": "seeds",
+         "values": [[s] for s in range(126)]},
+    ))
+    assert g.n_cells_raw() == 1008  # 2 x 2 x 2 x 126
+    p = plan(g)
+    assert len(p.cells) == 1008
+    # protocol-program axes: N x lat x chaos = 8 distinct keys; the 126
+    # seeds coalesce
+    assert p.planned_compiles == 8
+    assert p.expected_builds == 8       # obs=() -> one plain program each
+    p2 = plan(SweepGrid.from_json(json.loads(g.canonical_json())))
+    assert [c.id for c in p2.cells] == [c.id for c in p.cells]
+    assert [(gr.compile_key, len(gr.cells)) for gr in p2.groups] == \
+        [(gr.compile_key, len(gr.cells)) for gr in p.groups]
+
+
+# -------------------------------------------------------------- the run
+
+
+@pytest.fixture(scope="module")
+def loss_run(tmp_path_factory):
+    """One shared small campaign: chaos axis (clean vs loss) x 2 seeds
+    — 2 compile keys, 4 cells, metrics+audit ON."""
+    tmp = tmp_path_factory.mktemp("matrix")
+    g = _grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, LOSS_SCHEDULE], "labels": ["clean", "loss"]},
+    ))
+    sch = Scheduler(ledger_path=str(tmp / "ledger.jsonl"))
+    run = run_grid(g, sch)
+    return g, run, str(tmp / "ledger.jsonl")
+
+
+def test_run_compile_minimal_and_ledger_rows(loss_run):
+    g, run, lpath = loss_run
+    rep = run.report.to_json()
+    assert rep["cells_done"] == 4 and rep["cells_error"] == 0
+    assert rep["audit_violations"] == 0 and run.report.clean
+    # compiles == distinct keys; builds == keys x planes (also asserted
+    # inside the driver — a mismatch would have raised there)
+    assert rep["planned_compiles"] == rep["distinct_compile_keys"] == 2
+    assert rep["program_builds"] == rep["expected_builds"] == 4
+    # one RunManifest row per cell, labelled by cell, carrying the
+    # grid digest + axis labels, config digest == the cell spec digest
+    rows = ledger.read_all(lpath)
+    assert len(rows) == 4
+    by_cell = {r.extra["cell"]: r for r in rows}
+    for cell in g.expand():
+        row = by_cell[cell.id]
+        assert row.run == f"matrix:{cell.id}"
+        assert row.extra["grid_digest"] == g.grid_digest()
+        assert row.extra["axes"] == cell.labels
+        assert row.config_digest == cell.spec.digest()
+
+
+def test_run_pinned_subset_bit_identical_to_runner(loss_run):
+    """THE acceptance pin: matrix cells — including a chaos cell — are
+    bit-identical (full final pytree + metrics/audit blocks) to running
+    the same specs individually through `Runner`."""
+    g, run, _ = loss_run
+    p = plan(g)
+    spots = pick_spot_cells(p.cells, 2)
+    spots.append("seed=1/chaos=loss")       # force an adverse cell in
+    for cid in dict.fromkeys(spots):
+        mism = verify_cell(p.resolved[cid], run.states[cid],
+                           run.artifacts[cid])
+        assert mism == [], f"{cid}: {mism}"
+
+
+def test_report_impact_and_axis_aggregates(loss_run):
+    g, run, _ = loss_run
+    rep = run.report
+    row = rep.cell("seed=0/chaos=loss")
+    # the loss window cost real deliveries vs the fault-free twin
+    assert row["impact_vs_twin"]["msg_received"] < 0
+    assert "impact_vs_twin" not in rep.cell("seed=0/chaos=clean")
+    ax = rep.to_json()["by_axis"]["chaos"]
+    assert ax["clean"]["done"] == 2 and ax["loss"]["done"] == 2
+    assert ax["loss"]["done_delta_vs_twin_mean"] <= 0
+    assert "time_to_done_ms_mean" in ax["clean"]
+    # round trip + human rendering
+    again = MatrixReport.from_json(json.loads(json.dumps(rep.to_json())))
+    assert again.to_json() == rep.to_json()
+    assert "2 compile keys" in again.format()
+
+
+# ------------------------------------------------------------- service
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def test_http_matrix_round_trip(tmp_path):
+    """/w/matrix/*: submit -> run -> status -> report over HTTP, manual
+    drain, plus the 400-with-the-cell-named on a malformed grid."""
+    import threading
+
+    from wittgenstein_tpu.server.http import make_server
+    httpd = make_server(0, batch_auto=False)
+    httpd.batch_service.scheduler.ledger_path = str(tmp_path / "l.jsonl")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        grid = _grid().to_json()
+        sub = _post(port, "/w/matrix/submit", grid)
+        assert sub["status"] == "planned" and sub["cells"] == 2
+        assert sub["planned_compiles"] == 1
+        assert sub["grid_digest"] == _grid().grid_digest()
+        st = _get(port, f"/w/matrix/status/{sub['id']}")
+        assert st["status"] == "planned"
+        # report before done answers with status, not an error
+        assert _get(port,
+                    f"/w/matrix/report/{sub['id']}")["status"] == "planned"
+        _post(port, f"/w/matrix/run/{sub['id']}")
+        rep = _get(port, f"/w/matrix/report/{sub['id']}")
+        assert rep["status"] == "done"
+        assert rep["cells_done"] == 2 and rep["audit_violations"] == 0
+        assert rep["program_builds"] == 2
+        st = _get(port, f"/w/matrix/status/{sub['id']}")
+        assert st["status"] == "done"
+        assert st["progress"]["done"] == 2
+        # malformed grid -> 400 naming the bad cell
+        bad = dict(grid, axes=[{"name": "span", "field": "sim_ms",
+                                "values": [120, 250]}])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/w/matrix/submit", bad)
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read())["error"]
+        assert "span=250" in err and "chunk_ms" in err
+        # unknown job id -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/w/matrix/status/nope")
+        assert ei.value.code == 400
+    finally:
+        httpd.batch_service.close()
+        httpd.shutdown()
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cli_config_error_exit_2(capsys):
+    mod = _cli()
+    assert mod.main(["--grid", '{"bogus": 1}']) == 2
+    assert "config error" in capsys.readouterr().err
+    assert mod.main(["--grid", "not json at {all"]) == 2
+
+
+def test_cli_plan_only(capsys):
+    mod = _cli()
+    grid = json.dumps(_grid(axes=(
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkFixedLatency(30)"]},)).to_json())
+    assert mod.main(["--grid", grid, "--plan-only"]) == 0
+    out = capsys.readouterr().out
+    assert "4 cells -> 2 compile keys" in out
+
+
+# ------------------------------------------------------------ the 1000
+
+
+@pytest.mark.slow
+def test_thousand_cell_campaign_end_to_end(tmp_path):
+    """The full acceptance run: a >= 1000-cell grid scheduled with
+    program builds == distinct compile keys (driver-asserted), ONE
+    MatrixReport artifact, per-cell ledger rows with the grid digest,
+    and a pinned subset bit-identical to sequential Runner runs."""
+    g = _grid(base={"protocol": "PingPong", "params": {"node_count": 16},
+                    "seeds": [0], "sim_ms": 120, "chunk_ms": 120,
+                    "obs": []},
+              axes=(
+        {"name": "N", "field": "params.node_count", "values": [16, 24]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkHeterogeneousLatency(8,6,4)"]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, {"loss": [[0, 120, 300, 0, 16, 0, 16]]}],
+         "labels": ["clean", "loss"]},
+        {"name": "seed", "field": "seeds",
+         "values": [[s] for s in range(126)]},
+    ))
+    p = plan(g)
+    assert len(p.cells) == 1008 and p.planned_compiles == 8
+    spots = pick_spot_cells(p.cells, 3)
+    lpath = tmp_path / "ledger.jsonl"
+    sch = Scheduler(ledger_path=str(lpath))
+    run = run_grid(g, sch, plan_=p, keep_states=tuple(spots),
+                   max_wave=63)
+    rep = run.report.to_json()
+    assert rep["cells_done"] == 1008 and rep["cells_error"] == 0
+    assert rep["program_builds"] == rep["planned_compiles"] == 8
+    rows = ledger.read_all(str(lpath))
+    assert len(rows) == 1008
+    assert all(r.extra["grid_digest"] == g.grid_digest() for r in rows)
+    for cid in spots:
+        assert verify_cell(p.resolved[cid], run.states[cid],
+                           run.artifacts[cid]) == [], cid

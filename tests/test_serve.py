@@ -142,16 +142,58 @@ def test_spec_latency_model_field():
             **base["params"],
             "network_latency_name": "NetworkFixedLatency(4)"}),
             latency_model="NetworkFixedLatency(4)").validate()
-    # a protocol without the kwarg refuses through the param template
-    with pytest.raises(ValueError, match="network_latency_name"):
-        _spec(latency_model="NetworkFixedLatency(4)").validate()
-    # the happy path folds the model into the constructor
+    # the happy path folds the model into the constructor — including
+    # PingPong, which gained the kwarg with the matrix latency axis
+    # (PR 12); a double selection still refuses at the ctor level too
     sp = ScenarioSpec(**base, latency_model="NetworkFixedLatency(4)")
     assert repr(sp.validate().build_protocol().latency) == \
+        "NetworkFixedLatency(4)"
+    pp = _spec(latency_model="NetworkFixedLatency(4)")
+    assert repr(pp.validate().build_protocol().latency) == \
         "NetworkFixedLatency(4)"
     plain = ScenarioSpec(**base)
     assert sp.digest() != plain.digest()
     assert sp.compile_key() != plain.compile_key()
+
+
+def test_spec_from_env_latency_capture():
+    """WTPU_LATENCY lands in the spec FIELD (the ledger then records
+    the model the run used); an unknown name refuses LOUDLY instead of
+    silently falling back to the default model, and a double selection
+    with the legacy WTPU_BENCH_LATENCY refuses too."""
+    sp = ScenarioSpec.from_env(env={"WTPU_LATENCY":
+                                    "NetworkFixedLatency(8)"})
+    assert sp.latency_model == "NetworkFixedLatency(8)"
+    assert ScenarioSpec.from_env(env={}).latency_model is None
+    # the capture moves the digest — two runs of different physics can
+    # never share a config digest
+    assert sp.digest() != ScenarioSpec.from_env(env={}).digest()
+    het = ScenarioSpec.from_env(
+        env={"WTPU_LATENCY": "NetworkHeterogeneousLatency(20,10,6)"})
+    assert het.latency_model == "NetworkHeterogeneousLatency(20,10,6)"
+    with pytest.raises(ValueError, match="unknown WTPU_LATENCY"):
+        ScenarioSpec.from_env(env={"WTPU_LATENCY": "NetworkMadeUp"})
+    with pytest.raises(ValueError, match="unknown WTPU_LATENCY"):
+        ScenarioSpec.from_env(
+            env={"WTPU_LATENCY": "NetworkHeterogeneousLatency(0,5)"})
+    with pytest.raises(ValueError, match="both set"):
+        ScenarioSpec.from_env(
+            env={"WTPU_LATENCY": "NetworkFixedLatency(8)",
+                 "WTPU_BENCH_LATENCY": "NetworkFixedLatency(8)"})
+    # the legacy spelling is program-affecting for EVERY branch —
+    # bench_quiet builds pingpong/dfinity with it, so it must move
+    # those branches' digests too (not just Handel's str_knobs)
+    for proto in ("pingpong", "dfinity", "p2pflood"):
+        legacy = ScenarioSpec.from_env(
+            env={"WTPU_BENCH_PROTO": proto,
+                 "WTPU_BENCH_LATENCY": "NetworkFixedLatency(16)"})
+        assert legacy.params["network_latency_name"] == \
+            "NetworkFixedLatency(16)"
+        assert legacy.digest() != ScenarioSpec.from_env(
+            env={"WTPU_BENCH_PROTO": proto}).digest()
+    # WTPU_LATENCY=0 is the documented means-unset spelling
+    assert ScenarioSpec.from_env(
+        env={"WTPU_LATENCY": "0"}).latency_model is None
 
 
 def test_spec_route_kernel_program_field():

@@ -408,6 +408,81 @@ def bench_chaos_smoke():
     return res
 
 
+#: the matrix_smoke stage's grid — module-level so the stage and any
+#: consumer of its digest can never drift apart (the chaos_smoke
+#: convention); 2 x 2 x 2: seeds are data, the latency axis splits the
+#: compile key, the span axis is data again -> exactly 2 distinct keys
+MATRIX_SMOKE_GRID = {
+    "name": "matrix_smoke",
+    "base": {"protocol": "PingPong", "params": {"node_count": 64},
+             "seeds": [0], "sim_ms": 120, "chunk_ms": 120,
+             "obs": ["metrics", "audit"]},
+    "axes": [
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "lat", "field": "latency_model",
+         "values": [None, "NetworkFixedLatency(30)"]},
+        {"name": "span", "field": "sim_ms", "values": [120, 240]},
+    ],
+}
+
+
+def bench_matrix_smoke():
+    """Sweep-grid smoke stage (PR 12): a tiny 2 x 2 x 2 grid through the
+    in-process `Service`'s /w/matrix trio (submit -> run -> report) —
+    planned compiles == distinct compile keys == actual program builds
+    asserted, the `MatrixReport` artifact round-tripped through its
+    JSON form, and every per-cell `RunManifest` ledger row carrying the
+    grid digest (isolated temp file, the audit_smoke convention) — the
+    whole matrix path (SweepGrid -> planner -> scheduler coalescing ->
+    report -> ledger) exercised end to end in seconds."""
+    import os
+    import tempfile
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import MatrixReport, SweepGrid
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.serve import Scheduler, Service
+
+    grid = SweepGrid.from_json(MATRIX_SMOKE_GRID)
+    fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        svc = Service(scheduler=Scheduler(ledger_path=tmp), auto=False)
+        sub = svc.matrix_submit(MATRIX_SMOKE_GRID)
+        assert sub["cells"] == 8 and sub["planned_compiles"] == 2, sub
+        assert sub["grid_digest"] == grid.grid_digest()
+        st = svc.matrix_run(sub["id"])
+        assert st["status"] == "done", st
+        rep = svc.matrix_report(sub["id"])
+        assert rep["cells_done"] == 8 and rep["cells_error"] == 0, rep
+        assert rep["audit_violations"] == 0
+        # the compile-key-minimal pin: builds == distinct keys x planes
+        assert rep["planned_compiles"] == rep["distinct_compile_keys"] \
+            == 2, rep
+        assert rep["program_builds"] == rep["expected_builds"] == 4, rep
+        # report artifact round-trips through its JSON form exactly
+        # (the "status" key is the poll envelope, not the artifact)
+        art = {k: v for k, v in rep.items() if k != "status"}
+        again = MatrixReport.from_json(json.loads(json.dumps(art)))
+        assert again.to_json() == art, "report round-trip mismatch"
+        assert again.grid_digest == grid.grid_digest()
+        # per-cell ledger rows carry the grid digest + axis labels
+        rows = ledger.read_all(tmp)
+        assert len(rows) == 8, rows
+        assert all(r.extra.get("grid_digest") == grid.grid_digest()
+                   for r in rows), rows
+        assert all(r.run.startswith("matrix:") for r in rows)
+        assert all(r.audit_clean for r in rows)
+        return {"metric": "matrix_smoke_cells", "value": 8,
+                "unit": "cells", "grid_digest": grid.grid_digest(),
+                "planned_compiles": rep["planned_compiles"],
+                "program_builds": rep["program_builds"],
+                "wall_s": rep["wall_s"], "ledger_rows": len(rows),
+                "platform": jax.default_backend()}
+    finally:
+        os.unlink(tmp)
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -417,6 +492,7 @@ CONFIGS = {
     "audit_smoke": bench_audit_smoke,
     "serve_smoke": bench_serve_smoke,
     "chaos_smoke": bench_chaos_smoke,
+    "matrix_smoke": bench_matrix_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -425,7 +501,8 @@ CONFIGS = {
 METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "audit_smoke": "audit_smoke_violations",
                 "serve_smoke": "serve_smoke_requests",
-                "chaos_smoke": "chaos_smoke_lost_msgs"}
+                "chaos_smoke": "chaos_smoke_lost_msgs",
+                "matrix_smoke": "matrix_smoke_cells"}
 
 
 def _stage_spec(name):

@@ -1,0 +1,147 @@
+"""One-command sweep-grid campaign: expand, plan, run, report, verify.
+
+Loads a `SweepGrid` JSON (file, inline JSON, or '-' for stdin), plans
+it (every cell validated, grouped by compile key), runs it through the
+serve scheduler with live progress on stderr, prints the cross-cell
+`MatrixReport` summary, and optionally spot-checks a deterministic
+subset of cells bit-for-bit against sequential `Runner` runs (full
+final pytree + metrics/audit blocks — the matrix acceptance pin).
+
+Exit codes (the tools/chaos.py convention):
+  0  every cell done, every audit verdict clean, spot checks
+     bit-identical
+  1  violations or divergence: errored cells, audit violations, or a
+     spot-checked cell differing from its sequential reference (all
+     printed)
+  2  configuration error: malformed grid JSON, unknown axis path, a
+     cell that fails `ScenarioSpec.validate` (the offending cell is
+     named)
+
+    # a 2 x 2 x 2 grid from a file, report to disk, 3 spot checks
+    python tools/matrix.py --grid grid.json --out report.json \
+        --spot-check 3
+
+    # inline grid
+    python tools/matrix.py --grid '{"base": {"protocol": "PingPong",
+        "params": {"node_count": 32}, "sim_ms": 120, "chunk_ms": 120},
+        "axes": [{"name": "seed", "field": "seeds",
+                  "values": [[0], [1]]}]}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _load_grid_json(arg: str):
+    if arg == "-":
+        return json.load(sys.stdin)
+    if arg.lstrip().startswith("{"):
+        return json.loads(arg)
+    with open(arg) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/matrix.py",
+        description="declarative sweep-grid campaign: plan, run, "
+                    "report, verify")
+    ap.add_argument("--grid", required=True, metavar="JSON|PATH|-",
+                    help="SweepGrid JSON: a file path, inline JSON, or "
+                         "'-' for stdin (schema: matrix/grid.py)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the MatrixReport artifact here")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="per-cell RunManifest JSONL (default: the "
+                         "shared reports/ledger)")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="write chunk-boundary group checkpoints "
+                         "(crash-safety groundwork; end-to-end "
+                         "campaign resume is not wired into this CLI "
+                         "yet — see Scheduler.resume_checkpoints)")
+    ap.add_argument("--max-wave", type=int, default=64,
+                    help="max cells per coalesced launch wave "
+                         "(default 64)")
+    ap.add_argument("--spot-check", type=int, default=0, metavar="N",
+                    help="verify N cells (deterministic spread) "
+                         "bit-for-bit against sequential Runner runs")
+    ap.add_argument("--plan-only", action="store_true",
+                    help="expand + plan + print the compile accounting, "
+                         "run nothing")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-wave progress lines")
+    args = ap.parse_args(argv)
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import (SweepGrid, pick_spot_cells,
+                                         plan, run_grid, verify_cell)
+    from wittgenstein_tpu.serve import Scheduler
+
+    try:
+        grid = SweepGrid.from_json(_load_grid_json(args.grid))
+        mplan = plan(grid)
+    except (ValueError, OSError, json.JSONDecodeError, TypeError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+    summ = mplan.summary()
+    print(f"grid {grid.name!r} [{summ['grid_digest']}]: "
+          f"{summ['cells']} cells -> {summ['planned_compiles']} compile "
+          f"keys ({summ['expected_builds']} program builds, largest "
+          f"group {summ['largest_group']} cells)")
+    if args.plan_only:
+        return 0
+
+    spot = pick_spot_cells(mplan.cells, args.spot_check)
+
+    def progress(p):
+        if not args.quiet:
+            print(f"  [{p['wall_s']:8.1f}s] {p['done']}/{p['total']} "
+                  f"cells, {p['program_builds']} builds, "
+                  f"{p['groups_done']}/{p['groups_total']} groups",
+                  file=sys.stderr, flush=True)
+
+    sch = Scheduler(ledger_path=args.ledger,
+                    checkpoint_dir=args.checkpoint_dir)
+    run = run_grid(grid, sch, plan_=mplan, max_wave=args.max_wave,
+                   keep_states=tuple(spot), progress=progress)
+    report = run.report
+    print(report.format())
+    if args.out:
+        path = report.save(args.out)
+        print(f"report -> {path}")
+
+    rc = 0 if report.clean else 1
+    for cid in spot:
+        row = report.cell(cid)
+        if row["status"] != "done":
+            print(f"spot check {cid}: SKIPPED (cell "
+                  f"{row['status']}: {row.get('error')})")
+            rc = 1
+            continue
+        mism = verify_cell(mplan.resolved[cid], run.states[cid],
+                           run.artifacts[cid])
+        if mism:
+            print(f"spot check {cid}: DIVERGENCE vs the sequential "
+                  "Runner reference:")
+            for m in mism:
+                print(f"  {m}")
+            rc = 1
+        else:
+            print(f"spot check {cid}: bit-identical to the sequential "
+                  "Runner reference (full pytree + obs blocks)")
+    if rc == 0:
+        print("CLEAN: all cells done, audits clean"
+              + (", spot checks bit-identical" if spot else ""))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -383,6 +383,54 @@ def _chaos_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: The matrix-driver build (wittgenstein_tpu/matrix) audited under
+#: "<name>+matrix": one cell of a pinned SweepGrid expanded through
+#: the grid/spec path and compiled exactly the way the serve registry
+#: compiles it for the scheduler (vmapped scan_chunk of the cell's
+#: built protocol).  The matrix layer is host-side planning — the
+#: zero-cost rules (carry_extra_leaves=0, transfer_ops=0) prove the
+#: driver adds NO compiled residue over the plain engine, and the
+#: cell's latency axis pins compiled coverage of the per-link
+#: heterogeneous/asymmetric model (core/latency.py).
+MATRIX_PROTOCOLS = ("PingPong",)
+MATRIX_SUFFIX = "+matrix"
+
+#: the pinned matrix-target cell's latency axis value (the PR-12
+#: heterogeneous model: base 4, +spread 3, +skew 2, seed 1)
+_MATRIX_LATENCY = "NetworkHeterogeneousLatency(4,3,2,1)"
+
+
+def _matrix_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(MATRIX_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import scan_chunk
+        from ..matrix import SweepGrid
+
+        grid = SweepGrid(
+            name="analysis",
+            base={"protocol": base_name,
+                  "params": {"node_count": 64},
+                  "seeds": [0], "sim_ms": chunk, "chunk_ms": chunk,
+                  "obs": []},
+            axes=({"name": "lat", "field": "latency_model",
+                   "values": [_MATRIX_LATENCY, None]},))
+        cell = grid.expand()[0]
+        spec = cell.spec.validate()
+        proto = spec.build_protocol()
+        base = jax.vmap(scan_chunk(proto, chunk,
+                                   superstep=spec.superstep))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+matrix"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -581,6 +629,7 @@ def target_names() -> tuple:
                  sorted(f"{n}{TRACE_SUFFIX}" for n in TRACE_PROTOCOLS) +
                  sorted(f"{n}{AUDIT_SUFFIX}" for n in AUDIT_PROTOCOLS) +
                  sorted(f"{n}{CHAOS_SUFFIX}" for n in CHAOS_PROTOCOLS) +
+                 sorted(f"{n}{MATRIX_SUFFIX}" for n in MATRIX_PROTOCOLS) +
                  sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
@@ -593,6 +642,12 @@ def get_target(name: str) -> AnalysisTarget:
     if name.endswith(ROUTE_SUFFIX):
         raise KeyError(f"unknown pallas-route target {name!r}; known: "
                        f"{sorted(ROUTE_PROTOCOLS)}")
+    if name.endswith(MATRIX_SUFFIX):
+        if name[:-len(MATRIX_SUFFIX)] not in MATRIX_PROTOCOLS:
+            raise KeyError(
+                f"unknown matrix target {name!r}; known: "
+                f"{sorted(f'{n}{MATRIX_SUFFIX}' for n in MATRIX_PROTOCOLS)}")
+        return _matrix_target(name)
     if name.endswith(CHAOS_SUFFIX):
         if name[:-len(CHAOS_SUFFIX)] not in CHAOS_PROTOCOLS:
             raise KeyError(

@@ -322,6 +322,66 @@ class IC3NetworkLatency:
         return self.name
 
 
+class NetworkHeterogeneousLatency:
+    """Per-link heterogeneous, ASYMMETRIC geography: every unordered
+    node pair gets a stable base draw in ``[base, base + spread]`` and
+    every ORDERED pair a direction skew in ``[0, skew]``, so
+    ``A -> B != B -> A`` in general — the missing realistic-geography
+    axis (ROADMAP item 2): chaos delay-inflation windows then compose
+    with links that were never uniform to begin with.
+
+    Draws are counter-based (ops/prng) and keyed on the model's own
+    ``seed`` parameter, NOT the run seed: the link map is fixed
+    "geography" shared by every run of the model, reproducible from the
+    registry name alone (``NetworkHeterogeneousLatency(base,spread,
+    skew[,seed])``), and a different seed is a different (but equally
+    stable) topology.  `delta` is unused — per-link latency is
+    deterministic, like the fixed model; jitter belongs to the models
+    that fit one (ByDistanceWJitter) or to a chaos delay window."""
+
+    positional = False
+
+    #: domain tag for the link draws ("HETL") — never shares a stream
+    #: with the engine's TAG_LATENCY per-message deltas
+    _TAG = 0x4845544C
+
+    def __init__(self, base: int, spread: int = 0, skew: int = 0,
+                 seed: int = 0):
+        base, spread, skew, seed = (int(base), int(spread), int(skew),
+                                    int(seed))
+        if base < 1 or spread < 0 or skew < 0 or seed < 0:
+            # spec-validated: a bad parameterisation must surface as the
+            # request plane's 400 with remedy, not compile a floor-0
+            # model that silently breaks the superstep contract
+            raise ValueError(
+                f"NetworkHeterogeneousLatency wants base >= 1, "
+                f"spread >= 0, skew >= 0, seed >= 0; got ({base}, "
+                f"{spread}, {skew}, {seed})")
+        self.base, self.spread, self.skew, self.seed = (base, spread,
+                                                        skew, seed)
+        self.name = (f"NetworkHeterogeneousLatency({base},{spread},"
+                     f"{skew},{seed})")
+
+    def extended(self, nodes, src, dst, delta):
+        from ..ops import prng
+        key = prng.hash2(jnp.int32(self.seed), jnp.int32(self._TAG))
+        lo = jnp.minimum(src, dst)
+        hi = jnp.maximum(src, dst)
+        pair = prng.uniform_int(prng.hash2(key, 1), prng.hash2(lo, hi),
+                                self.spread + 1)
+        skew = prng.uniform_int(prng.hash2(key, 2), prng.hash2(src, dst),
+                                self.skew + 1)
+        return (self.base + pair + skew).astype(jnp.int32)
+
+    def latency_floor_ms(self):
+        # pair/skew draws are >= 0 and extra_latency only adds: the
+        # base IS the provable floor (tight — a zero draw achieves it).
+        return self.base
+
+    def __repr__(self):
+        return self.name
+
+
 def latency_name(kind: str, fixed: int) -> str:
     """Reference-compatible registry names (RegistryNetworkLatencies.name,
     RegistryNetworkLatencies.java:17-26): 'NetworkFixedLatency(100)' etc."""
@@ -330,19 +390,37 @@ def latency_name(kind: str, fixed: int) -> str:
     return f"{cls}({int(fixed)})"
 
 
+#: parametrised registry constructors — name(int[,int...]) forms
+_PARAM_MODELS = {
+    "NetworkFixedLatency": NetworkFixedLatency,
+    "NetworkUniformLatency": NetworkUniformLatency,
+    "NetworkHeterogeneousLatency": NetworkHeterogeneousLatency,
+}
+
+
 def get_by_name(name: str | None):
     """String-keyed latency lookup (RegistryNetworkLatencies.getByName,
-    :34-59): parametrised fixed/uniform names, then a by-class-simple-name
-    fallback; None falls back to NetworkLatencyByDistanceWJitter."""
+    :34-59): parametrised ``Class(int[,int...])`` names, then a
+    by-class-simple-name fallback; None falls back to
+    NetworkLatencyByDistanceWJitter.  A malformed parameter list is a
+    ValueError with the expected form — the request plane's 400."""
     if not name:
         return NetworkLatencyByDistanceWJitter()
     if "(" in name and name.endswith(")"):
         cls, arg = name[:-1].split("(", 1)
-        ctor = {"NetworkFixedLatency": NetworkFixedLatency,
-                "NetworkUniformLatency": NetworkUniformLatency}.get(cls)
+        ctor = _PARAM_MODELS.get(cls)
         if ctor is None:
-            raise KeyError(f"unknown parametrised latency {name!r}")
-        return ctor(int(arg))
+            raise KeyError(f"unknown parametrised latency {name!r}; "
+                           f"known: {sorted(_PARAM_MODELS)}")
+        try:
+            args = [int(x) for x in arg.split(",")] if arg.strip() else []
+            return ctor(*args)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"bad parameters in latency name {name!r}: {e} "
+                f"(expected comma-separated ints, e.g. "
+                f"'NetworkFixedLatency(100)' or "
+                f"'NetworkHeterogeneousLatency(20,10,6)')") from None
     model = globals().get(name)
     if model is None or not hasattr(model, "extended"):
         raise KeyError(f"unknown latency model {name!r}")

@@ -1,0 +1,35 @@
+"""wittgenstein_tpu.matrix — the sweep-grid subsystem: thousands of
+scenario cells, compile-key-minimal scheduling, one comparable report.
+
+  grid     — `SweepGrid`: a frozen, JSON-able declarative matrix (base
+             `ScenarioSpec` + named axes over params / N / seeds /
+             engine / latency_model / fault_schedule / attack /
+             route_kernel, paired-axis values, exclusion rules) that
+             expands DETERMINISTICALLY into cells with a stable
+             `grid_digest()`;
+  planner  — `plan()`: validate every cell, group by `compile_key()`,
+             order groups largest-first — total program builds ==
+             distinct (compile key, obs plane) pairs, asserted;
+  driver   — `run_grid()`: groups through the serve `Scheduler` (its
+             coalescing, retry/degradation and checkpoint/resume ride
+             along) with live progress and per-cell ledger rows
+             carrying the grid digest; `verify_cell()` is the
+             pinned-subset bit-identity oracle vs sequential `Runner`
+             runs;
+  report   — `MatrixReport`: per-cell metrics + audit verdicts +
+             impact deltas vs each cell's fault-free twin, aggregated
+             per axis, as ONE JSON artifact.
+
+Surfaces: `tools/matrix.py` (CLI, exit 0 clean / 1 violations-or-
+divergence / 2 config error) and the `/w/matrix/*` endpoints
+(server/http.py).
+"""
+
+from .driver import MatrixRun, pick_spot_cells, run_grid, verify_cell  # noqa: F401
+from .grid import Axis, Cell, SweepGrid  # noqa: F401
+from .planner import MatrixPlan, plan  # noqa: F401
+from .report import MatrixReport  # noqa: F401
+
+__all__ = ["SweepGrid", "Axis", "Cell", "MatrixPlan", "plan",
+           "MatrixRun", "run_grid", "verify_cell", "pick_spot_cells",
+           "MatrixReport"]

@@ -1,0 +1,304 @@
+"""The matrix driver: a planned grid through the serve `Scheduler`.
+
+Groups run CONTIGUOUSLY in plan order; within a group, cells are
+submitted in waves of at most `max_wave` cells and drained — every
+wave after the first is a registry HIT (same compile key, seeds are
+data), so the whole group runs on the programs the first wave built
+while wave batching bounds the coalesced lane width (a thousand
+single-seed cells never concatenate into one thousand-lane state).
+Retry-with-backoff, batch-width degradation and chunk-boundary
+checkpoint/resume all ride along for free — they are `Scheduler`
+properties (PR 10), not driver ones.
+
+The driver ASSERTS the compile-key-minimal contract: with a cold
+registry, program builds after the run must equal the plan's
+`expected_builds` (one build per (compile key, obs plane) — see
+planner.py's vocabulary note); a warm registry may only build fewer.
+A violated assertion is a scheduling bug, raised loudly rather than
+recorded.
+
+Per-cell `RunManifest` ledger rows are labelled ``matrix:<cell id>``
+and carry the grid digest + axis labels in `extra`, so a sweep's
+provenance is one ``grep grid_digest`` over the ledger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..serve.scheduler import Scheduler
+from .grid import SweepGrid
+from .planner import MatrixPlan, plan
+from .report import MatrixReport
+
+
+@dataclasses.dataclass
+class MatrixRun:
+    """One grid run: the report artifact plus the in-memory per-cell
+    products the artifact deliberately leaves out (full obs blocks,
+    kept final states for bit-identity verification)."""
+
+    report: MatrixReport
+    artifacts: dict                 # cell id -> scheduler artifacts
+    states: dict                    # cell id -> final (net, ps) slices
+    requests: dict                  # cell id -> request id
+
+
+def _drain(sch: Scheduler, rids: list, poll_s: float = 0.05):
+    """Drive the scheduler until every request settles.  `run_pending`
+    is single-drainer (a concurrent service worker may own the drain);
+    polling statuses instead of trusting our own processed count keeps
+    the driver correct in both in-process and service-threaded use."""
+    while True:
+        sch.run_pending()
+        statuses = []
+        for rid in rids:
+            try:
+                statuses.append(sch.request(rid).status)
+            except KeyError:        # evicted already-done request
+                statuses.append("done")
+        if all(s in ("done", "error") for s in statuses):
+            return
+        time.sleep(poll_s)
+
+
+def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
+             plan_: MatrixPlan | None = None, *, ledger_path=None,
+             checkpoint_dir=None, max_wave: int = 64,
+             keep_states=("*",), progress=None,
+             strict_builds: bool = True) -> MatrixRun:
+    """Run every cell of `grid` (module docstring) and build the
+    `MatrixReport`.
+
+    keep_states — cell ids whose final (net, pstate) slices to retain
+        for bit-identity verification ("*" keeps all; device memory
+        scales with it, so thousand-cell campaigns pass a pinned
+        subset).
+    progress    — optional callback(dict) at every wave boundary:
+        cells done/total, groups done, program builds so far, wall.
+    strict_builds — raise when measured registry builds disagree with
+        the plan (the compile-key-minimal contract).  The measurement
+        is the registry's GLOBAL miss counter, so it can only be
+        attributed to this run when the scheduler is ours alone; pass
+        False when sharing a scheduler with concurrent traffic (the
+        service's auto mode) — the report still records the measured
+        delta, it just can't be an assertion there.
+    """
+    plan_ = plan_ or plan(grid)
+    sch = scheduler or Scheduler(ledger_path=ledger_path,
+                                 checkpoint_dir=checkpoint_dir)
+    keep_all = "*" in keep_states
+    keep = set(keep_states)
+    stats0 = sch.registry.stats()
+    cold = stats0["entries"] == 0
+    t0 = time.time()
+    results: dict = {}
+    artifacts: dict = {}
+    states: dict = {}
+    requests: dict = {}
+    done_cells = 0
+    for gi, group in enumerate(plan_.groups):
+        cells = list(group.cells)
+        for lo in range(0, len(cells), max_wave):
+            wave = cells[lo:lo + max_wave]
+            rids = []
+            for cell in wave:
+                try:
+                    # the AS-AUTHORED cell spec, not the resolved one:
+                    # provenance digests what the grid requested (the
+                    # serve convention); submit re-validates cheaply
+                    rid = sch.submit(
+                        cell.spec,
+                        label=f"matrix:{cell.id}",
+                        ledger_extra={"grid_digest": plan_.grid_digest,
+                                      "cell": cell.id,
+                                      "axes": dict(cell.labels)})
+                except ValueError as e:     # plan validated; belt and
+                    # braces for env drift between plan and run
+                    results[cell.id] = {"status": "error",
+                                        "error": str(e)}
+                    continue
+                requests[cell.id] = rid
+                rids.append((cell, rid))
+            _drain(sch, [rid for _, rid in rids])
+            # harvest IMMEDIATELY: the scheduler's keep_done eviction
+            # may drop finished records once later waves pile up
+            for cell, rid in rids:
+                try:
+                    req = sch.request(rid)
+                except KeyError:
+                    results[cell.id] = {
+                        "status": "error",
+                        "error": "request evicted before harvest "
+                                 "(raise Scheduler keep_done above "
+                                 "max_wave)"}
+                    continue
+                if req.status == "done":
+                    results[cell.id] = {"status": "done",
+                                        "artifacts": req.artifacts}
+                    artifacts[cell.id] = req.artifacts
+                    if keep_all or cell.id in keep:
+                        states[cell.id] = req.final_state
+                    done_cells += 1
+                else:
+                    results[cell.id] = {"status": "error",
+                                        "error": req.error or req.status}
+            if progress is not None:
+                reg = sch.registry.stats()
+                progress({"done": done_cells,
+                          "total": len(plan_.cells),
+                          "errors": sum(1 for r in results.values()
+                                        if r["status"] == "error"),
+                          "groups_done": gi + (1 if lo + max_wave >=
+                                               len(cells) else 0),
+                          "groups_total": len(plan_.groups),
+                          "planned_compiles": plan_.planned_compiles,
+                          "program_builds": reg["misses"]
+                          - stats0["misses"],
+                          "wall_s": round(time.time() - t0, 3)})
+    wall = time.time() - t0
+    reg = sch.registry.stats()
+    builds = reg["misses"] - stats0["misses"]
+    # the compile-key-minimal contract, ASSERTED (module docstring).
+    # An errored cell may legitimately leave its group's programs
+    # unbuilt (builds < expected), so the exact-equality check only
+    # applies to fully-clean cold runs — errored cells are the
+    # report's/CLI's exit-1 story, not a scheduling bug.
+    clean = all(r["status"] == "done" for r in results.values())
+    if strict_builds and cold and clean \
+            and builds != plan_.expected_builds:
+        raise RuntimeError(
+            f"matrix: compile-key-minimal contract violated — "
+            f"{builds} program builds for {plan_.expected_builds} "
+            f"expected ({plan_.planned_compiles} distinct compile "
+            "keys); a group was re-built mid-run")
+    if strict_builds and builds > plan_.expected_builds:
+        raise RuntimeError(
+            f"matrix: {builds} program builds exceed the plan's "
+            f"{plan_.expected_builds} even on a warm registry")
+    report = MatrixReport.build(
+        plan_, results, wall_s=wall,
+        compiles={"program_builds": builds,
+                  "distinct_compile_keys": plan_.planned_compiles,
+                  "registry": reg},
+        scheduler_stats=sch.resilience)
+    return MatrixRun(report=report, artifacts=artifacts, states=states,
+                     requests=requests)
+
+
+# ---------------------------------------------------------- verification
+
+
+def _runner_reference(spec, seed):
+    """One seed of a cell run twice through `Runner` (one obs plane per
+    pass — bit-identical on the trajectory), chunked exactly like the
+    scheduler: the tests/test_serve.py sequential-reference shape, the
+    matrix's pinned-subset oracle."""
+    import numpy as np
+
+    from ..core.network import Runner
+    from ..obs.audit import AuditSpec
+    from ..obs.spec import MetricsSpec
+
+    proto = spec.build_protocol()
+    frame = audit = None
+    runner = Runner(proto, donate=False, chunk_limit=spec.chunk_ms,
+                    metrics=MetricsSpec(stat_each_ms=spec.stat_each_ms)
+                    if "metrics" in spec.obs else None)
+    net, ps = proto.init(np.int32(seed))
+    if spec.partition:
+        import jax.numpy as jnp
+        idx = jnp.asarray(spec.partition, jnp.int32)
+        net = net.replace(nodes=net.nodes.replace(
+            down=net.nodes.down.at[idx].set(True)))
+    net, ps = runner.run_ms(net, ps, spec.sim_ms)
+    if "metrics" in spec.obs:
+        frame = runner.metrics_frame()
+    if "audit" in spec.obs:
+        auditor = Runner(proto, donate=False, chunk_limit=spec.chunk_ms,
+                         audit=AuditSpec())
+        anet, aps = proto.init(np.int32(seed))
+        if spec.partition:
+            import jax.numpy as jnp
+            idx = jnp.asarray(spec.partition, jnp.int32)
+            anet = anet.replace(nodes=anet.nodes.replace(
+                down=anet.nodes.down.at[idx].set(True)))
+        auditor.run_ms(anet, aps, spec.sim_ms)
+        audit = auditor.audit_report()
+    return (net, ps), frame, audit
+
+
+def verify_cell(spec, final_state, artifacts) -> list:
+    """Bit-identity check of one matrix cell against per-seed `Runner`
+    runs: full final pytree per lane, plus the metrics/audit blocks
+    (exact for single-seed cells; seed-summed series/totals for wider
+    ones, matching the blocks' own batch aggregation).  Returns
+    human-readable mismatch strings — empty means bit-identical."""
+    import jax
+    import numpy as np
+
+    mismatches = []
+    spec = spec if isinstance(spec.superstep, int) else spec.validate()
+    refs = [_runner_reference(spec, s) for s in spec.seeds]
+    for i, (state, frame, audit) in enumerate(refs):
+        lane = jax.tree.map(lambda x, i=i: x[i], final_state)
+        for a, b in zip(jax.tree.leaves(lane), jax.tree.leaves(state)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                mismatches.append(
+                    f"seed {spec.seeds[i]}: final-state pytree differs "
+                    "from the sequential Runner run")
+                break
+    if "metrics" in spec.obs and "engine_metrics" in artifacts:
+        blk = artifacts["engine_metrics"]
+        frames = [f for _, f, _ in refs]
+        if len(frames) == 1:
+            from ..obs.export import engine_metrics_block
+            ref_blk = engine_metrics_block(
+                frames[0], extra={"metrics_seeds": 1})
+            if blk != ref_blk:
+                mismatches.append("engine_metrics block differs from "
+                                  "the sequential reference")
+        elif "series" in blk:
+            for name in blk["series"]:
+                if name == "time":
+                    continue
+                want = np.sum([f.column(name) for f in frames],
+                              axis=0)
+                if list(map(int, want)) != blk["series"][name]:
+                    mismatches.append(
+                        f"metrics series {name!r} != the seed-summed "
+                        "sequential reference")
+    if "audit" in spec.obs and "audit" in artifacts:
+        blk = artifacts["audit"]
+        audits = [a for _, _, a in refs]
+        if len(audits) == 1:
+            from ..obs.audit_report import audit_block
+            ref_blk = audit_block(audits[0], extra={"audit_seeds": 1})
+            if blk != ref_blk:
+                mismatches.append("audit block differs from the "
+                                  "sequential reference")
+        else:
+            want_totals = {
+                k: sum(a.totals_dict()[k] for a in audits)
+                for k in audits[0].totals_dict()}
+            if blk["totals"] != want_totals:
+                mismatches.append("audit totals != the seed-summed "
+                                  "sequential reference")
+            if blk["clean"] != all(a.clean for a in audits):
+                mismatches.append("audit verdict differs from the "
+                                  "sequential reference")
+    return mismatches
+
+
+def pick_spot_cells(cells, k: int) -> list:
+    """A deterministic spread of `k` cell ids over the expansion order
+    (first/last/evenly between) — the pinned verification subset."""
+    if k <= 0 or not cells:
+        return []
+    k = min(k, len(cells))
+    if k == 1:
+        return [cells[0].id]
+    idx = sorted({round(i * (len(cells) - 1) / (k - 1))
+                  for i in range(k)})
+    return [cells[i].id for i in idx]

@@ -30,9 +30,20 @@ class PingPong:
     """Parameters mirror PingPong.PingPongParameters (PingPong.java)."""
 
     def __init__(self, node_count=1000, witness=0, latency=None,
-                 node_builder=None, inbox_cap=32):
+                 node_builder=None, inbox_cap=32,
+                 network_latency_name=None):
         self.node_count = node_count
         self.witness = witness
+        if latency is not None and network_latency_name is not None:
+            raise ValueError(
+                "PingPong: pass either latency (an instance) or "
+                "network_latency_name (a registry name), not both")
+        if network_latency_name is not None:
+            # registry-name selection like every other model — the
+            # spec's `latency_model` field and the matrix latency axis
+            # then reach the reference sample protocol too
+            from ..core.latency import get_by_name
+            latency = get_by_name(network_latency_name)
         self.latency = latency or NetworkLatencyByDistanceWJitter()
         self.builder = node_builder or builders.NodeBuilder()
         # Pongs can pile up at the witness: with 1000 nodes the arrival curve

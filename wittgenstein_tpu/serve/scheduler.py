@@ -90,6 +90,14 @@ class Request:
     restored_state: tuple | None = None
     #: chunk boundary this request resumed from (0 = never resumed)
     resumed_from_ms: int = 0
+    #: ledger-row label override (default "serve:<id>") — the matrix
+    #: driver labels rows "matrix:<cell>" so a sweep's provenance reads
+    #: by cell, not by scheduler-internal request id
+    label: str | None = None
+    #: extra keys merged into the ledger row's `extra` dict (the matrix
+    #: driver rides the grid digest + axis labels here, so every
+    #: per-cell RunManifest row is joinable back to its SweepGrid)
+    ledger_extra: dict | None = None
 
     def status_json(self) -> dict:
         out = {"id": self.id, "status": self.status,
@@ -161,9 +169,12 @@ class Scheduler:
 
     # ------------------------------------------------------------- submit
 
-    def submit(self, spec: ScenarioSpec) -> str:
+    def submit(self, spec: ScenarioSpec, label: str | None = None,
+               ledger_extra: dict | None = None) -> str:
         """Validate (raises `ValueError` with remedy text — the HTTP
-        layer's 400) and enqueue; returns the request id."""
+        layer's 400) and enqueue; returns the request id.  `label` /
+        `ledger_extra` ride into the request's ledger row (the matrix
+        driver's per-cell provenance — see the Request fields)."""
         resolved = spec.validate()
         key = resolved.compile_key()
         with self._mu:
@@ -177,7 +188,9 @@ class Scheduler:
                 rid = f"r{self._n:04d}"
             self._requests[rid] = Request(id=rid, spec=resolved,
                                           compile_key=key,
-                                          requested=spec)
+                                          requested=spec, label=label,
+                                          ledger_extra=dict(ledger_extra)
+                                          if ledger_extra else None)
             self._queue.append(rid)
         return rid
 
@@ -376,7 +389,10 @@ class Scheduler:
                      "requested": (ln.req.requested
                                    or ln.req.spec).to_json(),
                      "progress_ms": ln.req.progress_ms,
-                     "width": ln.width} for ln in lanes]}
+                     "width": ln.width,
+                     "label": ln.req.label,
+                     "ledger_extra": ln.req.ledger_extra}
+                    for ln in lanes]}
         try:
             tmp = path + ".tmp.npz"
             checkpoint.save(tmp, state[0], state[1], meta=meta)
@@ -442,7 +458,9 @@ class Scheduler:
                 req = Request(
                     id=rid, spec=spec,
                     compile_key=specs_meta["compile_key"],
-                    requested=ScenarioSpec.from_json(rm["requested"]))
+                    requested=ScenarioSpec.from_json(rm["requested"]),
+                    label=rm.get("label"),
+                    ledger_extra=rm.get("ledger_extra"))
                 req.progress_ms = int(rm["progress_ms"])
                 req.resumed_from_ms = int(rm["progress_ms"])
                 req.restored_state = sl
@@ -685,7 +703,9 @@ class Scheduler:
         try:
             mani = ledger.manifest_from_spec(
                 line, req.requested or req.spec,
-                label=f"serve:{req.id}", compile_key=req.compile_key)
+                label=req.label or f"serve:{req.id}",
+                compile_key=req.compile_key,
+                **(req.ledger_extra or {}))
             return ledger.append(mani, self.ledger_path)
         except Exception as e:      # noqa: BLE001 — provenance only
             import sys

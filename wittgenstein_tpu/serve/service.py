@@ -17,6 +17,14 @@ same object.  JSON in, JSON out:
                         instead of an error (poll-friendly)
   registry_stats()   -> compile-registry warm/cold counters
 
+Sweep grids (wittgenstein_tpu/matrix) ride the same scheduler through
+the `/w/matrix/*` trio: `matrix_submit(grid_json)` plans eagerly
+(cells + planned compiles come back immediately; auto mode starts the
+run on its own worker thread), `matrix_status(id)` streams cells done
+/ program builds / wall, and `matrix_report(id)` returns the ONE
+cross-cell `MatrixReport` artifact; `matrix_run(id)` is the manual-
+mode synchronous drive (the POST /w/batch/run convention).
+
 ``auto=True`` (the server default) drains the queue on a background
 worker thread, so submit returns immediately and status streams; with
 ``auto=False`` (tests, benchmarks) the caller drains explicitly via
@@ -25,10 +33,38 @@ worker thread, so submit returns immediately and status streams; with
 
 from __future__ import annotations
 
+import dataclasses
 import threading
+import time
 
 from .scheduler import Scheduler
 from .spec import ScenarioSpec
+
+
+@dataclasses.dataclass
+class _MatrixJob:
+    """One submitted sweep grid (service-internal mutable record)."""
+
+    id: str
+    grid: object                    # matrix.SweepGrid
+    plan: object                    # matrix.MatrixPlan
+    status: str = "planned"         # planned | running | done | error
+    progress: dict = dataclasses.field(default_factory=dict)
+    report: dict | None = None
+    error: str | None = None
+    submitted: float = dataclasses.field(default_factory=time.time)
+    finished: float | None = None
+
+    def status_json(self) -> dict:
+        out = {"id": self.id, "status": self.status,
+               "grid_digest": self.plan.grid_digest,
+               "cells_total": len(self.plan.cells),
+               "planned_compiles": self.plan.planned_compiles}
+        if self.progress:
+            out["progress"] = dict(self.progress)
+        if self.error:
+            out["error"] = self.error
+        return out
 
 
 class Service:
@@ -39,6 +75,9 @@ class Service:
         self._wake = threading.Event()
         self._stop = False
         self._worker = None
+        self._matrix: dict = {}
+        self._matrix_n = 0
+        self._matrix_mu = threading.Lock()
 
     # ------------------------------------------------------------ worker
 
@@ -100,3 +139,103 @@ class Service:
     def registry_stats(self) -> dict:
         """GET /w/batch/registry."""
         return self.scheduler.registry.registry_block()
+
+    # ---------------------------------------------- matrix (sweep grids)
+
+    def matrix_submit(self, body: dict) -> dict:
+        """POST /w/matrix/submit — body is a `SweepGrid` JSON object.
+        Plans EAGERLY (every cell validated, grouped by compile key —
+        a malformed grid or cell raises ValueError with the cell named,
+        the HTTP layer's 400) and, in auto mode, starts the run on a
+        worker thread; in manual mode the caller drives it with
+        `matrix_run(id)` (POST /w/matrix/run/{id})."""
+        from ..matrix import SweepGrid, plan
+
+        grid = SweepGrid.from_json(body or {})
+        mplan = plan(grid)
+        with self._matrix_mu:
+            self._matrix_n += 1
+            mid = f"m{self._matrix_n:04d}"
+            job = _MatrixJob(id=mid, grid=grid, plan=mplan)
+            self._matrix[mid] = job
+        if self._auto:
+            threading.Thread(target=self._matrix_drive, args=(job,),
+                             daemon=True,
+                             name=f"wtpu-matrix-{mid}").start()
+        return {"id": mid, "status": job.status,
+                "grid_digest": mplan.grid_digest,
+                "cells": len(mplan.cells),
+                "planned_compiles": mplan.planned_compiles}
+
+    def _matrix_job(self, mid: str) -> _MatrixJob:
+        with self._matrix_mu:
+            if mid not in self._matrix:
+                raise KeyError(f"unknown matrix job {mid!r}")
+            return self._matrix[mid]
+
+    def _matrix_drive(self, job: _MatrixJob):
+        """Run one planned grid on the shared scheduler.  States are
+        not retained (the report + ledger rows are the service
+        products; bit-identity verification is the CLI/tests' job).
+        strict_builds=False: the scheduler is shared with /w/batch
+        traffic and other matrix jobs, so the registry's global miss
+        counter cannot be attributed to this run — the report records
+        the measured delta without asserting on it."""
+        from ..matrix import run_grid
+
+        with self._matrix_mu:
+            if job.status != "planned":
+                return                  # single driver per job
+            job.status = "running"
+        try:
+            run = run_grid(job.grid, self.scheduler, plan_=job.plan,
+                           keep_states=(), strict_builds=False,
+                           progress=lambda p: job.progress.update(p))
+            job.report = run.report.to_json()
+            job.status = "done"
+        except Exception as e:          # noqa: BLE001 — a broken grid
+            # must not take the service thread down silently
+            job.status, job.error = "error", f"{type(e).__name__}: " \
+                                            f"{e!s:.500}"
+        finally:
+            job.finished = time.time()
+            self._evict_matrix()
+
+    #: finished matrix jobs retained for report polling (the batch
+    #: plane's keep_done convention — each done job holds a full
+    #: MatrixReport JSON, megabytes for thousand-cell campaigns)
+    keep_done_matrix = 64
+
+    def _evict_matrix(self):
+        """Drop the oldest finished jobs past `keep_done_matrix` so a
+        long-lived server's matrix table cannot grow without bound."""
+        with self._matrix_mu:
+            done = sorted((j for j in self._matrix.values()
+                           if j.status in ("done", "error")),
+                          key=lambda j: j.finished or 0.0)
+            for j in done[:max(0, len(done) - self.keep_done_matrix)]:
+                del self._matrix[j.id]
+
+    def matrix_run(self, mid: str) -> dict:
+        """POST /w/matrix/run/{id} — synchronous drive (manual mode /
+        ops; a no-op returning status when already running or done)."""
+        job = self._matrix_job(mid)
+        if job.status == "planned":
+            self._matrix_drive(job)
+        return job.status_json()
+
+    def matrix_status(self, mid: str) -> dict:
+        """GET /w/matrix/status/{id} — lifecycle + live progress (cells
+        done / program builds / wall)."""
+        return self._matrix_job(mid).status_json()
+
+    def matrix_report(self, mid: str) -> dict:
+        """GET /w/matrix/report/{id} — the `MatrixReport` artifact when
+        done, else the status snapshot (poll-friendly, the
+        /w/batch/result convention)."""
+        job = self._matrix_job(mid)
+        if job.status != "done":
+            return job.status_json()
+        out = dict(job.report)
+        out["status"] = "done"
+        return out

@@ -265,8 +265,9 @@ class ScenarioSpec:
                 raise _err(
                     f"unknown latency_model {self.latency_model!r}: {e} "
                     "(registered: NetworkFixedLatency(ms), "
-                    "NetworkUniformLatency(max), class names from "
-                    "core/latency.py, e.g. "
+                    "NetworkUniformLatency(max), "
+                    "NetworkHeterogeneousLatency(base,spread,skew[,seed])"
+                    ", class names from core/latency.py, e.g. "
                     "NetworkLatencyByDistanceWJitter)") from None
         validate_parameters(self.protocol, self._effective_params())
         if self.engine not in ENGINES:
@@ -444,6 +445,9 @@ class ScenarioSpec:
         malformed values exactly like `bench._int_env` (a bad override
         must not kill the metric line) and never validated here (the
         bench's own setup raises where refusal is the right behavior).
+        One exception: an unknown WTPU_LATENCY name refuses loudly —
+        see the capture below — because tolerance there would DIGEST a
+        model the run never used.
         The capture records the REQUESTED config (e.g. an "auto"
         superstep before resolution, the default batched-engine
         preference): equal digests imply equal programs because the
@@ -467,14 +471,11 @@ class ScenarioSpec:
             protocol, params = "Dfinity", {}
         elif proto_sel == "p2pflood":
             # mirrors bench_quiet's construction (the routing-kernel
-            # A/B workload) — program-affecting latency override folds
-            # in exactly like the Handel branch's str_knobs
+            # A/B workload)
             protocol = "P2PFlood"
             params = {"node_count": n, "dead_node_count": n // 10,
                       "peers_count": 8, "delay_before_resent": 1,
                       "delay_between_sends": 1}
-            if env.get("WTPU_BENCH_LATENCY") is not None:
-                params["network_latency_name"] = env["WTPU_BENCH_LATENCY"]
         else:
             # Unknown proto_sel values also land here; bench.py routes
             # them to bench_quiet, whose refusal fires BEFORE any
@@ -514,6 +515,12 @@ class ScenarioSpec:
                 if env.get(var) is not None:
                     params[key] = (env[var] != "0" if truth == "not0"
                                    else env[var] == "1")
+        if protocol != "Handel" and env.get("WTPU_BENCH_LATENCY"):
+            # the quiet/flood protocols honor the legacy latency
+            # spelling too (bench_quiet builds with it), so it is
+            # program-affecting for EVERY branch and must fold into
+            # the digest exactly like the Handel str_knobs above
+            params["network_latency_name"] = env["WTPU_BENCH_LATENCY"]
         raw_ss = env.get("WTPU_SUPERSTEP")
         if raw_ss == "auto":
             superstep = "auto"
@@ -558,7 +565,37 @@ class ScenarioSpec:
             except (ValueError, TypeError) as e:
                 print(f"bench: ignoring malformed WTPU_CHAOS: {e}",
                       file=sys.stderr)
+        # WTPU_LATENCY selects the run's latency model by registry name
+        # and is captured into the spec FIELD (the canonical spelling,
+        # like the WTPU_CHAOS capture above), so the ledger row records
+        # the model the run actually used.  Unlike the other captures
+        # this one refuses LOUDLY on an unknown name: get_by_name's
+        # fallback-to-default would otherwise run the distance model
+        # while the digest claimed the requested one — a silently
+        # mislabeled ledger row, worse than no metric line.
+        latency_model = None
+        lat_raw = env.get("WTPU_LATENCY")
+        if lat_raw and lat_raw != "0":
+            if env.get("WTPU_BENCH_LATENCY") is not None:
+                raise _err(
+                    "WTPU_LATENCY and WTPU_BENCH_LATENCY both set: one "
+                    "latency selection per run (WTPU_LATENCY is the "
+                    "canonical spelling; the legacy WTPU_BENCH_LATENCY "
+                    "feeds params directly)")
+            from ..core.latency import get_by_name
+            try:
+                get_by_name(lat_raw)
+            except (KeyError, ValueError) as e:
+                raise _err(
+                    f"unknown WTPU_LATENCY {lat_raw!r}: {e} — refusing "
+                    "to digest a latency model the run would not use "
+                    "(registered: NetworkFixedLatency(ms), "
+                    "NetworkUniformLatency(max), "
+                    "NetworkHeterogeneousLatency(base,spread,skew[,seed])"
+                    ", class names from core/latency.py)") from None
+            latency_model = lat_raw
         return cls(
+            latency_model=latency_model,
             fault_schedule=fault_schedule,
             protocol=protocol, params=params,
             seeds=tuple(range(_int("WTPU_BENCH_SEEDS", 16))),

@@ -31,6 +31,17 @@ service"; spec schema in serve/spec.py):
     POST /w/batch/run                      manual queue drain
     GET  /w/batch/registry                 compile-registry hit/miss
 
+Matrix plane (wittgenstein_tpu/matrix — README "Scenario matrix";
+grid schema in matrix/grid.py):
+
+    POST /w/matrix/submit                  body: SweepGrid JSON ->
+                                           {"id", "grid_digest", "cells",
+                                            "planned_compiles"}
+    GET  /w/matrix/status/{id}             lifecycle + cells done /
+                                           program builds / wall
+    GET  /w/matrix/report/{id}             the MatrixReport artifact
+    POST /w/matrix/run/{id}                manual synchronous drive
+
 Run: python -m wittgenstein_tpu.server.http [port]
 """
 
@@ -118,6 +129,18 @@ class _Handler(BaseHTTPRequestHandler):
          lambda s, m, b: s.batch.run_pending()),
         ("GET", r"^/w/batch/registry$",
          lambda s, m, b: s.batch.registry_stats()),
+        # ---- matrix plane (wittgenstein_tpu/matrix): a whole sweep
+        # grid as one request — planned at submit (400 names the bad
+        # cell), driven on the batch scheduler, reported as ONE
+        # cross-cell artifact.  Same no-sim-lock rule as /w/batch/*.
+        ("POST", r"^/w/matrix/submit$",
+         lambda s, m, b: s.batch.matrix_submit(b or {})),
+        ("GET", r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.matrix_status(m.group(1))),
+        ("GET", r"^/w/matrix/report/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.matrix_report(m.group(1))),
+        ("POST", r"^/w/matrix/run/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.matrix_run(m.group(1))),
     ]
 
     # Routes that must NOT take the sim lock (keyed by the ROUTES pattern,
@@ -129,6 +152,10 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/result/([A-Za-z0-9_-]+)$",
         r"^/w/batch/run$",
         r"^/w/batch/registry$",
+        r"^/w/matrix/submit$",
+        r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
+        r"^/w/matrix/report/([A-Za-z0-9_-]+)$",
+        r"^/w/matrix/run/([A-Za-z0-9_-]+)$",
     })
 
     @property
