@@ -391,6 +391,157 @@ def test_cli_plan_only(capsys):
     assert "4 cells -> 2 compile keys" in out
 
 
+# ---------------------------------------------------- campaign resume
+
+
+def _norm_report(rep):
+    """A report's resume-invariant projection: everything except the
+    run-local accounting (wall, measured builds, scheduler counters,
+    resume markers) — the kill-mid-campaign bit-identity target."""
+    import copy
+    d = copy.deepcopy(rep.to_json())
+    for k in ("wall_s", "program_builds", "registry", "resilience",
+              "resume"):
+        d.pop(k, None)
+    for row in d["cells"]:
+        row.pop("resumed_from_ms", None)
+    return d
+
+
+KILL_GRID_AXES = (
+    {"name": "chaos", "field": "fault_schedule",
+     "values": [{"churn": [[3, 20, 60]]}, None],
+     "labels": ["churn", "none"]},
+    {"name": "seed", "field": "seeds", "values": [[0], [1], [2]]},
+)
+
+
+def test_kill_mid_campaign_resume_bit_identical(tmp_path):
+    """THE campaign-resume acceptance pin: a multi-group grid (chaos
+    axis -> 2 compile keys, one group under churn) is hard-stopped
+    mid-flight — some cells finished (ledger rows), one group caught
+    mid-run (checkpoint, under chaos), the rest never ran.  A fresh
+    scheduler + `run_grid(resume=True)` serves finished cells from
+    their ledger rows, resumes the checkpointed group bit-identically,
+    re-plans only the rest — and the resulting `MatrixReport` (per-cell
+    summaries, impact deltas, audit verdicts, time_to_done headlines,
+    by-axis aggregates, planned compile accounting) is BIT-IDENTICAL
+    to the uninterrupted run's, as are the re-run cells' final
+    pytrees."""
+    import jax
+    import numpy as np
+
+    g = _grid(base={"protocol": "PingPong", "params": {"node_count": 64},
+                    "seeds": [0], "sim_ms": 120, "chunk_ms": 40,
+                    "obs": ["metrics", "audit"]},
+              axes=KILL_GRID_AXES)
+    p = plan(g)
+    assert p.planned_compiles == 2      # churn group + clean group
+    ref = run_grid(g, Scheduler(ledger_path=str(tmp_path / "ref.jsonl")),
+                   plan_=p)
+    assert ref.report.clean
+
+    # hard stop: chunk launches start failing mid-campaign.  Waves of
+    # 2 cells x 3 chunks x (primary + audit shadow) = 6 launches per
+    # wave; dying after 14 lets the first group's two waves finish (3
+    # cells -> 3 ledger rows) and kills the second group at its chunk
+    # 2 — a mid-flight checkpoint UNDER CHURN (groups run largest-
+    # first and equal-sized ties keep plan order: churn is first).
+    led, ck = str(tmp_path / "led.jsonl"), str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def killer(fn, *a):
+        calls["n"] += 1
+        if calls["n"] > 14:
+            raise RuntimeError("KILLED")
+        return fn(*a)
+
+    crashed = run_grid(
+        g, Scheduler(ledger_path=led, checkpoint_dir=ck, launcher=killer,
+                     max_retries=0, retry_backoff_s=0.0),
+        plan_=p, max_wave=2)
+    assert 0 < crashed.report.data["cells_done"] < len(p.cells)
+    rows_after_crash = ledger.read_all(led)
+    assert 0 < len(rows_after_crash) < len(p.cells)
+    import os
+    assert os.listdir(ck), "no mid-flight checkpoint was written"
+
+    resumed = run_grid(g, Scheduler(ledger_path=led, checkpoint_dir=ck),
+                       plan_=p, resume=True)
+    rinfo = resumed.report.data["resume"]
+    assert rinfo["from_ledger"] == len(rows_after_crash)
+    assert rinfo["resumed_requests"] >= 1   # the checkpointed cells
+    assert resumed.report.clean
+    assert _norm_report(resumed.report) == _norm_report(ref.report)
+    # re-run / checkpoint-resumed cells: full final pytrees identical
+    # to the uninterrupted run (ledger-served cells have no fresh
+    # state — their row IS the verified artifact)
+    assert resumed.states, "resume re-ran nothing: the kill was a no-op"
+    for cid, st in resumed.states.items():
+        for x, y in zip(jax.tree.leaves(st),
+                        jax.tree.leaves(ref.states[cid])):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # the finished campaign dropped its checkpoints
+    assert not os.listdir(ck)
+
+
+def test_resume_cross_grid_dedup_and_stale_refusal(tmp_path):
+    """Cross-grid dedup: a cell whose exact config digest already has
+    a clean ledger row is served from the ledger and counted as
+    `deduped`.  And the loud refusal: resuming a DIFFERENT grid
+    against a checkpoint directory from another campaign names the
+    mismatch instead of mixing trajectories."""
+    led = str(tmp_path / "led.jsonl")
+    g1 = _grid(base={"protocol": "PingPong",
+                     "params": {"node_count": 64}, "seeds": [0],
+                     "sim_ms": 120, "chunk_ms": 40,
+                     "obs": ["metrics", "audit"]})
+    r1 = run_grid(g1, Scheduler(ledger_path=led))
+    assert r1.report.clean
+
+    # same cells + one new: the overlap is served from g1's rows
+    g2 = _grid(base=dict(g1.base),
+               axes=({"name": "seed", "field": "seeds",
+                      "values": [[0], [1], [2]]},))
+    r2 = run_grid(g2, Scheduler(ledger_path=led,
+                                checkpoint_dir=str(tmp_path / "ck2")),
+                  resume=True)
+    assert r2.report.clean
+    assert r2.report.data["resume"]["deduped"] == 2
+    assert r2.report.data["resume"]["from_ledger"] == 0
+    # the deduped rows fed real report rows (summaries + headline)
+    for row in r2.report.data["cells"]:
+        assert row["status"] == "done"
+        assert row["summary"]["done_count"] > 0
+
+    # stale-checkpoint refusal: kill g1 mid-run, then resume a grid
+    # with an EDITED base against those checkpoints
+    ck = str(tmp_path / "ck3")
+    calls = {"n": 0}
+
+    def killer(fn, *a):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("KILLED")
+        return fn(*a)
+
+    run_grid(g1, Scheduler(ledger_path=str(tmp_path / "x.jsonl"),
+                           checkpoint_dir=ck, launcher=killer,
+                           max_retries=0, retry_backoff_s=0.0),
+             max_wave=2)
+    g_edited = _grid(base={**dict(g1.base), "sim_ms": 240})
+    with pytest.raises(ValueError, match="grid"):
+        run_grid(g_edited, Scheduler(ledger_path=led,
+                                     checkpoint_dir=ck), resume=True)
+
+
+def test_cli_resume_flags(capsys):
+    mod = _cli()
+    assert mod.main(["--grid", json.dumps(_grid().to_json()),
+                     "--resume"]) == 2
+    assert "--checkpoint-dir" in capsys.readouterr().err
+
+
 # ------------------------------------------------------------ the 1000
 
 

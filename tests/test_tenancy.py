@@ -1,0 +1,366 @@
+"""Serve-plane tenancy (PR 13): admission control with 429/retry-after,
+deficit-round-robin fairness, per-request deadlines, and chunk-boundary
+checkpoint-preemption — with bit-identity to an uninterrupted run (full
+final pytree AND the stitched obs-plane artifacts) as the acceptance
+bar, chaos ON for one preemption case, plus the stale-checkpoint
+digest refusal.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fill the registry
+from wittgenstein_tpu.serve import (AdmissionError, ScenarioSpec,
+                                    Scheduler, TenantPolicy)
+
+CHAOS = {"churn": [[3, 20, 60]], "partitions": [[30, 90, 1, 0, 32]]}
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0, 1), sim_ms=120, chunk_ms=40,
+                obs=("metrics",))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def _artifact_blocks(art):
+    """The obs-plane blocks a preemption must not change (wall-clock
+    and scheduler-level fields honestly differ)."""
+    return {k: art[k] for k in ("engine_metrics", "trace", "audit",
+                                "summary") if k in art}
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """One uninterrupted run of the canonical spec — final state AND
+    artifacts are the bit-identity reference for every preemption
+    path."""
+    sched = Scheduler()
+    rid = sched.submit(_spec())
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    return req.final_state, _artifact_blocks(req.artifacts)
+
+
+# ------------------------------------------------------------ spec fields
+
+
+def test_tenancy_fields_digest_only():
+    """tenant/priority/deadline_ms are in the digest (two requests of
+    different urgency are different requests) but NEVER in the compile
+    key (tenancy must not split the coalesced program)."""
+    a = _spec()
+    b = _spec(tenant="interactive", priority=3, deadline_ms=5000)
+    assert a.digest() != b.digest()
+    assert a.validate().compile_key() == b.validate().compile_key()
+    # round-trips through the canonical JSON form
+    again = ScenarioSpec.from_json(b.canonical_json())
+    assert again == b and again.digest() == b.digest()
+
+
+def test_tenancy_field_refusals():
+    with pytest.raises(ValueError, match="tenant"):
+        _spec(tenant="")
+    with pytest.raises(ValueError, match="priority"):
+        _spec(priority="high")
+    with pytest.raises(ValueError, match="deadline_ms"):
+        _spec(deadline_ms=0)
+    with pytest.raises(ValueError, match="deadline_ms"):
+        _spec(deadline_ms=2.5)
+    with pytest.raises(ValueError, match="weight"):
+        TenantPolicy(weight=0)
+
+
+# ------------------------------------------------------------- admission
+
+
+def test_admission_429_and_recovery():
+    """An over-budget tenant is refused with a retry-after remedy; the
+    queue is bounded, the scheduler survives, and a post-drain retry
+    lands — nothing crashes, nothing grows without bound."""
+    sched = Scheduler(tenants={"camp": {"max_queued": 2,
+                                        "retry_after_s": 0.5}})
+    r1 = sched.submit(_spec(tenant="camp", seeds=(0,)))
+    r2 = sched.submit(_spec(tenant="camp", seeds=(1,)))
+    with pytest.raises(AdmissionError, match="retry after") as ei:
+        sched.submit(_spec(tenant="camp", seeds=(2,)))
+    assert ei.value.retry_after_s >= 0.5
+    assert ei.value.http_status == 429
+    # other tenants are not collateral damage
+    r3 = sched.submit(_spec(tenant="other", seeds=(3,)))
+    sched.run_pending()
+    assert all(sched.request(r).status == "done" for r in (r1, r2, r3))
+    # the drain freed the budget: the retried submission is admitted
+    r4 = sched.submit(_spec(tenant="camp", seeds=(2,)))
+    sched.run_pending()
+    assert sched.request(r4).status == "done"
+    ten = sched.tenancy_stats()
+    assert ten["rejected"] == 1
+    assert ten["tenants"]["camp"]["rejected"] == 1
+    assert ten["tenants"]["camp"]["done"] == 3
+
+
+def test_http_429_round_trip():
+    """The acceptance pin over real HTTP: over-budget submit returns
+    429 with Retry-After (header + body), the worker never crashes,
+    and the queue drains back to admitting."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from wittgenstein_tpu.server.http import make_server
+
+    httpd = make_server(port=0, batch_auto=False, scheduler=Scheduler(
+        tenants={"default": {"max_queued": 1, "retry_after_s": 0.25}}))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, body=None):
+        req = urllib.request.Request(
+            f"{base}{path}", method="POST",
+            data=json.dumps(body or {}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        spec = _spec(seeds=(0,))
+        st, sub = post("/w/batch/submit", spec.to_json())
+        assert st == 200 and sub["id"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/w/batch/submit",
+                 dataclasses.replace(spec, seeds=(1,)).to_json())
+        e = ei.value
+        assert e.code == 429
+        body = json.loads(e.read())
+        assert body["retry_after_s"] >= 0.25
+        assert "retry after" in body["error"]
+        assert int(e.headers["Retry-After"]) >= 1
+        # a malformed spec is still a 400, not a 429
+        with pytest.raises(urllib.error.HTTPError) as ei400:
+            post("/w/batch/submit", {"protocol": "PingPong",
+                                     "obs": ["typo_plane"]})
+        assert ei400.value.code == 400
+        # worker alive: drain, then the retry is admitted
+        st, _ = post("/w/batch/run")
+        assert st == 200
+        st, sub2 = post("/w/batch/submit",
+                        dataclasses.replace(spec, seeds=(1,)).to_json())
+        assert st == 200, sub2
+        post("/w/batch/run")
+        with urllib.request.urlopen(f"{base}/w/batch/tenancy",
+                                    timeout=10) as resp:
+            ten = json.loads(resp.read())
+        assert ten["rejected"] == 1
+        assert ten["tenants"]["default"]["done"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------------------- fairness
+
+
+def test_drr_fairness_no_starvation():
+    """A weight-4 interactive tenant's request completes before a
+    weight-1 campaign backlog finishes: the backlog is sliced at chunk
+    boundaries instead of holding the device to its end — and every
+    request still completes (no starvation either way)."""
+    sched = Scheduler(tenants={"campaign": {"weight": 1},
+                               "interactive": {"weight": 4}},
+                      quantum_chunks=1)
+    camp = [sched.submit(_spec(tenant="campaign", seeds=(s,)))
+            for s in range(3)]
+    # different compile key (node_count) — genuinely non-coalescable
+    inter = sched.submit(_spec(tenant="interactive", seeds=(9,),
+                               params={"node_count": 32}))
+    sched.run_pending()
+    reqs = {r: sched.request(r) for r in camp + [inter]}
+    assert all(q.status == "done" for q in reqs.values()), \
+        {r: q.error for r, q in reqs.items()}
+    assert reqs[inter].finished < max(reqs[r].finished for r in camp)
+    assert sched.resilience["preemptions"] >= 1
+
+
+# ------------------------------------------------ preemption bit-identity
+
+
+def test_priority_preempt_then_resume_bit_identical(reference):
+    """A higher-priority submission preempts the running group at the
+    next chunk boundary; the preempted request later completes with a
+    final pytree AND artifacts bit-identical to an uninterrupted run
+    (the in-memory restored_state + saved obs carries path)."""
+    ref_state, ref_blocks = reference
+    sched = Scheduler()
+    fired = {"hi": None}
+
+    def boundary():
+        if fired["hi"] is None:
+            fired["hi"] = sched.submit(
+                _spec(params={"node_count": 32}, seeds=(7,),
+                      priority=5, tenant="interactive"))
+    sched.on_boundary = boundary
+    lo = sched.submit(_spec())
+    sched.run_pending()
+    rlo, rhi = sched.request(lo), sched.request(fired["hi"])
+    assert rlo.status == "done" and rhi.status == "done", \
+        (rlo.error, rhi.error)
+    assert rlo.preempted >= 1
+    assert rlo.artifacts["preempted"] == rlo.preempted
+    assert rhi.finished < rlo.finished      # the preemptor went first
+    _trees_equal(ref_state, rlo.final_state)
+    # the stitched metrics artifact covers the WHOLE span, identically
+    assert _artifact_blocks(rlo.artifacts) == ref_blocks
+
+
+def test_preempt_under_chaos_bit_identical():
+    """The same preempt-then-resume pin with chaos ON: a fault-schedule
+    spec (churn + mid-run partition) preempted mid-flight still lands
+    bit-identical state and clean, identical audit artifacts."""
+    spec = _spec(obs=("metrics", "audit"), fault_schedule=CHAOS)
+    ref_sched = Scheduler()
+    ref_rid = ref_sched.submit(spec)
+    ref_sched.run_pending()
+    ref = ref_sched.request(ref_rid)
+    assert ref.status == "done", ref.error
+    assert ref.artifacts["audit"]["clean"], ref.artifacts["audit"]
+
+    sched = Scheduler()
+    fired = {"hi": None}
+
+    def boundary():
+        if fired["hi"] is None:
+            fired["hi"] = sched.submit(
+                _spec(params={"node_count": 32}, seeds=(7,),
+                      priority=5))
+    sched.on_boundary = boundary
+    rid = sched.submit(spec)
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    assert req.preempted >= 1
+    _trees_equal(ref.final_state, req.final_state)
+    assert _artifact_blocks(req.artifacts) == \
+        _artifact_blocks(ref.artifacts)
+
+
+def test_deadline_demotes_never_kills(reference):
+    """A request past its deadline yields to waiting work at the chunk
+    boundary but still completes bit-identically — deadlines demote
+    the device hold, they never kill the run."""
+    ref_state, _ = reference
+    sched = Scheduler()
+    fired = {"other": None}
+
+    def boundary():
+        if fired["other"] is None:
+            time.sleep(0.01)        # guarantee the 1 ms deadline blew
+            fired["other"] = sched.submit(
+                _spec(params={"node_count": 32}, seeds=(7,),
+                      tenant="other"))
+    sched.on_boundary = boundary
+    dl = sched.submit(_spec(deadline_ms=1))
+    sched.run_pending()
+    rd, ro = sched.request(dl), sched.request(fired["other"])
+    assert rd.status == "done" and ro.status == "done"
+    assert rd.preempted >= 1
+    assert rd.artifacts["deadline_missed"] is True
+    assert ro.finished < rd.finished
+    _trees_equal(ref_state, rd.final_state)
+
+
+def test_preempted_request_coalesces_on_return(reference):
+    """A preempted vmapped request re-enters the SAME compiled program
+    (registry HIT, no rebuild): preemption is scheduler-side only."""
+    ref_state, _ = reference
+    sched = Scheduler()
+    fired = {"hi": None}
+
+    def boundary():
+        if fired["hi"] is None:
+            fired["hi"] = sched.submit(
+                _spec(params={"node_count": 32}, seeds=(7,),
+                      priority=9))
+    sched.on_boundary = boundary
+    lo = sched.submit(_spec())
+    sched.run_pending()
+    assert sched.request(lo).preempted >= 1
+    reg = sched.registry.stats()
+    # exactly two programs ever built: the 64n group and the 32n one —
+    # the preempted group's continuation re-used its program
+    assert reg["entries"] == 2, reg
+    _trees_equal(ref_state, sched.request(lo).final_state)
+
+
+# ------------------------------------------- checkpoint digest refusal
+
+
+def test_stale_checkpoint_spec_digest_refused(tmp_path):
+    """The satellite fix: a checkpoint whose stored spec was edited
+    after writing (digest mismatch) is REFUSED with remedy text, not
+    silently restored; an untouched sibling file still resumes."""
+    from wittgenstein_tpu.utils import checkpoint as ckpt
+
+    ck = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def killer(fn, *a):
+        calls["n"] += 1
+        if calls["n"] > 2:          # chunk 1 (primary+shadow) lands,
+            raise RuntimeError("KILLED")    # chunk 2 dies
+        return fn(*a)
+
+    crashed = Scheduler(launcher=killer, retry_backoff_s=0.0,
+                        max_retries=0, checkpoint_dir=ck)
+    crashed.submit(_spec(obs=("metrics", "audit")))
+    crashed.run_pending()
+    files = os.listdir(ck)
+    assert len(files) == 1
+    path = os.path.join(ck, files[0])
+
+    meta = ckpt.peek_meta(path)
+    assert meta["schema"] == 2
+    assert meta["requests"][0]["spec_digest"]
+    # the helper itself: consistent meta has no problems
+    assert ckpt.stale_meta_problems(meta) == []
+
+    # tamper: the spec says 240 ms now, the digest says it didn't
+    meta["requests"][0]["spec"]["sim_ms"] = 240
+    assert ckpt.stale_meta_problems(meta)
+    z = dict(np.load(path))
+    z["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                  dtype=np.uint8)
+    np.savez_compressed(path, **z)
+
+    from wittgenstein_tpu.serve import StaleCheckpointError
+    fresh = Scheduler(checkpoint_dir=ck)
+    with pytest.raises(StaleCheckpointError, match="edited"):
+        fresh.resume_checkpoints()
+    # an older-schema file is refused too (cannot be verified)
+    meta["schema"] = 1
+    z["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                  dtype=np.uint8)
+    np.savez_compressed(path, **z)
+    with pytest.raises(StaleCheckpointError, match="schema"):
+        Scheduler(checkpoint_dir=ck).resume_checkpoints()
+    # a GARBAGE file is NOT a staleness refusal: it keeps the PR-10
+    # skip-with-stderr behavior instead of aborting the whole resume
+    with open(path, "wb") as f:
+        f.write(b"not an npz at all")
+    assert Scheduler(checkpoint_dir=ck).resume_checkpoints() == []

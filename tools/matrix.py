@@ -62,10 +62,19 @@ def main(argv=None) -> int:
                     help="per-cell RunManifest JSONL (default: the "
                          "shared reports/ledger)")
     ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
-                    help="write chunk-boundary group checkpoints "
-                         "(crash-safety groundwork; end-to-end "
-                         "campaign resume is not wired into this CLI "
-                         "yet — see Scheduler.resume_checkpoints)")
+                    help="write chunk-boundary group checkpoints; a "
+                         "killed campaign restarts with --resume from "
+                         "exactly where it died (bit-identical "
+                         "continuation, spec digests verified)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume a killed campaign: re-enqueue this "
+                         "grid's per-group checkpoints (needs the "
+                         "interrupted run's --checkpoint-dir), serve "
+                         "finished cells from their ledger rows "
+                         "(--ledger; exact config-digest matches from "
+                         "other grids dedup too), and re-run only the "
+                         "unfinished cells.  Stale/mismatched "
+                         "checkpoints refuse loudly (exit 2)")
     ap.add_argument("--max-wave", type=int, default=64,
                     help="max cells per coalesced launch wave "
                          "(default 64)")
@@ -108,11 +117,31 @@ def main(argv=None) -> int:
                   f"{p['groups_done']}/{p['groups_total']} groups",
                   file=sys.stderr, flush=True)
 
+    if args.resume and not args.checkpoint_dir:
+        print("config error: --resume needs --checkpoint-dir (the "
+              "interrupted run's checkpoint directory)", file=sys.stderr)
+        return 2
     sch = Scheduler(ledger_path=args.ledger,
                     checkpoint_dir=args.checkpoint_dir)
-    run = run_grid(grid, sch, plan_=mplan, max_wave=args.max_wave,
-                   keep_states=tuple(spot), progress=progress)
+    try:
+        run = run_grid(grid, sch, plan_=mplan, max_wave=args.max_wave,
+                       keep_states=tuple(spot), progress=progress,
+                       resume=args.resume)
+    except ValueError as e:
+        # ONLY the resume staleness refusals are config errors; a
+        # ValueError from a plain campaign is an internal failure and
+        # must keep its traceback
+        if not args.resume:
+            raise
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
     report = run.report
+    if args.resume and "resume" in report.data:
+        r = report.data["resume"]
+        print(f"resume: {r['from_ledger']} cells from this grid's "
+              f"ledger rows, {r['deduped']} deduped from exact config "
+              f"matches, {r['resumed_requests']} requests resumed "
+              "from checkpoints")
     print(report.format())
     if args.out:
         path = report.save(args.out)
@@ -125,6 +154,12 @@ def main(argv=None) -> int:
             print(f"spot check {cid}: SKIPPED (cell "
                   f"{row['status']}: {row.get('error')})")
             rc = 1
+            continue
+        if cid not in run.states:
+            # a resume run served this cell from its ledger row — no
+            # fresh state to verify; it was spot-checkable when it ran
+            print(f"spot check {cid}: SKIPPED (served from the "
+                  "ledger; re-run without --resume to re-verify)")
             continue
         mism = verify_cell(mplan.resolved[cid], run.states[cid],
                            run.artifacts[cid])

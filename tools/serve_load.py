@@ -1,0 +1,209 @@
+"""Multi-tenant load generator for the serve request plane.
+
+Hundreds of concurrent mixed-compile-key `ScenarioSpec`s across >= 3
+tenants hammer ONE auto-draining `serve.Service` from client threads —
+the shape a production deployment actually sees — and the run records
+the tenancy plane's honest numbers (BENCH_NOTES.md r15 `tenancy`
+block):
+
+  * p50/p99 submit->result latency per tenant and overall (wall clock
+    from the client's submit call to its poll observing "done");
+  * rejection rate: 429-equivalent `AdmissionError`s per tenant
+    (clients back off `retry_after_s` and retry, bounded — the
+    admission-control round trip, not a crash);
+  * preemption count (scheduler chunk-boundary yields) and per-tenant
+    completion counts (zero starvation is asserted: every tenant's
+    every request eventually completes);
+  * compile amortization: completed requests per program build — the
+    coalescing story under tenancy (tenancy fields are NOT in the
+    compile key, so mixed tenants still share programs).
+
+Tenant mix (weights/budgets exercise every tenancy mechanism):
+  interactive — weight 4, short single-seed specs, deadline-carrying;
+  campaign    — weight 1, BOUNDED queue (max_queued; the 429 source),
+                wider multi-seed specs;
+  batch       — weight 2, unbounded, mixed spans.
+
+Usage: python tools/serve_load.py [--requests N] [--out PATH]
+       (default 120 requests; --out writes the JSON line to a file
+       as well as stdout)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax                                        # noqa: E402
+
+import wittgenstein_tpu.models                    # noqa: E402, F401
+from wittgenstein_tpu.serve import (              # noqa: E402
+    AdmissionError, ScenarioSpec, Scheduler, Service)
+
+#: the three compile keys of the mix (latency_model is program-
+#: affecting) — mixed keys make the DRR/preemption path do real work
+LATENCIES = (None, "NetworkFixedLatency(10)", "NetworkFixedLatency(30)")
+
+
+def tenant_specs(name: str, count: int):
+    """The tenant's request list (deterministic — seeds/spans derive
+    from the request index, so two runs of the generator submit the
+    same work)."""
+    out = []
+    for i in range(count):
+        lat = LATENCIES[i % len(LATENCIES)]
+        if name == "interactive":
+            out.append(ScenarioSpec(
+                protocol="PingPong", params={"node_count": 64},
+                seeds=(i,), sim_ms=80, chunk_ms=40, obs=("metrics",),
+                latency_model=lat, tenant=name, priority=2,
+                deadline_ms=60_000))
+        elif name == "campaign":
+            out.append(ScenarioSpec(
+                protocol="PingPong", params={"node_count": 64},
+                seeds=(100 + 2 * i, 101 + 2 * i), sim_ms=160,
+                chunk_ms=40, obs=("metrics",), latency_model=lat,
+                tenant=name))
+        else:
+            out.append(ScenarioSpec(
+                protocol="PingPong", params={"node_count": 64},
+                seeds=(500 + i,), sim_ms=120 if i % 2 else 80,
+                chunk_ms=40, obs=("metrics",), latency_model=lat,
+                tenant=name))
+    return out
+
+
+def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50):
+    """One tenant's client thread: submit each spec (backing off on
+    429s), poll to completion, record the submit->result wall."""
+    for spec in specs:
+        t0 = time.perf_counter()
+        rid = None
+        for _ in range(max_attempts):
+            try:
+                rid = svc.submit(spec.to_json())["id"]
+                break
+            except AdmissionError as e:
+                rec["rejected"] += 1
+                time.sleep(min(e.retry_after_s, 0.5))
+        if rid is None:
+            rec["gave_up"] += 1
+            continue
+        while True:
+            st = svc.status(rid)
+            if st["status"] in ("done", "error"):
+                break
+            time.sleep(poll_s)
+        if st["status"] == "done":
+            rec["done"] += 1
+            rec["lat_ms"].append(1e3 * (time.perf_counter() - t0))
+        else:
+            rec["errors"] += 1
+
+
+def pct(sorted_vals, q):
+    """Upper nearest-rank percentile (ceil, not floor: a floored p99
+    over ~100 samples would read ~p98 and hide the one true tail
+    outlier — the number this tool exists to report)."""
+    import math
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1,
+            math.ceil(q * (len(sorted_vals) - 1)))
+    return round(sorted_vals[i], 1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/serve_load.py",
+        description="multi-tenant serve load generator (tenancy bench)")
+    ap.add_argument("--requests", type=int, default=120,
+                    help="total requests across the 3 tenants "
+                         "(default 120)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the JSON line here")
+    args = ap.parse_args(argv)
+
+    per = max(1, args.requests // 3)
+    sch = Scheduler(
+        tenants={"interactive": {"weight": 4},
+                 "campaign": {"weight": 1, "max_queued": 4,
+                              "retry_after_s": 0.2},
+                 "batch": {"weight": 2}},
+        quantum_chunks=2)
+    svc = Service(scheduler=sch, auto=True)
+    recs = {name: {"submitted": per, "done": 0, "errors": 0,
+                   "rejected": 0, "gave_up": 0, "lat_ms": []}
+            for name in ("interactive", "campaign", "batch")}
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=drive_tenant,
+                                args=(svc, tenant_specs(n, per), recs[n]),
+                                name=f"load-{n}")
+               for n in recs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    svc.close()
+
+    ten = svc.tenancy_stats()
+    reg = svc.registry_stats()
+    all_lat = sorted(x for r in recs.values() for x in r["lat_ms"])
+    done_total = sum(r["done"] for r in recs.values())
+    starved = [n for n, r in recs.items() if r["done"] < r["submitted"]
+               and not r["errors"] and not r["gave_up"]]
+    tenancy = {
+        "schema": 1,                        # BENCH_NOTES r15
+        "requests": 3 * per,
+        "completed": done_total,
+        "rejections_429": sum(r["rejected"] for r in recs.values()),
+        "preemptions": ten["preemptions"],
+        "p50_ms": pct(all_lat, 0.50),
+        "p99_ms": pct(all_lat, 0.99),
+        "program_builds": reg["misses"],
+        "requests_per_build": round(done_total / max(1, reg["misses"]),
+                                    1),
+        "chunk_wall_ema_s": ten["chunk_wall_ema_s"],
+        "per_tenant": {
+            n: {"submitted": r["submitted"], "completed": r["done"],
+                "rejected_429": r["rejected"], "errors": r["errors"],
+                "gave_up": r["gave_up"],
+                "p50_ms": pct(sorted(r["lat_ms"]), 0.50),
+                "p99_ms": pct(sorted(r["lat_ms"]), 0.99),
+                "weight": ten["tenants"].get(n, {}).get("weight")}
+            for n, r in recs.items()},
+    }
+    out = {
+        "metric": "serve_load_p99_ms",
+        "value": tenancy["p99_ms"],
+        "unit": "ms",
+        "wall_total_s": round(wall, 2),
+        "tenancy": tenancy,
+        "registry": reg,
+        "platform": jax.default_backend(),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        pathlib.Path(args.out).write_text(line + "\n")
+    if starved:
+        print(f"STARVATION: tenant(s) {starved} did not complete their "
+              "requests", file=sys.stderr)
+        return 1
+    errs = sum(r["errors"] + r["gave_up"] for r in recs.values())
+    if errs:
+        print(f"{errs} request(s) errored or gave up", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -431,6 +431,51 @@ def _matrix_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: The tenancy build (PR 13) audited under "<name>+tenancy": the SAME
+#: grid/spec compile path as the matrix target but with the schema-4
+#: tenancy trio (tenant/priority/deadline_ms) set — scheduling
+#: metadata that must stay scheduler-side.  The zero-cost rules
+#: (carry_extra_leaves=0, transfer_ops=0) prove the tenancy plane adds
+#: NO compiled residue: a tenancy-labelled spec compiles the identical
+#: program its unlabelled twin does (the fields are digest-only, never
+#: compile-key — serve/spec.py schema-4 note).
+TENANCY_PROTOCOLS = ("PingPong",)
+TENANCY_SUFFIX = "+tenancy"
+
+
+def _tenancy_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(TENANCY_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import scan_chunk
+        from ..serve.spec import ScenarioSpec
+
+        spec = ScenarioSpec(
+            protocol=base_name, params={"node_count": 64},
+            seeds=(0,), sim_ms=chunk, chunk_ms=chunk, obs=(),
+            tenant="analysis", priority=3,
+            deadline_ms=60_000).validate()
+        # the tenancy fields must not have split the compile key
+        bare = ScenarioSpec(
+            protocol=base_name, params={"node_count": 64},
+            seeds=(0,), sim_ms=chunk, chunk_ms=chunk,
+            obs=()).validate()
+        assert spec.compile_key() == bare.compile_key(), \
+            "tenancy fields leaked into the compile key"
+        proto = spec.build_protocol()
+        base = jax.vmap(scan_chunk(proto, chunk,
+                                   superstep=spec.superstep))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+tenancy"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -630,6 +675,8 @@ def target_names() -> tuple:
                  sorted(f"{n}{AUDIT_SUFFIX}" for n in AUDIT_PROTOCOLS) +
                  sorted(f"{n}{CHAOS_SUFFIX}" for n in CHAOS_PROTOCOLS) +
                  sorted(f"{n}{MATRIX_SUFFIX}" for n in MATRIX_PROTOCOLS) +
+                 sorted(f"{n}{TENANCY_SUFFIX}"
+                        for n in TENANCY_PROTOCOLS) +
                  sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
@@ -648,6 +695,12 @@ def get_target(name: str) -> AnalysisTarget:
                 f"unknown matrix target {name!r}; known: "
                 f"{sorted(f'{n}{MATRIX_SUFFIX}' for n in MATRIX_PROTOCOLS)}")
         return _matrix_target(name)
+    if name.endswith(TENANCY_SUFFIX):
+        if name[:-len(TENANCY_SUFFIX)] not in TENANCY_PROTOCOLS:
+            raise KeyError(
+                f"unknown tenancy target {name!r}; known: "
+                f"{sorted(f'{n}{TENANCY_SUFFIX}' for n in TENANCY_PROTOCOLS)}")
+        return _tenancy_target(name)
     if name.endswith(CHAOS_SUFFIX):
         if name[:-len(CHAOS_SUFFIX)] not in CHAOS_PROTOCOLS:
             raise KeyError(

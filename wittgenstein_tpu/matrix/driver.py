@@ -63,11 +63,139 @@ def _drain(sch: Scheduler, rids: list, poll_s: float = 0.05):
         time.sleep(poll_s)
 
 
+def _harvest(sch: Scheduler, pairs, results, artifacts, states,
+             keep_all, keep) -> int:
+    """Pull settled requests into the per-cell result tables
+    (IMMEDIATELY after each drain: the scheduler's keep_done eviction
+    may drop finished records once later waves pile up).  Returns the
+    number of cells done."""
+    done = 0
+    for cell, rid in pairs:
+        try:
+            req = sch.request(rid)
+        except KeyError:
+            results[cell.id] = {
+                "status": "error",
+                "error": "request evicted before harvest "
+                         "(raise Scheduler keep_done above max_wave)"}
+            continue
+        if req.status == "done":
+            results[cell.id] = {"status": "done",
+                                "artifacts": req.artifacts}
+            artifacts[cell.id] = req.artifacts
+            if keep_all or cell.id in keep:
+                states[cell.id] = req.final_state
+            done += 1
+        else:
+            results[cell.id] = {"status": "error",
+                                "error": req.error or req.status}
+    return done
+
+
+def _row_artifacts(row) -> dict:
+    """Rebuild the artifact subset a `MatrixReport` cell row needs
+    from a finished cell's `RunManifest` ledger row (the durable
+    completion facts `Scheduler._finalize` rides in `extra`).  The
+    resulting report row is IDENTICAL to the live run's — summary,
+    audit verdict/violations and the time_to_done headline were all
+    computed once at finalize from the same blocks."""
+    ex = row.extra or {}
+    art = {"summary": dict(ex["summary"]), "from_ledger": True,
+           "ledger_row": row.run}
+    if row.audit_clean is not None:
+        art["audit"] = {"clean": bool(row.audit_clean),
+                        "violations": dict(ex.get("violations", {}))}
+    if ex.get("time_to_done_ms") is not None:
+        art["time_to_done_ms"] = int(ex["time_to_done_ms"])
+    return art
+
+
+def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
+    """The campaign-resume join (run_grid(resume=True)): per-group
+    checkpoints re-enqueued through `Scheduler.resume_checkpoints`
+    (spec digests verified file-side) plus finished-cell ledger rows
+    keyed on the grid digest — and, for cells not in THIS grid's rows,
+    a cross-grid dedup by exact config digest.  Returns
+    ``(served, pre, counts)``: ledger-served results by cell id,
+    checkpoint-requeued (cell, rid) pairs, and the resume accounting.
+    Refuses LOUDLY (ValueError with remedy) on checkpoints from a
+    different grid or cells whose spec no longer digests to the
+    checkpointed one — silently mixing trajectories of two different
+    campaigns is the one thing resume must never do."""
+    from ..obs import ledger as ledger_mod
+
+    cells_by_id = {c.id: c for c in plan_.cells}
+    rids = sch.resume_checkpoints()
+    pre = []
+    try:
+        for rid in rids:
+            req = sch.request(rid)
+            ex = req.ledger_extra or {}
+            cid = ex.get("cell")
+            if ex.get("grid_digest") != plan_.grid_digest \
+                    or cid not in cells_by_id:
+                raise ValueError(
+                    f"matrix resume: checkpoint request {rid} belongs "
+                    f"to grid {ex.get('grid_digest')!r} / cell "
+                    f"{cid!r}, not this grid ({plan_.grid_digest}). "
+                    "Fix: point --checkpoint-dir at the directory "
+                    "this grid's interrupted run used, or delete the "
+                    "stale checkpoints to restart those groups from "
+                    "scratch")
+            want = cells_by_id[cid].spec.digest()
+            got = (req.requested or req.spec).digest()
+            if got != want:
+                raise ValueError(
+                    f"matrix resume: cell {cid!r} now digests to "
+                    f"{want} but its checkpoint was written for {got} "
+                    "— the spec was edited since the interrupted run. "
+                    "Fix: restore the original grid, or delete the "
+                    "stale checkpoint to re-run the cell under the "
+                    "new spec")
+            pre.append((cells_by_id[cid], rid))
+    except ValueError:
+        # roll back EVERY re-enqueued request before refusing: on a
+        # shared scheduler, valid earlier files' requests left queued
+        # would run with no harvester (wasted device time + surprise
+        # ledger rows)
+        sch.withdraw(rids)
+        raise
+    requeued = {c.id for c, _ in pre}
+    by_cell: dict = {}
+    by_digest: dict = {}
+    for row in ledger_mod.read_all(ledger_path):
+        ex = row.extra or {}
+        if "summary" not in ex or row.audit_clean is False:
+            continue        # unclean / pre-r15 rows cannot serve cells
+        if ex.get("grid_digest") == plan_.grid_digest and ex.get("cell"):
+            by_cell[ex["cell"]] = row
+        by_digest.setdefault(row.config_digest, row)
+    served: dict = {}
+    counts = {"from_ledger": 0, "deduped": 0,
+              "resumed_requests": len(pre)}
+    for cell in plan_.cells:
+        if cell.id in requeued:
+            continue        # mid-flight, not finished — must re-run
+        dig = cell.spec.digest()
+        row, dedup = by_cell.get(cell.id), False
+        if row is not None and row.config_digest != dig:
+            row = None      # same id, edited spec: never serve stale
+        if row is None:
+            row, dedup = by_digest.get(dig), True
+        if row is None:
+            continue
+        served[cell.id] = {"status": "done",
+                           "artifacts": _row_artifacts(row)}
+        counts["deduped" if dedup else "from_ledger"] += 1
+    return served, pre, counts
+
+
 def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
              plan_: MatrixPlan | None = None, *, ledger_path=None,
              checkpoint_dir=None, max_wave: int = 64,
              keep_states=("*",), progress=None,
-             strict_builds: bool = True) -> MatrixRun:
+             strict_builds: bool = True,
+             resume: bool = False) -> MatrixRun:
     """Run every cell of `grid` (module docstring) and build the
     `MatrixReport`.
 
@@ -84,6 +212,17 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
         False when sharing a scheduler with concurrent traffic (the
         service's auto mode) — the report still records the measured
         delta, it just can't be an assertion there.
+    resume      — end-to-end campaign resume: re-enqueue this grid's
+        per-group checkpoints (the scheduler needs the interrupted
+        run's `checkpoint_dir`), serve already-finished cells from
+        their ledger rows (keyed on the grid digest; an exact config-
+        digest match from ANOTHER grid is served too and counted as
+        `deduped`), and re-plan only the unfinished cells.  Refuses
+        loudly on spec/digest mismatches with stale checkpoints.  The
+        resulting report's cell rows are bit-identical to an
+        uninterrupted run's (tests/test_matrix.py kill-mid-campaign
+        pin); the run-local accounting (wall, program_builds, the
+        `resume` block) honestly differs.
     """
     plan_ = plan_ or plan(grid)
     sch = scheduler or Scheduler(ledger_path=ledger_path,
@@ -98,7 +237,29 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
     states: dict = {}
     requests: dict = {}
     done_cells = 0
-    for gi, group in enumerate(plan_.groups):
+    resume_counts = None
+    groups = plan_.groups
+    expected_builds = plan_.expected_builds
+    if resume:
+        served, pre, resume_counts = _load_resume(
+            plan_, sch, ledger_path or sch.ledger_path)
+        results.update(served)
+        done_cells += len(served)
+        # the resumed run's build CEILING: ledger-served groups never
+        # compile; checkpoint-requeued groups do (during the pre-drain
+        # below, inside this run's accounting window) and so stay in
+        # the ceiling
+        expected_builds = sum(
+            g.builds for g in plan_.remaining(set(served)))
+        # drive the checkpoint-requeued groups to completion first —
+        # they re-enter mid-flight and harvest like any other cell
+        if pre:
+            requests.update({c.id: rid for c, rid in pre})
+            _drain(sch, [rid for _, rid in pre])
+            done_cells += _harvest(sch, pre, results, artifacts,
+                                   states, keep_all, keep)
+        groups = plan_.remaining(set(results))
+    for gi, group in enumerate(groups):
         cells = list(group.cells)
         for lo in range(0, len(cells), max_wave):
             wave = cells[lo:lo + max_wave]
@@ -122,28 +283,8 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                 requests[cell.id] = rid
                 rids.append((cell, rid))
             _drain(sch, [rid for _, rid in rids])
-            # harvest IMMEDIATELY: the scheduler's keep_done eviction
-            # may drop finished records once later waves pile up
-            for cell, rid in rids:
-                try:
-                    req = sch.request(rid)
-                except KeyError:
-                    results[cell.id] = {
-                        "status": "error",
-                        "error": "request evicted before harvest "
-                                 "(raise Scheduler keep_done above "
-                                 "max_wave)"}
-                    continue
-                if req.status == "done":
-                    results[cell.id] = {"status": "done",
-                                        "artifacts": req.artifacts}
-                    artifacts[cell.id] = req.artifacts
-                    if keep_all or cell.id in keep:
-                        states[cell.id] = req.final_state
-                    done_cells += 1
-                else:
-                    results[cell.id] = {"status": "error",
-                                        "error": req.error or req.status}
+            done_cells += _harvest(sch, rids, results, artifacts,
+                                   states, keep_all, keep)
             if progress is not None:
                 reg = sch.registry.stats()
                 progress({"done": done_cells,
@@ -152,7 +293,7 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                                         if r["status"] == "error"),
                           "groups_done": gi + (1 if lo + max_wave >=
                                                len(cells) else 0),
-                          "groups_total": len(plan_.groups),
+                          "groups_total": len(groups),
                           "planned_compiles": plan_.planned_compiles,
                           "program_builds": reg["misses"]
                           - stats0["misses"],
@@ -164,25 +305,30 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
     # An errored cell may legitimately leave its group's programs
     # unbuilt (builds < expected), so the exact-equality check only
     # applies to fully-clean cold runs — errored cells are the
-    # report's/CLI's exit-1 story, not a scheduling bug.
+    # report's/CLI's exit-1 story, not a scheduling bug.  A resumed
+    # run asserts only the CEILING, but against its narrowed
+    # expected_builds (live + checkpoint-requeued groups): a served
+    # group that somehow re-compiles is a scheduling bug there too.
     clean = all(r["status"] == "done" for r in results.values())
-    if strict_builds and cold and clean \
-            and builds != plan_.expected_builds:
+    if strict_builds and cold and clean and not resume \
+            and builds != expected_builds:
         raise RuntimeError(
             f"matrix: compile-key-minimal contract violated — "
-            f"{builds} program builds for {plan_.expected_builds} "
+            f"{builds} program builds for {expected_builds} "
             f"expected ({plan_.planned_compiles} distinct compile "
             "keys); a group was re-built mid-run")
-    if strict_builds and builds > plan_.expected_builds:
+    if strict_builds and builds > expected_builds:
         raise RuntimeError(
-            f"matrix: {builds} program builds exceed the plan's "
-            f"{plan_.expected_builds} even on a warm registry")
+            f"matrix: {builds} program builds exceed the "
+            f"{'resume-narrowed ' if resume else ''}expected "
+            f"{expected_builds} even on a warm registry")
     report = MatrixReport.build(
         plan_, results, wall_s=wall,
         compiles={"program_builds": builds,
                   "distinct_compile_keys": plan_.planned_compiles,
                   "registry": reg},
-        scheduler_stats=sch.resilience)
+        scheduler_stats=sch.resilience,
+        resume=resume_counts)
     return MatrixRun(report=report, artifacts=artifacts, states=states,
                      requests=requests)
 

@@ -73,6 +73,23 @@ class MatrixPlan:
                 "expected_builds": self.expected_builds,
                 "largest_group": max(len(g.cells) for g in self.groups)}
 
+    def remaining(self, done_ids) -> tuple:
+        """The RE-PLAN of a resumed campaign: the same groups in the
+        same largest-first order, each narrowed to the cells NOT in
+        `done_ids` (cells already served from ledger rows or requeued
+        from a group checkpoint); emptied groups drop out.  Build
+        accounting stays honest — a group with any live cell still
+        needs its full (key, plane) program set, a fully-served group
+        needs none."""
+        done = set(done_ids)
+        out = []
+        for g in self.groups:
+            live = tuple(c for c in g.cells if c.id not in done)
+            if live:
+                out.append(Group(compile_key=g.compile_key, cells=live,
+                                 builds=g.builds))
+        return tuple(out)
+
 
 def plan(grid: SweepGrid) -> MatrixPlan:
     """Expand + validate + group (module docstring).  Raises

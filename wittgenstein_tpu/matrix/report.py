@@ -24,6 +24,11 @@ from __future__ import annotations
 import dataclasses
 import json
 
+#: shared home since PR 13 (the serve scheduler persists it into each
+#: ledger row's extra, which is how a resumed campaign's report rows
+#: stay bit-identical to live ones); re-exported here for callers
+from ..obs.export import time_to_done_ms  # noqa: F401
+
 #: report schema version (bump on field changes; readers key on it)
 SCHEMA = 1
 
@@ -31,33 +36,6 @@ SCHEMA = 1
 #: chaos.impact_summary fingerprint, shared so the matrix and the
 #: chaos CLI can never disagree about what "impact" means
 IMPACT_KEYS = ("done_count", "live_count", "msg_sent", "msg_received")
-
-
-def time_to_done_ms(engine_metrics: dict | None):
-    """Earliest interval end (absolute sim ms) at which the run's
-    final `done_count` was already reached, from an `engine_metrics`
-    block's series; None when metrics are off, the series was
-    truncated, or nothing ever finished."""
-    if not engine_metrics or "series" not in engine_metrics:
-        return None
-    series = engine_metrics["series"]
-    if "done_count" not in series:
-        return None
-    final = engine_metrics.get("totals", {}).get("done_count", 0)
-    if final <= 0:
-        return None
-    vals = series["done_count"]
-    samples = series.get("samples")
-    times = series["time"]
-    last = 0
-    for i, t in enumerate(times):
-        # forward-fill quiet (samples == 0) intervals, the
-        # MetricsFrame.filled contract — a fast-forwarded row holds 0s
-        if samples is None or samples[i] > 0:
-            last = vals[i]
-        if last >= final:
-            return int(t)
-    return None
 
 
 def _cell_row(cell, rspec, result, twin_summary) -> dict:
@@ -76,7 +54,12 @@ def _cell_row(cell, rspec, result, twin_summary) -> dict:
         if not art["audit"]["clean"]:
             row["violations"] = {k: v for k, v in
                                  art["audit"]["violations"].items() if v}
-    ttd = time_to_done_ms(art.get("engine_metrics"))
+    # a ledger-served cell (campaign resume / cross-grid dedup) carries
+    # the headline directly — computed by the scheduler at finalize
+    # from the same engine_metrics block, so the row is identical
+    ttd = art.get("time_to_done_ms")
+    if ttd is None:
+        ttd = time_to_done_ms(art.get("engine_metrics"))
     if ttd is not None:
         row["time_to_done_ms"] = ttd
     if art.get("resumed_from_ms"):
@@ -134,9 +117,14 @@ class MatrixReport:
     @classmethod
     def build(cls, plan, results: dict, wall_s: float,
               compiles: dict | None = None,
-              scheduler_stats: dict | None = None) -> "MatrixReport":
+              scheduler_stats: dict | None = None,
+              resume: dict | None = None) -> "MatrixReport":
         """Assemble from a `MatrixPlan` + per-cell results
-        (cell id -> {"status", "artifacts"|"error"})."""
+        (cell id -> {"status", "artifacts"|"error"}).  `resume` is the
+        driver's campaign-resume accounting (cells served from ledger
+        rows / deduped across grids / checkpoint-resumed requests) —
+        recorded as its own block so the cell rows stay identical to
+        an uninterrupted run's."""
         grid = plan.grid
         summaries = {cid: r["artifacts"]["summary"]
                      for cid, r in results.items()
@@ -171,6 +159,8 @@ class MatrixReport:
             data.update(compiles)       # program_builds / registry block
         if scheduler_stats:
             data["resilience"] = dict(scheduler_stats)
+        if resume:
+            data["resume"] = dict(resume)
         return cls(data=data)
 
     # -------------------------------------------------------------- views

@@ -301,3 +301,34 @@ def engine_metrics_block(frame: MetricsFrame, extra: dict | None = None) \
     if extra:
         out.update(extra)
     return out
+
+
+def time_to_done_ms(engine_metrics: dict | None):
+    """Earliest interval end (absolute sim ms) at which the run's
+    final `done_count` was already reached, from an `engine_metrics`
+    block's series; None when metrics are off, the series was
+    truncated, or nothing ever finished.  Shared home (PR 13): the
+    matrix report's per-cell headline AND the serve scheduler's
+    durable ledger-row extra compute it from the same block, so a
+    campaign resumed from ledger rows reads the same number a live
+    run would."""
+    if not engine_metrics or "series" not in engine_metrics:
+        return None
+    series = engine_metrics["series"]
+    if "done_count" not in series:
+        return None
+    final = engine_metrics.get("totals", {}).get("done_count", 0)
+    if final <= 0:
+        return None
+    vals = series["done_count"]
+    samples = series.get("samples")
+    times = series["time"]
+    last = 0
+    for i, t in enumerate(times):
+        # forward-fill quiet (samples == 0) intervals, the
+        # MetricsFrame.filled contract — a fast-forwarded row holds 0s
+        if samples is None or samples[i] > 0:
+            last = vals[i]
+        if last >= final:
+            return int(t)
+    return None

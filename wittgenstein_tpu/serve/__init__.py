@@ -23,13 +23,18 @@ coalesced into few compiled device programs.
                 compatible requests join at the next chunk boundary),
                 returning per-request ProgressPerTime/trace/audit
                 artifacts and appending one `RunManifest` ledger row
-                per request.
+                per request.  Since PR 13 it is multi-tenant: bounded
+                per-tenant admission (`AdmissionError` -> HTTP 429 +
+                retry-after), deficit-round-robin fairness over
+                tenants (`TenantPolicy` weights), and chunk-boundary
+                checkpoint-preemption with bit-identical resumption.
   `service`   — `Service`: submit/status/result surface (in-process
                 and behind `server/http.py`'s `/w/batch/*` routes)
                 streaming progress from the on-device metrics plane.
 """
 
 from .registry import CompileRegistry  # noqa: F401
-from .scheduler import Request, Scheduler  # noqa: F401
+from .scheduler import (AdmissionError, Request, Scheduler,  # noqa: F401
+                        StaleCheckpointError, TenantPolicy)
 from .service import Service  # noqa: F401
 from .spec import ENGINES, OBS_PLANES, ScenarioSpec  # noqa: F401

@@ -40,6 +40,46 @@ the resumed trajectory is bit-identical to an uninterrupted run
 (chunk-boundary restore of a deterministic pure engine is exact —
 tests/test_serve_resilience.py), with obs-plane artifacts covering the
 post-restore span and the ledger row carrying `resumed_from_ms`.
+Checkpoint metadata is schema 2: each stored request carries its spec
+digest, and `resume_checkpoints` REFUSES a file whose stored spec no
+longer digests to its recorded value (a stale .npz from an edited
+spec would otherwise be silently restored into the wrong trajectory).
+
+Tenancy (PR 13 — the survivability half of ROADMAP item 5): the FIFO
+single-tenant queue becomes a multi-tenant one.
+
+  * Admission control: `Scheduler(tenants={name: TenantPolicy})`
+    bounds each tenant's QUEUED depth (`max_queued`); an over-budget
+    `submit` raises `AdmissionError` — carrying `retry_after_s`
+    estimated from the tenant's queued chunk backlog times a running
+    EMA of chunk wall time — which the HTTP layer maps to 429 +
+    Retry-After instead of letting the queue grow without bound.
+  * Weighted-fair queueing: `run_pending` picks the next group by
+    DEFICIT ROUND ROBIN over the tenants with queued work (strict
+    priority classes first — only the highest queued `spec.priority`
+    competes; within a tenant, earliest `deadline_ms` first, then
+    FIFO).  Each tenant's turn adds `weight x quantum_chunks` to its
+    deficit; the selected group runs with that deficit as its chunk
+    budget and pays back what it consumed, so a thousand-cell campaign
+    wave and an interactive spec INTERLEAVE instead of the campaign
+    starving everything behind it.
+  * Checkpoint-based preemption: a running group yields at the next
+    CHUNK BOUNDARY — never mid-program — when (a) its DRR budget is
+    exhausted and non-coalescable work waits, (b) a strictly
+    higher-priority request waits, or (c) every deadline-carrying lane
+    in the group is past its deadline and other work waits.  Yielding
+    re-enqueues the requests with their chunk-boundary lane states
+    (and their stashed obs-plane carries) held in memory — the group
+    checkpoint file, when `checkpoint_dir` is set, covers the
+    process-death case exactly as in PR 10 — so a preempted-then-
+    resumed run is BIT-IDENTICAL to an uninterrupted one, including
+    its metrics/trace/audit artifacts (tests/test_tenancy.py).
+
+With no `tenants=` config the scheduler behaves exactly as before
+(FIFO within the top priority class, no slice preemption): tenancy is
+scheduler-side only, and the compiled programs are untouched — the
+`PingPong+tenancy` analysis target pins carry_extra_leaves=0 /
+transfer_ops=0 over a tenancy-labelled spec.
 """
 
 from __future__ import annotations
@@ -57,6 +97,56 @@ from .spec import ScenarioSpec
 
 #: request lifecycle states
 STATUSES = ("queued", "running", "done", "error")
+
+#: group-checkpoint metadata schema (bump on field changes).  2 (PR
+#: 13): per-request `spec_digest` — resume verifies each stored spec
+#: still digests to it and refuses a tampered/stale file with remedy
+#: text instead of silently restoring the wrong trajectory.
+CKPT_META_SCHEMA = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's admission + fairness budget (module docstring)."""
+
+    #: DRR weight — this tenant's share of chunk budget per rotation
+    weight: int = 1
+    #: max QUEUED requests before submit is refused with 429/retry-
+    #: after (0 = unbounded, the single-tenant default)
+    max_queued: int = 0
+    #: floor of the retry-after estimate an over-budget submit carries
+    retry_after_s: float = 1.0
+
+    def __post_init__(self):
+        if self.weight < 1:
+            raise ValueError(f"TenantPolicy: weight must be >= 1, got "
+                             f"{self.weight} (a zero-weight tenant "
+                             "would starve by construction)")
+        if self.max_queued < 0 or self.retry_after_s < 0:
+            raise ValueError("TenantPolicy: max_queued and "
+                             "retry_after_s must be >= 0")
+
+
+class StaleCheckpointError(ValueError):
+    """A checkpoint refused by the staleness gate (schema mismatch or
+    a stored spec that no longer digests to its recorded value) — the
+    ONE resume failure that must raise through `resume_checkpoints`
+    instead of being skipped: silently restoring a different spec's
+    trajectory is worse than restarting.  Plain IO/decode failures
+    (torn files, garbage .npz) keep the PR-10 skip-with-stderr
+    behavior."""
+
+
+class AdmissionError(RuntimeError):
+    """An over-budget submission, refused — the HTTP layer's 429 (the
+    `http_status` attribute is what `server/http.py` keys on; the
+    worker never crashes, the client retries after `retry_after_s`)."""
+
+    http_status = 429
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = round(float(retry_after_s), 3)
 
 
 @dataclasses.dataclass
@@ -98,12 +188,40 @@ class Request:
     #: driver rides the grid digest + axis labels here, so every
     #: per-cell RunManifest row is joinable back to its SweepGrid)
     ledger_extra: dict | None = None
+    #: chunk-boundary preemptions this request absorbed (tenancy)
+    preempted: int = 0
+    #: obs-plane carries stashed before a preemption — restored into
+    #: the next `_Lane` so the final artifacts cover the WHOLE span
+    saved_carries: dict | None = None
+    #: group-level fast-forward skip stats accumulated across
+    #: preemption segments (the artifact's `fast_forward` block)
+    ff_accum: dict | None = None
+
+    @property
+    def tenant(self) -> str:
+        return self.spec.tenant
+
+    @property
+    def deadline_at(self) -> float | None:
+        """Absolute wall-clock deadline (None = none).  A checkpoint-
+        resumed request's clock restarts at re-submission — the
+        original process is gone, and so is its wall budget."""
+        if self.spec.deadline_ms is None:
+            return None
+        return self.submitted + self.spec.deadline_ms / 1000.0
 
     def status_json(self) -> dict:
         out = {"id": self.id, "status": self.status,
                "compile_key": self.compile_key,
                "progress_ms": self.progress_ms,
-               "sim_ms": self.spec.sim_ms}
+               "sim_ms": self.spec.sim_ms,
+               "tenant": self.spec.tenant}
+        if self.spec.priority:
+            out["priority"] = self.spec.priority
+        if self.spec.deadline_ms is not None:
+            out["deadline_ms"] = self.spec.deadline_ms
+        if self.preempted:
+            out["preempted"] = self.preempted
         if self.progress:
             out["progress"] = dict(self.progress)
         if self.error:
@@ -121,7 +239,10 @@ class _Lane:
         # made — only the remaining chunks run
         self.remaining = (req.spec.sim_ms -
                           req.progress_ms) // req.spec.chunk_ms
-        self.carries: dict = {}     # plane -> [per-chunk carry slices]
+        # a PREEMPTED request re-enters with its pre-yield obs carries
+        # intact, so the finished artifacts stitch the whole span
+        self.carries: dict = req.saved_carries or {}
+        req.saved_carries = None    # plane -> [per-chunk carry slices]
 
     def stash(self, plane: str, carry, lo: int):
         sl = jax.tree.map(lambda x: x[lo:lo + self.width], carry)
@@ -136,7 +257,9 @@ class Scheduler:
     def __init__(self, registry: CompileRegistry | None = None,
                  ledger_path=None, on_boundary=None, keep_done: int = 256,
                  launcher=None, max_retries: int = 2,
-                 retry_backoff_s: float = 0.05, checkpoint_dir=None):
+                 retry_backoff_s: float = 0.05, checkpoint_dir=None,
+                 tenants: dict | None = None,
+                 quantum_chunks: int | None = None):
         self.registry = registry or CompileRegistry()
         self.ledger_path = ledger_path      # None = the shared default
         #: the device-program launch seam: ``launcher(fn, *args)``
@@ -149,8 +272,34 @@ class Scheduler:
         self.retry_backoff_s = float(retry_backoff_s)
         #: directory for chunk-boundary group checkpoints (None = off)
         self.checkpoint_dir = checkpoint_dir
+        #: tenancy: tenant name -> `TenantPolicy` (plain dicts accepted
+        #: for JSON-authored configs; "*" sets the default policy).
+        #: Empty = the single-tenant PR-7 behavior: FIFO within the top
+        #: priority class, no DRR slicing.
+        self.tenants = {name: (pol if isinstance(pol, TenantPolicy)
+                               else TenantPolicy(**pol))
+                        for name, pol in (tenants or {}).items()}
+        #: DRR quantum in CHUNKS per weight point per rotation; None
+        #: defaults to 4 when any tenant policy exists.  Slicing is
+        #: active iff this resolves non-None.
+        if quantum_chunks is None and self.tenants:
+            quantum_chunks = 4
+        self.quantum_chunks = quantum_chunks
+        self._deficit: dict = {}            # tenant -> chunk deficit
+        #: DRR rotation pointer: the last-served tenant NAME (the ring
+        #: itself is rebuilt per selection from the tenants with
+        #: queued work, so bookkeeping stays bounded by live tenants —
+        #: client-supplied tenant strings must not leak memory in a
+        #: long-lived service)
+        self._last_tenant: str | None = None
+        #: EMA of one coalesced chunk's wall seconds — the retry-after
+        #: estimate's unit cost (0.0 until the first chunk lands)
+        self.chunk_wall_ema_s = 0.0
+        #: per-tenant lifetime counters (tenancy_stats())
+        self._tstats: dict = {}
         #: resilience accounting, surfaced in per-request artifacts
-        self.resilience = {"retries": 0, "demotions": 0, "resumed": 0}
+        self.resilience = {"retries": 0, "demotions": 0, "resumed": 0,
+                           "preemptions": 0, "rejected": 0}
         #: test/ops hook: called at every chunk boundary of a running
         #: group, BEFORE admission — a callback may `submit()` and see
         #: its request join this group (the continuous-batching pin)
@@ -167,17 +316,98 @@ class Scheduler:
         self._n = 0
         self._draining = False
 
+    # ------------------------------------------------------------ tenancy
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        """The tenant's admission/fairness policy ("*" = the default
+        for unlisted tenants; unbounded weight-1 otherwise)."""
+        pol = self.tenants.get(tenant) or self.tenants.get("*")
+        return pol or TenantPolicy()
+
+    #: lifetime-counter retention bound for UNCONFIGURED tenants —
+    #: tenant is a client-supplied string, so a long-lived service
+    #: must not let per-name stat dicts grow without limit (configured
+    #: tenants are never evicted)
+    MAX_TENANT_STATS = 4096
+
+    def _tstat(self, tenant: str) -> dict:
+        """Per-tenant lifetime counters (caller holds the lock)."""
+        if tenant not in self._tstats and \
+                len(self._tstats) >= self.MAX_TENANT_STATS:
+            queued_now = {self._requests[r].spec.tenant
+                          for r in self._queue}
+            for victim in list(self._tstats):    # oldest-first (dict
+                # insertion order); skip configured/live tenants
+                if victim not in self.tenants and \
+                        victim not in queued_now:
+                    del self._tstats[victim]
+                    break
+        return self._tstats.setdefault(
+            tenant, {"submitted": 0, "rejected": 0, "done": 0,
+                     "errors": 0, "preemptions": 0})
+
+    def _admit(self, spec: ScenarioSpec):
+        """Refuse an over-budget submission with a retry-after remedy
+        (caller holds the lock).  Only QUEUED requests count against
+        the budget — a running request's slot is already freed for the
+        next submit, which is what keeps the queue bounded while the
+        device stays busy."""
+        pol = self.policy(spec.tenant)
+        self._tstat(spec.tenant)["submitted"] += 1
+        if not pol.max_queued:
+            return
+        mine = [self._requests[r] for r in self._queue
+                if self._requests[r].spec.tenant == spec.tenant]
+        if len(mine) < pol.max_queued:
+            return
+        self.resilience["rejected"] += 1
+        self._tstat(spec.tenant)["rejected"] += 1
+        backlog_chunks = sum(
+            (r.spec.sim_ms - r.progress_ms) // r.spec.chunk_ms
+            for r in mine)
+        retry = max(pol.retry_after_s,
+                    backlog_chunks * self.chunk_wall_ema_s)
+        raise AdmissionError(
+            f"tenant {spec.tenant!r} queue is full ({len(mine)}/"
+            f"{pol.max_queued} queued requests): retry after "
+            f"~{retry:.1f}s, raise the tenant's max_queued, or split "
+            "the submission across tenants", retry_after_s=retry)
+
+    def tenancy_stats(self) -> dict:
+        """The `/w/batch/tenancy` block: per-tenant queue depth +
+        lifetime counters, plus the scheduler-level knobs a load
+        generator needs to interpret them."""
+        with self._mu:
+            out = {"tenants": {}, "quantum_chunks": self.quantum_chunks,
+                   "chunk_wall_ema_s": round(self.chunk_wall_ema_s, 4),
+                   "rejected": self.resilience["rejected"],
+                   "preemptions": self.resilience["preemptions"]}
+            queued: dict = {}
+            for rid in self._queue:
+                t = self._requests[rid].spec.tenant
+                queued[t] = queued.get(t, 0) + 1
+            for t in set(self._tstats) | set(queued) | set(
+                    k for k in self.tenants if k != "*"):
+                pol = self.policy(t)
+                out["tenants"][t] = {
+                    **self._tstat(t), "queued": queued.get(t, 0),
+                    "weight": pol.weight, "max_queued": pol.max_queued}
+            return out
+
     # ------------------------------------------------------------- submit
 
     def submit(self, spec: ScenarioSpec, label: str | None = None,
                ledger_extra: dict | None = None) -> str:
         """Validate (raises `ValueError` with remedy text — the HTTP
-        layer's 400) and enqueue; returns the request id.  `label` /
-        `ledger_extra` ride into the request's ledger row (the matrix
-        driver's per-cell provenance — see the Request fields)."""
+        layer's 400) and enqueue; returns the request id.  An
+        over-budget tenant raises `AdmissionError` (the 429 path; see
+        `_admit`).  `label` / `ledger_extra` ride into the request's
+        ledger row (the matrix driver's per-cell provenance — see the
+        Request fields)."""
         resolved = spec.validate()
         key = resolved.compile_key()
         with self._mu:
+            self._admit(resolved)
             self._n += 1
             rid = f"r{self._n:04d}"
             while rid in self._requests:
@@ -204,11 +434,34 @@ class Scheduler:
         with self._mu:
             return list(self._queue)
 
+    def withdraw(self, rids) -> int:
+        """Remove still-QUEUED requests from the scheduler (running/
+        settled ones are left alone); returns how many were removed.
+        The matrix driver's resume rollback: when a later checkpoint
+        fails validation, the earlier files' re-enqueued requests must
+        not be left orphaned on a shared scheduler — they would run
+        with no harvester."""
+        with self._mu:
+            n = 0
+            for rid in rids:
+                req = self._requests.get(rid)
+                if req is not None and req.status == "queued":
+                    if rid in self._queue:
+                        self._queue.remove(rid)
+                    del self._requests[rid]
+                    n += 1
+            return n
+
     # -------------------------------------------------------------- drain
 
     def run_pending(self) -> dict:
-        """Drain the queue: group compatible requests, run each group.
-        Returns ``{"processed": N, "registry": stats}``."""
+        """Drain the queue: pick the next group (DRR over tenants
+        within the top priority class — `_next_head`), run it up to
+        its chunk budget, repeat until empty.  A preempted group goes
+        back on the queue and is re-picked on a later rotation, so the
+        loop terminates: every `_run_group` call advances at least one
+        chunk or settles a request.  Returns ``{"processed": N,
+        "registry": stats}``."""
         with self._mu:
             if self._draining:
                 return {"processed": 0, "registry": self.registry.stats()}
@@ -216,20 +469,76 @@ class Scheduler:
         processed = 0
         try:
             while True:
-                with self._mu:
-                    head = next((r for r in self._queue), None)
-                if head is None:
+                key, budget, tenant = self._next_head()
+                if key is None:
                     break
-                key = self._requests[head].compile_key
                 try:
-                    processed += self._run_group(key)
+                    done, used = self._run_group(key, budget)
+                    processed += done
                 except Exception as e:      # noqa: BLE001 — a broken
                     # group must not wedge the whole queue
                     self._fail_group(key, e)
+                    used = 0
+                with self._mu:
+                    if tenant in self._deficit:
+                        self._deficit[tenant] -= used
+                        if not any(self._requests[r].spec.tenant == tenant
+                                   for r in self._queue):
+                            # classic DRR: an emptied tenant forfeits
+                            # its leftover deficit (no banking idle
+                            # credit against future contention) — and
+                            # its entry, so arbitrary client-supplied
+                            # tenant names never accumulate
+                            del self._deficit[tenant]
         finally:
             with self._mu:
                 self._draining = False
         return {"processed": processed, "registry": self.registry.stats()}
+
+    def _next_head(self):
+        """Pick the next group to run: ``(compile_key, budget_chunks,
+        tenant)`` or ``(None, None, None)`` on an empty queue.
+
+        Strict priority classes first: only requests at the highest
+        queued `spec.priority` compete.  Without tenancy config the
+        winner is the class's FIFO head with an unbounded budget (the
+        PR-7 behavior).  With tenancy, deficit round robin over the
+        class's tenants: the rotation pointer advances tenant by
+        tenant, each turn adds ``weight x quantum_chunks`` to the
+        tenant's deficit, and the tenant's EDF-then-FIFO head runs
+        with the accumulated deficit as its chunk budget (floor 1 —
+        a group always makes progress)."""
+        with self._mu:
+            if not self._queue:
+                return None, None, None
+            reqs = [self._requests[r] for r in self._queue]
+            top = max(r.spec.priority for r in reqs)
+            cand = [r for r in reqs if r.spec.priority == top]
+            if self.quantum_chunks is None:
+                return cand[0].compile_key, None, cand[0].spec.tenant
+            import bisect
+            # the rotation ring is the sorted set of tenants with
+            # candidate work, entered just AFTER the last-served name
+            # (circular) — equivalent to a persistent round robin, but
+            # bounded: nothing is remembered for tenants with no
+            # queued work except their banked deficit (pruned by
+            # run_pending when they empty)
+            ring = sorted({r.spec.tenant for r in cand})
+            i = bisect.bisect_right(ring, self._last_tenant) \
+                if self._last_tenant is not None else 0
+            tenant = ring[i % len(ring)]
+            self._last_tenant = tenant
+            self._deficit[tenant] = (
+                self._deficit.get(tenant, 0)
+                + self.policy(tenant).weight * self.quantum_chunks)
+            mine = [r for r in cand if r.spec.tenant == tenant]
+            # earliest deadline first; deadline-less requests keep
+            # FIFO order behind every deadline-carrying one
+            head = min(mine, key=lambda r: (
+                r.deadline_at if r.deadline_at is not None
+                else float("inf"), r.submitted))
+            budget = max(1, int(self._deficit[tenant]))
+            return head.compile_key, budget, tenant
 
     def _fail_group(self, key: str, e: Exception):
         """Mark every unfinished request of this compile key errored —
@@ -243,6 +552,7 @@ class Scheduler:
                     if req.id in self._queue:
                         self._queue.remove(req.id)
                     req.status, req.error = "error", msg
+                    self._tstat(req.spec.tenant)["errors"] += 1
 
     # ----------------------------------------------------------- grouping
 
@@ -382,10 +692,14 @@ class Scheduler:
         import os
 
         from ..utils import checkpoint
-        meta = {"compile_key": key, "schema": 1,
+        meta = {"compile_key": key, "schema": CKPT_META_SCHEMA,
                 "requests": [
                     {"id": ln.req.id,
                      "spec": ln.req.spec.to_json(),
+                     # the resume-time staleness gate: the stored spec
+                     # must still digest to this value, or the file
+                     # predates a spec edit and is refused
+                     "spec_digest": ln.req.spec.digest(),
                      "requested": (ln.req.requested
                                    or ln.req.spec).to_json(),
                      "progress_ms": ln.req.progress_ms,
@@ -419,7 +733,14 @@ class Scheduler:
         (chunk-boundary restore of the deterministic pure engine), so
         `first_divergence`-style full-pytree comparison passes
         (tests/test_serve_resilience.py).  Run `run_pending()` (or the
-        service worker) afterwards to drive them to completion."""
+        service worker) afterwards to drive them to completion.
+
+        Staleness refusal (module docstring): a `StaleCheckpointError`
+        — checkpoint meta from another schema, or a stored spec that
+        no longer digests to its recorded `spec_digest` — RAISES
+        through with remedy text; any other failure (torn file,
+        garbage .npz) keeps the PR-10
+        one-bad-file-must-not-block-the-others behavior."""
         import glob
         import os
         if not self.checkpoint_dir:
@@ -429,6 +750,8 @@ class Scheduler:
                 self.checkpoint_dir, "group-*.npz"))):
             try:
                 resumed += self._resume_one(path)
+            except StaleCheckpointError:
+                raise       # a staleness refusal, never swallowed
             except Exception as e:      # noqa: BLE001 — one bad file
                 # must not block the others
                 import sys
@@ -439,6 +762,11 @@ class Scheduler:
     def _resume_one(self, path: str) -> list:
         from ..utils import checkpoint
         specs_meta = checkpoint.peek_meta(path)
+        for problem in checkpoint.stale_meta_problems(specs_meta):
+            raise StaleCheckpointError(
+                f"serve: refusing checkpoint {path}: {problem}. "
+                "Fix: delete the stale file (the run restarts from "
+                "scratch), or resume with the tree/spec that wrote it")
         reqs_meta = specs_meta["requests"]
         spec0 = ScenarioSpec.from_json(reqs_meta[0]["spec"])
         proto = spec0.build_protocol()
@@ -470,12 +798,83 @@ class Scheduler:
             self.resilience["resumed"] += len(rids)
         return rids
 
+    # --------------------------------------------------------- preemption
+
+    def _waiting_elsewhere(self, key: str, engine: str) -> list:
+        """Queued requests that CANNOT join the running group (caller
+        holds the lock): a different compile key, or a lockstep engine
+        that closed admission at launch.  Only these justify yielding
+        — a same-key vmapped request late-joins for free."""
+        out = []
+        for rid in self._queue:
+            r = self._requests[rid]
+            if r.compile_key != key or engine != "vmapped":
+                out.append(r)
+        return out
+
+    def _should_yield(self, key: str, lanes: list, chunks_run: int,
+                      budget: int | None) -> str | None:
+        """The chunk-boundary preemption decision (module docstring).
+        Returns the reason ("priority" | "slice" | "deadline") or
+        None."""
+        engine = lanes[0].req.spec.engine
+        now = time.time()
+        with self._mu:
+            others = self._waiting_elsewhere(key, engine)
+            if not others:
+                return None
+            group_pri = max(ln.req.spec.priority for ln in lanes)
+            if any(r.spec.priority > group_pri for r in others):
+                return "priority"
+            if budget is not None and chunks_run >= budget:
+                return "slice"
+            deadlines = [d for d in (ln.req.deadline_at for ln in lanes)
+                         if d is not None]
+            if deadlines and all(now >= d for d in deadlines):
+                # every deadline-CARRYING lane blew its wall budget:
+                # the group no longer holds the device against waiting
+                # work (soft — the run continues on a later rotation,
+                # never killed; deadline-less lanes ride the yield and
+                # resume bit-identically)
+                return "deadline"
+        return None
+
+    def _preempt(self, key: str, lanes: list, state, ff_stats,
+                 reason: str):
+        """Yield at a chunk boundary: slice each lane's state out of
+        the batch and re-enqueue its request carrying that state (and
+        its stashed obs carries) — the in-memory twin of the group
+        checkpoint, consumed by `_init_lanes` exactly like a
+        checkpoint restore, so the continuation is bit-identical."""
+        offsets = np.cumsum([0] + [ln.width for ln in lanes])
+        slices = [jax.tree.map(
+            lambda x, lo=int(lo), w=ln.width: x[lo:lo + w], state)
+            for ln, lo in zip(lanes, offsets)]
+        with self._mu:
+            self.resilience["preemptions"] += 1
+            for ln, sl in zip(lanes, slices):
+                req = ln.req
+                req.restored_state = sl
+                req.saved_carries = ln.carries
+                if ff_stats is not None:
+                    acc = req.ff_accum or {"skipped_ms": 0,
+                                           "jump_count": 0}
+                    req.ff_accum = {k: acc[k] + ff_stats[k]
+                                    for k in acc}
+                req.preempted += 1
+                req.status = "queued"
+                self._queue.append(req.id)
+                self._tstat(req.spec.tenant)["preemptions"] += 1
+
     # ------------------------------------------------------------ the run
 
-    def _run_group(self, key: str) -> int:
+    def _run_group(self, key: str,
+                   budget_chunks: int | None = None) -> tuple:
+        """Run one compile-key group until it finishes or yields
+        (`_should_yield`); returns ``(requests_done, chunks_run)``."""
         reqs = self._take_compatible(key)
         if not reqs:
-            return 0
+            return 0, 0
         spec0 = reqs[0].spec
         if spec0.engine != "vmapped" and len(reqs) > 1:
             # lockstep engines (one fused mailbox / one shared jump)
@@ -505,6 +904,7 @@ class Scheduler:
                 r.status, r.started = "running", now
         ff_stats = {"skipped_ms": 0, "jump_count": 0}
         done = 0
+        chunks_run = 0
         # One registry lookup per plane per GROUP (the programs are
         # constant across chunks) — hit/miss counters then reflect
         # warm/cold submits, not chunk counts.
@@ -514,6 +914,7 @@ class Scheduler:
         while lanes:
             entry = state
             widths = [ln.width for ln in lanes]
+            t_chunk = time.time()
             out = self._launch(fn, entry, widths, spec0.engine,
                                primary is not None)
             state = (out[0], out[1])
@@ -567,8 +968,24 @@ class Scheduler:
                     self._save_checkpoint(key, lanes, state)
                 else:
                     self._drop_checkpoint(key)
+            chunks_run += 1
+            # the retry-after estimate's unit cost: an EMA of one
+            # coalesced chunk's wall time (the snapshot above already
+            # synced the device, so this is honest compute time)
+            dt = time.time() - t_chunk
+            self.chunk_wall_ema_s = (dt if not self.chunk_wall_ema_s
+                                     else 0.8 * self.chunk_wall_ema_s
+                                     + 0.2 * dt)
             if self.on_boundary is not None:
                 self.on_boundary()
+            if lanes:
+                reason = self._should_yield(key, lanes, chunks_run,
+                                            budget_chunks)
+                if reason is not None:
+                    self._preempt(key, lanes, state,
+                                  ff_stats if spec0.engine ==
+                                  "fast_forward" else None, reason)
+                    return done, chunks_run
             if admit_inflight:
                 joiners = self._take_compatible(key)
                 if joiners:
@@ -580,7 +997,7 @@ class Scheduler:
                     state = self._concat(
                         ([state] if lanes else []) + new)
                     lanes.extend(_Lane(r) for r in joiners)
-        return done
+        return done, chunks_run
 
     # ------------------------------------------------------- per-request
 
@@ -621,8 +1038,13 @@ class Scheduler:
             "msg_received": int(np.asarray(nodes.msg_received).sum()),
         }
         if ff_stats is not None:
-            art["fast_forward"] = dict(ff_stats)    # group-level skips
+            acc = req.ff_accum or {}
+            art["fast_forward"] = {k: ff_stats[k] + acc.get(k, 0)
+                                   for k in ff_stats}   # group-level
         art["resilience"] = dict(self.resilience)   # scheduler-level
+        art["tenant"] = spec.tenant
+        if req.preempted:
+            art["preempted"] = req.preempted
         line = {"metric": f"serve_{req.id}", "sim_ms": spec.sim_ms,
                 "superstep": spec.superstep, "batch": len(spec.seeds)}
         if req.resumed_from_ms:
@@ -665,11 +1087,29 @@ class Scheduler:
                       f"{report.format()}", file=sys.stderr)
         now = time.time()
         wall = now - (req.started or now)
+        if req.deadline_at is not None and now > req.deadline_at:
+            # observability only — a deadline demotes the request's
+            # hold on the device (_should_yield), it never kills it
+            art["deadline_missed"] = True
         line["wall_total_s"] = round(wall, 3)
+        # durable completion facts ride the ledger row's extra: the
+        # matrix driver's campaign resume / cross-grid dedup rebuilds
+        # a finished cell's report row from them without re-running it
+        durable = {"summary": dict(art["summary"])}
+        if "engine_metrics" in art:
+            from ..obs.export import time_to_done_ms
+            ttd = time_to_done_ms(art["engine_metrics"])
+            if ttd is not None:
+                durable["time_to_done_ms"] = ttd
+        if "audit" in art and not art["audit"]["clean"]:
+            durable["violations"] = {
+                k: v for k, v in art["audit"]["violations"].items() if v}
+        req.ledger_extra = {**(req.ledger_extra or {}), **durable}
         path = self._append_ledger(req, line)
         art["wall_s"] = round(wall, 3)
         art["registry"] = self.registry.stats()
         with self._mu:
+            self._tstat(spec.tenant)["done"] += 1
             req.artifacts = art
             req.final_state = final_state
             req.finished = now

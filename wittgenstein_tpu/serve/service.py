@@ -140,6 +140,12 @@ class Service:
         """GET /w/batch/registry."""
         return self.scheduler.registry.registry_block()
 
+    def tenancy_stats(self) -> dict:
+        """GET /w/batch/tenancy — per-tenant queue depth + lifetime
+        counters (submitted/rejected/done/preemptions), the DRR knobs,
+        and the chunk-wall EMA behind retry-after estimates."""
+        return self.scheduler.tenancy_stats()
+
     # ---------------------------------------------- matrix (sweep grids)
 
     def matrix_submit(self, body: dict) -> dict:

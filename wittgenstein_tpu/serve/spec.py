@@ -47,7 +47,16 @@ import json
 #: folds into BOTH digest and compile_key).  The entry-only
 #: `partition` field (nodes down at entry) keeps its data-only role;
 #: mid-run partition/heal windows live in the schedule.
-SCHEMA = 3
+#: 4 (PR 13): + the tenancy trio `tenant` / `priority` / `deadline_ms`
+#: — pure SCHEDULING metadata (admission control, weighted-fair
+#: queueing, checkpoint-preemption in serve/scheduler.py).  They are
+#: in the digest (two requests with different urgency are different
+#: requests, and the ledger must say so) but NEVER in the compile key:
+#: tenancy must not split the coalesced program — a campaign cell and
+#: an interactive request over the same program share one compiled
+#: chunk (the `PingPong+tenancy` analysis target pins zero compiled
+#: residue).
+SCHEMA = 4
 
 #: routing-kernel selection the registry honors per spec
 #: (ops/pallas_route.py): the fused Pallas binning megakernel or the
@@ -117,6 +126,14 @@ class ScenarioSpec:
     #: [[start, end, pid, lo, hi]], loss/delay windows — mid-run
     #: adversity as data (program-affecting; schema 3)
     fault_schedule: dict | None = None
+    #: --- tenancy trio (schema 4): scheduling metadata, digest-only —
+    #: NEVER in the compile key (tenancy must not split the coalesced
+    #: program; see the SCHEMA note above)
+    tenant: str = "default"      # admission/fairness bucket
+    priority: int = 0            # higher preempts lower at chunk bounds
+    deadline_ms: int | None = None   # wall-clock budget from submit; a
+    #: request past its deadline stops holding the device against
+    #: waiting tenants (soft — never killed, only demoted)
     schema: int = SCHEMA
 
     def __post_init__(self):
@@ -145,6 +162,24 @@ class ScenarioSpec:
             # requester never meant (and mislabel the A/B)
             raise _err(f"unknown route_kernel {self.route_kernel!r}; "
                        f"known: {ROUTE_KERNELS}")
+        # tenancy trio: refused at CONSTRUCTION like route_kernel/obs —
+        # a malformed tenancy field silently coerced would admit a
+        # request under the wrong budget (or digest a config the
+        # requester never meant)
+        if not isinstance(self.tenant, str) or not self.tenant:
+            raise _err(f"tenant must be a non-empty string, got "
+                       f"{self.tenant!r}")
+        if isinstance(self.priority, bool) or \
+                not isinstance(self.priority, int):
+            raise _err(f"priority must be an int (higher preempts "
+                       f"lower), got {self.priority!r}")
+        if self.deadline_ms is not None:
+            if isinstance(self.deadline_ms, bool) or \
+                    not isinstance(self.deadline_ms, int) or \
+                    self.deadline_ms < 1:
+                raise _err(f"deadline_ms must be a positive int of "
+                           f"wall-clock ms from submit (or None), got "
+                           f"{self.deadline_ms!r}")
         if self.fault_schedule is not None:
             # normalize through the schedule's own canonical form so
             # equal adversity always digests equal (key order, empty
@@ -231,6 +266,10 @@ class ScenarioSpec:
             # (window-entry fault application + outbox adversaries), so
             # two specs differing only in adversity must never coalesce
             "fault_schedule": spec.fault_schedule,
+            # tenant/priority/deadline_ms are DELIBERATELY absent:
+            # tenancy is scheduling metadata, and splitting the compile
+            # key on it would un-coalesce programs that are identical
+            # on device (schema-4 note at the top of this module)
         })
 
     # ---------------------------------------------------------- validation

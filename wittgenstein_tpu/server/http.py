@@ -25,11 +25,16 @@ Batch request plane (wittgenstein_tpu/serve — README "Simulation as a
 service"; spec schema in serve/spec.py):
 
     POST /w/batch/submit                   body: ScenarioSpec JSON ->
-                                           {"id", "status", "compile_key"}
+                                           {"id", "status", "compile_key"};
+                                           an over-budget tenant gets 429
+                                           + Retry-After (+ retry_after_s
+                                           in the body) instead of an
+                                           unbounded queue
     GET  /w/batch/status/{id}              lifecycle + streaming progress
     GET  /w/batch/result/{id}              artifacts when done
     POST /w/batch/run                      manual queue drain
     GET  /w/batch/registry                 compile-registry hit/miss
+    GET  /w/batch/tenancy                  per-tenant queue/fairness stats
 
 Matrix plane (wittgenstein_tpu/matrix — README "Scenario matrix";
 grid schema in matrix/grid.py):
@@ -129,6 +134,8 @@ class _Handler(BaseHTTPRequestHandler):
          lambda s, m, b: s.batch.run_pending()),
         ("GET", r"^/w/batch/registry$",
          lambda s, m, b: s.batch.registry_stats()),
+        ("GET", r"^/w/batch/tenancy$",
+         lambda s, m, b: s.batch.tenancy_stats()),
         # ---- matrix plane (wittgenstein_tpu/matrix): a whole sweep
         # grid as one request — planned at submit (400 names the bad
         # cell), driven on the batch scheduler, reported as ONE
@@ -152,6 +159,7 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/result/([A-Za-z0-9_-]+)$",
         r"^/w/batch/run$",
         r"^/w/batch/registry$",
+        r"^/w/batch/tenancy$",
         r"^/w/matrix/submit$",
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
         r"^/w/matrix/report/([A-Za-z0-9_-]+)$",
@@ -197,18 +205,32 @@ class _Handler(BaseHTTPRequestHandler):
                 with lock:
                     try:
                         result = fn(self, m, body)
-                    except Exception as e:  # surface as a 400, like Spring
-                        self._reply(400, {"error": str(e)})
+                    except Exception as e:  # surface as a 400, like
+                        # Spring — except admission refusals, which
+                        # carry their own status (429) + retry-after so
+                        # a well-behaved client backs off instead of
+                        # hammering a full queue (serve AdmissionError)
+                        status = getattr(e, "http_status", 400)
+                        payload = {"error": str(e)}
+                        headers = None
+                        retry = getattr(e, "retry_after_s", None)
+                        if retry is not None:
+                            payload["retry_after_s"] = retry
+                            headers = {"Retry-After":
+                                       str(max(1, round(retry)))}
+                        self._reply(status, payload, headers)
                         return
                 self._reply(200, result if result is not None else {"ok": 1})
                 return
         self._reply(404, {"error": f"no route {method} {self.path}"})
 
-    def _reply(self, status, payload):
+    def _reply(self, status, payload, headers=None):
         data = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -225,17 +247,20 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def make_server(port: int = 0,
-                batch_auto: bool = True) -> ThreadingHTTPServer:
+def make_server(port: int = 0, batch_auto: bool = True,
+                scheduler=None) -> ThreadingHTTPServer:
     """`batch_auto=False` gives a manual-drain batch service (POST
     /w/batch/run runs the queue) — deterministic for tests; the default
-    drains on a background worker so submits return immediately."""
+    drains on a background worker so submits return immediately.
+    `scheduler` lets an operator serve a pre-configured
+    `serve.Scheduler` (tenancy policies, checkpoint_dir, ledger path)
+    behind the same routes."""
     from ..serve import Service
 
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     httpd.sim_server = core.Server()
     httpd.sim_lock = threading.Lock()
-    httpd.batch_service = Service(auto=batch_auto)
+    httpd.batch_service = Service(scheduler=scheduler, auto=batch_auto)
     return httpd
 
 

@@ -40,6 +40,43 @@ def peek_meta(path: str) -> dict:
             if "__meta__" in z else {}
 
 
+def stale_meta_problems(meta: dict) -> list:
+    """Staleness audit of a serve GROUP-checkpoint metadata dict
+    (serve/scheduler.py writes them; schema 2 records each request's
+    spec digest).  Returns human-readable problem strings — empty
+    means the file is internally consistent and safe to restore.  The
+    scheduler's `resume_checkpoints` and the matrix driver's campaign
+    resume share this one definition, so "stale" can never mean two
+    different things on the two resume paths.
+
+    Checks: meta schema (an older tree's file lacks the digests this
+    gate needs — refusing beats guessing), and that every stored spec
+    STILL digests to its recorded `spec_digest` (a hand-edited or
+    torn file would otherwise restore a trajectory its spec never
+    produced)."""
+    from ..serve.spec import ScenarioSpec
+
+    schema = meta.get("schema")
+    if schema != 2:
+        return [f"checkpoint meta schema {schema!r} != 2 — written by "
+                "a different tree, so its specs cannot be verified"]
+    problems = []
+    for rm in meta.get("requests", ()):
+        want = rm.get("spec_digest")
+        try:
+            got = ScenarioSpec.from_json(rm["spec"]).digest()
+        except (ValueError, KeyError, TypeError) as e:
+            problems.append(f"request {rm.get('id')!r}: stored spec "
+                            f"no longer parses ({e})")
+            continue
+        if got != want:
+            problems.append(
+                f"request {rm.get('id')!r}: stored spec digests to "
+                f"{got} but the checkpoint recorded {want} — the spec "
+                "was edited after this checkpoint was written")
+    return problems
+
+
 def load(path: str, protocol, seed=0):
     """Restore (net, pstate, meta).  `protocol` must be constructed with
     the same parameters as at save time — its `init` supplies the pytree
