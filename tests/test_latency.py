@@ -335,3 +335,106 @@ def test_latency_floor_tick_scaled_and_mathis():
         def extended(self, nodes, src, dst, delta):
             return jnp.full_like(delta, 99)
     assert latency_floor_ms(Custom()) == 1
+
+
+# ------------------------------------------------- CSV measured matrix
+
+
+def _write_csv(path, text):
+    path.write_text(text)
+    return str(path)
+
+
+CSV_OK = ("city,Alpha,Beta,Gamma\n"
+          "Alpha,10,42,80\n"
+          "Beta,44,8,120\n"          # asymmetric on purpose: B->A != A->B
+          "Gamma,78,118,6\n")
+
+
+def test_csv_latency_model_roundtrip(tmp_path):
+    """The reference's CSVLatencyReader beyond the vendored matrix:
+    measured per-city-pair RTTs from a user file, halved one-way,
+    asymmetric links kept, provable exhaustive floor."""
+    from wittgenstein_tpu.core.latency import NetworkCSVLatency
+
+    path = _write_csv(tmp_path / "m.csv", CSV_OK)
+    m = get_by_name(f"NetworkCSVLatency({path})")
+    assert isinstance(m, NetworkCSVLatency)
+    assert m.cities == ("Alpha", "Beta", "Gamma")
+    nodes = builders.NodeBuilder().build(0, 6)
+    nodes = nodes.replace(city=jnp.asarray([0, 1, 2, 0, 1, 2],
+                                           jnp.int32))
+    src = jnp.asarray([0, 1, 3], jnp.int32)
+    dst = jnp.asarray([1, 0, 5], jnp.int32)
+    delta = jnp.zeros(3, jnp.int32)
+    lat = np.asarray(full_latency(m, nodes, src, dst, delta))
+    assert lat[0] == 21                 # round(42 / 2)
+    assert lat[1] == 22                 # round(44 / 2) — asymmetric
+    assert lat[2] == 40                 # round(80 / 2)
+    # the floor is the exhaustive min THROUGH the rounding expression,
+    # diagonal included (distinct nodes share a city)
+    assert m.latency_floor_ms() == 3    # round(6 / 2) on the diagonal
+    # city-range validation refuses unmapped nodes loudly
+    with pytest.raises(ValueError, match="city-positioned"):
+        m.validate(nodes.replace(city=nodes.city.at[0].set(-1)))
+    with pytest.raises(ValueError, match="covers 3 cities"):
+        m.validate(nodes.replace(city=nodes.city.at[0].set(7)))
+
+
+def test_csv_latency_refuses_with_remedy(tmp_path):
+    """The spec 400 path: a missing or malformed file refuses at
+    CONSTRUCTION with remedy text, so `ScenarioSpec.validate` surfaces
+    it before anything compiles."""
+    with pytest.raises(ValueError, match="no CSV at"):
+        get_by_name(f"NetworkCSVLatency({tmp_path}/nope.csv)")
+    bad_arity = _write_csv(tmp_path / "a.csv",
+                           "city,Alpha,Beta\nAlpha,10\nBeta,44,8\n")
+    with pytest.raises(ValueError, match="expected a city name"):
+        get_by_name(f"NetworkCSVLatency({bad_arity})")
+    bad_num = _write_csv(tmp_path / "n.csv",
+                         "city,Alpha,Beta\nAlpha,10,x\nBeta,44,8\n")
+    with pytest.raises(ValueError, match="not a number"):
+        get_by_name(f"NetworkCSVLatency({bad_num})")
+    bad_neg = _write_csv(tmp_path / "g.csv",
+                         "city,Alpha,Beta\nAlpha,10,-4\nBeta,44,8\n")
+    with pytest.raises(ValueError, match="must be >= 0"):
+        get_by_name(f"NetworkCSVLatency({bad_neg})")
+    bad_names = _write_csv(tmp_path / "o.csv",
+                           "city,Alpha,Beta\nBeta,10,4\nAlpha,44,8\n")
+    with pytest.raises(ValueError, match="do not match the header"):
+        get_by_name(f"NetworkCSVLatency({bad_names})")
+    empty = _write_csv(tmp_path / "e.csv", "city,Alpha,Beta\n")
+    with pytest.raises(ValueError, match="holds no matrix"):
+        get_by_name(f"NetworkCSVLatency({empty})")
+    # the ScenarioSpec boundary wraps the same refusal as its 400
+    from wittgenstein_tpu.serve.spec import ScenarioSpec
+    spec = ScenarioSpec(protocol="PingPong",
+                        params={"node_count": 16},
+                        latency_model=f"NetworkCSVLatency("
+                                      f"{tmp_path}/nope.csv)",
+                        sim_ms=40, chunk_ms=40)
+    with pytest.raises(ValueError, match="unknown latency_model.*no "
+                                         "CSV at"):
+        spec.validate()
+
+
+def test_csv_latency_floor_is_sound(tmp_path):
+    """The latency-floor contract, CSV edition: sampled distinct-pair
+    latencies never undercut the claimed floor."""
+    from wittgenstein_tpu.core.latency import latency_floor_ms
+    from wittgenstein_tpu.ops import prng
+
+    path = _write_csv(tmp_path / "m.csv", CSV_OK)
+    m = get_by_name(f"NetworkCSVLatency({path})")
+    floor = latency_floor_ms(m)
+    nodes = builders.NodeBuilder().build(1, 64)
+    nodes = nodes.replace(
+        city=(jnp.arange(64, dtype=jnp.int32) % 3))
+    ids = jnp.arange(4096, dtype=jnp.int32)
+    s = prng.hash2(jnp.int32(1), jnp.int32(0xC511))
+    src = prng.uniform_int(prng.hash2(s, 1), ids, 64)
+    dst = prng.uniform_int(prng.hash2(s, 2), ids, 64)
+    delta = prng.uniform_delta(prng.hash2(s, 3), ids)
+    lat = np.asarray(full_latency(m, nodes, src, dst, delta))
+    keep = np.asarray(src != dst)
+    assert lat[keep].min() >= floor

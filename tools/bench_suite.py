@@ -8,7 +8,10 @@ Configs (BASELINE.md "Tracked configs"):
   * Dfinity 10k validators (10 BPs + 10,000 attesters, rotating
     100-attester committees)
 plus smoke stages: trace_smoke (PR 5), audit_smoke (PR 6), serve_smoke
-(PR 7 — 2 coalesced requests through the in-process request plane).
+(PR 7 — 2 coalesced requests through the in-process request plane),
+chaos_smoke (PR 10), matrix_smoke (PR 12), tenancy_smoke (PR 13) and
+memo_smoke (PR 14 — snapshot-fork prefix sharing bit-identical to the
+unmemoized run, prefix_chunks_saved == the fork plan's prediction).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
@@ -579,6 +582,99 @@ def bench_tenancy_smoke():
             "platform": jax.default_backend()}
 
 
+#: the memo_smoke stage's grid — module-level like MATRIX_SMOKE_GRID
+#: (a consumer of its digest can never drift from the stage): a
+#: chaos-axis sweep whose clean/loss cells share a 3-chunk honest
+#: prefix per seed -> 2 fork groups, predicted prefix_chunks_saved =
+#: 2 groups x 1 extra cell x 3 chunks = 6
+MEMO_SMOKE_GRID = {
+    "name": "memo_smoke",
+    "base": {"protocol": "PingPong", "params": {"node_count": 64},
+             "latency_model": "NetworkFixedLatency(10)",
+             "seeds": [0], "sim_ms": 240, "chunk_ms": 40,
+             "obs": ["metrics", "audit"]},
+    "axes": [
+        {"name": "seed", "field": "seeds", "values": [[0], [1]]},
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [None, {"loss": [[120, 240, 400, 0, 64, 0, 64]]}],
+         "labels": ["clean", "loss"]},
+    ],
+}
+
+#: report keys that honestly differ between a memoized and an
+#: unmemoized run of the SAME grid (wall clock, measured builds,
+#: scheduler counters, the memo/fork provenance itself) — everything
+#: else must be bit-identical, which is the stage's acceptance pin
+MEMO_VOLATILE_KEYS = ("wall_s", "program_builds", "registry",
+                      "resilience", "resume", "memo")
+
+
+def _memo_norm_report(rep: dict) -> dict:
+    import copy
+    d = copy.deepcopy(rep)
+    for k in MEMO_VOLATILE_KEYS:
+        d.pop(k, None)
+    for row in d["cells"]:
+        row.pop("forked_from", None)
+    return d
+
+
+def bench_memo_smoke():
+    """Memoized-supersteps smoke stage (PR 14): a small chaos-axis
+    grid whose cells share an honest prefix runs twice — once plain,
+    once with `run_grid(memo=True)` — and the stage asserts the memo
+    contract end to end in seconds: `prefix_chunks_saved` > 0 AND
+    equal to the fork plan's prediction, every forked cell's final
+    pytree bit-identical to the unmemoized run's, the two
+    `MatrixReport`s bit-identical outside the honestly-run-local
+    keys (MEMO_VOLATILE_KEYS), and forked ledger rows carrying
+    `forked_from` provenance."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import SweepGrid, plan, run_grid
+    from wittgenstein_tpu.memo import plan_prefixes
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.serve import Scheduler
+
+    grid = SweepGrid.from_json(MEMO_SMOKE_GRID)
+    mplan = plan(grid)
+    predicted = plan_prefixes(mplan).predicted_chunks_saved
+    assert predicted == 6, predicted
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = run_grid(grid, Scheduler(
+            ledger_path=os.path.join(tmp, "ref.jsonl")), plan_=mplan)
+        mem = run_grid(grid, Scheduler(
+            ledger_path=os.path.join(tmp, "memo.jsonl")), plan_=mplan,
+            memo=True)
+        blk = mem.report.data["memo"]
+        assert blk["prefix_chunks_saved"] == predicted > 0, blk
+        assert blk["forked_cells"] == 4 and blk["fork_vetoed"] == 0, blk
+        assert _memo_norm_report(mem.report.to_json()) == \
+            _memo_norm_report(ref.report.to_json()), \
+            "memoized report differs from the unmemoized run"
+        for cid, st in mem.states.items():
+            for a, b in zip(jax.tree.leaves(st),
+                            jax.tree.leaves(ref.states[cid])):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b),
+                                              err_msg=cid)
+        rows = ledger.read_all(os.path.join(tmp, "memo.jsonl"))
+        forked = [r for r in rows
+                  if (r.extra or {}).get("forked_from")]
+        assert len(forked) == 4, [r.run for r in rows]
+        assert all(r.extra["forked_from"]["fork_ms"] == 120
+                   for r in forked)
+    return {"metric": "memo_smoke_prefix_chunks_saved",
+            "value": blk["prefix_chunks_saved"], "unit": "chunks",
+            "memo": blk, "grid_digest": grid.grid_digest(),
+            "cells": len(mplan.cells),
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -590,6 +686,7 @@ CONFIGS = {
     "chaos_smoke": bench_chaos_smoke,
     "matrix_smoke": bench_matrix_smoke,
     "tenancy_smoke": bench_tenancy_smoke,
+    "memo_smoke": bench_memo_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -600,7 +697,8 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "serve_smoke": "serve_smoke_requests",
                 "chaos_smoke": "chaos_smoke_lost_msgs",
                 "matrix_smoke": "matrix_smoke_cells",
-                "tenancy_smoke": "tenancy_smoke_requests"}
+                "tenancy_smoke": "tenancy_smoke_requests",
+                "memo_smoke": "memo_smoke_prefix_chunks_saved"}
 
 
 def _stage_spec(name):
@@ -671,6 +769,15 @@ def _stage_spec(name):
             protocol="PingPong", params={"node_count": 64}, seeds=(0,),
             sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
             superstep=1, tenant="campaign"),
+        # the stage runs a whole grid twice; the digested config is
+        # the grid's BASE cell (the matrix_smoke convention would be a
+        # grid digest, but the ledger's config digest is a spec digest
+        # — the base cell is the honest one-spec record)
+        "memo_smoke": dict(
+            protocol="PingPong", params={"node_count": 64},
+            latency_model="NetworkFixedLatency(10)", seeds=(0,),
+            sim_ms=240, chunk_ms=40, obs=("metrics", "audit"),
+            superstep=1),
     }
     cfg = table.get(name)
     if cfg is None:

@@ -75,6 +75,17 @@ def main(argv=None) -> int:
                          "other grids dedup too), and re-run only the "
                          "unfinished cells.  Stale/mismatched "
                          "checkpoints refuse loudly (exit 2)")
+    ap.add_argument("--memo", action="store_true",
+                    help="memoized supersteps (wittgenstein_tpu/memo): "
+                         "cells differing only in post-fork adversity "
+                         "share ONE honest-prefix run and fork from "
+                         "its checkpoint — bit-identical, and "
+                         "spot-checks verify forked cells like any "
+                         "other (their rows carry forked_from)")
+    ap.add_argument("--memo-table", default=None, metavar="DIR",
+                    help="cross-run memo table directory (implies "
+                         "--memo): completed prefixes are reused "
+                         "across campaign invocations")
     ap.add_argument("--max-wave", type=int, default=64,
                     help="max cells per coalesced launch wave "
                          "(default 64)")
@@ -121,12 +132,15 @@ def main(argv=None) -> int:
         print("config error: --resume needs --checkpoint-dir (the "
               "interrupted run's checkpoint directory)", file=sys.stderr)
         return 2
+    memo = None
+    if args.memo or args.memo_table:
+        memo = {"table": args.memo_table} if args.memo_table else True
     sch = Scheduler(ledger_path=args.ledger,
                     checkpoint_dir=args.checkpoint_dir)
     try:
         run = run_grid(grid, sch, plan_=mplan, max_wave=args.max_wave,
                        keep_states=tuple(spot), progress=progress,
-                       resume=args.resume)
+                       resume=args.resume, memo=memo)
     except ValueError as e:
         # ONLY the resume staleness refusals are config errors; a
         # ValueError from a plain campaign is an internal failure and
@@ -163,15 +177,21 @@ def main(argv=None) -> int:
             continue
         mism = verify_cell(mplan.resolved[cid], run.states[cid],
                            run.artifacts[cid])
+        # a forked cell verifies like any other — its final state and
+        # stitched blocks are compared against the same sequential
+        # twin, with the fork provenance named instead of skipped
+        fk = row.get("forked_from")
+        how = (f" (forked from prefix {fk['prefix_digest']} @ "
+               f"{fk['fork_ms']} ms)") if fk else ""
         if mism:
             print(f"spot check {cid}: DIVERGENCE vs the sequential "
-                  "Runner reference:")
+                  f"Runner reference{how}:")
             for m in mism:
                 print(f"  {m}")
             rc = 1
         else:
             print(f"spot check {cid}: bit-identical to the sequential "
-                  "Runner reference (full pytree + obs blocks)")
+                  f"Runner reference (full pytree + obs blocks){how}")
     if rc == 0:
         print("CLEAN: all cells done, audits clean"
               + (", spot checks bit-identical" if spot else ""))

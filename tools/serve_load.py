@@ -25,8 +25,12 @@ Tenant mix (weights/budgets exercise every tenancy mechanism):
   batch       — weight 2, unbounded, mixed spans.
 
 Usage: python tools/serve_load.py [--requests N] [--out PATH]
+       [--stream]
        (default 120 requests; --out writes the JSON line to a file
-       as well as stdout)
+       as well as stdout; --stream adds the long-poll partial-metrics
+       smoke check: one spec streamed boundary by boundary over
+       `/w/batch/stream`-equivalent `Service.stream`, asserting one
+       delta per chunk)
 """
 
 from __future__ import annotations
@@ -108,6 +112,37 @@ def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50):
             rec["errors"] += 1
 
 
+def stream_smoke(svc) -> dict:
+    """The --stream check: submit one multi-chunk spec to the
+    auto-draining service and LONG-POLL its per-chunk totals until
+    eof; a healthy stream yields exactly sim_ms/chunk_ms boundary
+    entries with monotone times and per-chunk deltas.  Returns the
+    JSON block (``ok`` False on any shortfall)."""
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": 64},
+                        seeds=(0,), sim_ms=160, chunk_ms=40,
+                        obs=("metrics",), tenant="stream")
+    rid = svc.submit(spec.to_json())["id"]
+    chunks, polls = [], 0
+    after = None
+    t0 = time.perf_counter()
+    while True:
+        out = svc.stream(rid, after_ms=after, timeout_s=10.0)
+        polls += 1
+        chunks += out["chunks"]
+        after = out["next_after_ms"]
+        if out["eof"] or polls > 64:
+            break
+    wall = time.perf_counter() - t0
+    times = [c["t_ms"] for c in chunks]
+    want = spec.sim_ms // spec.chunk_ms
+    ok = (times == sorted(set(times)) and len(chunks) == want
+          and all("delta" in c and "totals" in c for c in chunks)
+          and out["eof"])
+    return {"ok": ok, "chunks": len(chunks), "expected": want,
+            "polls": polls, "wall_s": round(wall, 3),
+            "final_totals": chunks[-1]["totals"] if chunks else None}
+
+
 def pct(sorted_vals, q):
     """Upper nearest-rank percentile (ceil, not floor: a floored p99
     over ~100 samples would read ~p98 and hide the one true tail
@@ -129,6 +164,9 @@ def main(argv=None) -> int:
                          "(default 120)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write the JSON line here")
+    ap.add_argument("--stream", action="store_true",
+                    help="add the long-poll partial-metrics smoke "
+                         "check (one spec streamed chunk by chunk)")
     args = ap.parse_args(argv)
 
     per = max(1, args.requests // 3)
@@ -152,6 +190,7 @@ def main(argv=None) -> int:
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    stream_block = stream_smoke(svc) if args.stream else None
     svc.close()
 
     ten = svc.tenancy_stats()
@@ -190,10 +229,15 @@ def main(argv=None) -> int:
         "registry": reg,
         "platform": jax.default_backend(),
     }
+    if stream_block is not None:
+        out["stream"] = stream_block
     line = json.dumps(out)
     print(line)
     if args.out:
         pathlib.Path(args.out).write_text(line + "\n")
+    if stream_block is not None and not stream_block["ok"]:
+        print(f"STREAM smoke failed: {stream_block}", file=sys.stderr)
+        return 1
     if starved:
         print(f"STARVATION: tenant(s) {starved} did not complete their "
               "requests", file=sys.stderr)

@@ -476,6 +476,57 @@ def _tenancy_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: The memo build (PR 14 — wittgenstein_tpu/memo) audited under
+#: "<name>+memo": the honest-prefix program a snapshot-fork campaign
+#: runs, compiled through the same grid/spec path.  Memoization is
+#: entirely HOST-side (prefix planning, state forks, lane freezing in
+#: the scheduler): the zero-cost rules (carry_extra_leaves=0,
+#: transfer_ops=0) prove that with memo OFF — and equally with it on —
+#: the compiled chunk program carries NO memo residue, and the build
+#: asserts the memo contract's two static halves: stripping post-fork
+#: adversity lands exactly on the clean sibling's compile key, and the
+#: adversity start the planner forks before is the schedule's first
+#: window.
+MEMO_PROTOCOLS = ("PingPong",)
+MEMO_SUFFIX = "+memo"
+
+
+def _memo_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(MEMO_SUFFIX)]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import scan_chunk
+        from ..memo import first_adversity_ms, strip_adversity
+        from ..serve.spec import ScenarioSpec
+
+        adverse = ScenarioSpec(
+            protocol=base_name, params={"node_count": 64},
+            seeds=(0,), sim_ms=2 * chunk, chunk_ms=chunk, obs=(),
+            fault_schedule={"loss": [[chunk, 2 * chunk, 500,
+                                      0, 64, 0, 64]]}).validate()
+        clean = ScenarioSpec(
+            protocol=base_name, params={"node_count": 64},
+            seeds=(0,), sim_ms=2 * chunk, chunk_ms=chunk,
+            obs=()).validate()
+        prefix = strip_adversity(adverse)
+        assert prefix.compile_key() == clean.compile_key(), \
+            "stripping post-fork adversity must land on the clean " \
+            "sibling's compile key (the fork-group sharing contract)"
+        assert first_adversity_ms(adverse) == chunk
+        proto = prefix.build_protocol()
+        base = jax.vmap(scan_chunk(proto, chunk,
+                                   superstep=prefix.superstep))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+memo"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: Superstep-K targets (PR 4): the fused K-ms window engine
 #: (core/network.step_kms / batched twin) compiled at a pinned K on a
 #: floor-rich latency model, so the `superstep_amortization` budgets pin
@@ -677,6 +728,7 @@ def target_names() -> tuple:
                  sorted(f"{n}{MATRIX_SUFFIX}" for n in MATRIX_PROTOCOLS) +
                  sorted(f"{n}{TENANCY_SUFFIX}"
                         for n in TENANCY_PROTOCOLS) +
+                 sorted(f"{n}{MEMO_SUFFIX}" for n in MEMO_PROTOCOLS) +
                  sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
@@ -701,6 +753,12 @@ def get_target(name: str) -> AnalysisTarget:
                 f"unknown tenancy target {name!r}; known: "
                 f"{sorted(f'{n}{TENANCY_SUFFIX}' for n in TENANCY_PROTOCOLS)}")
         return _tenancy_target(name)
+    if name.endswith(MEMO_SUFFIX):
+        if name[:-len(MEMO_SUFFIX)] not in MEMO_PROTOCOLS:
+            raise KeyError(
+                f"unknown memo target {name!r}; known: "
+                f"{sorted(f'{n}{MEMO_SUFFIX}' for n in MEMO_PROTOCOLS)}")
+        return _memo_target(name)
     if name.endswith(CHAOS_SUFFIX):
         if name[:-len(CHAOS_SUFFIX)] not in CHAOS_PROTOCOLS:
             raise KeyError(

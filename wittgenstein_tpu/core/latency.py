@@ -382,6 +382,104 @@ class NetworkHeterogeneousLatency:
         return self.name
 
 
+class NetworkCSVLatency(NetworkLatencyByCity):
+    """Measured per-city-pair latency loaded from a CSV file — the
+    reference's `CSVLatencyReader` beyond the vendored `citydata.npz`
+    (ROADMAP item 2): bring your own ping matrix.
+
+    CSV shape: a header row naming the cities (an optional leading
+    label cell is ignored), then one row per source city — its name
+    followed by the measured RTT in ms to each destination city, in
+    header order.  The matrix may be ASYMMETRIC (A->B != B->A is real
+    geography) and the diagonal is the intra-city RTT.  Only the
+    MATRIX differs from the vendored model: `extended` (halved RTT,
+    floored at 1 ms) and the exhaustive `latency_floor_ms` are
+    inherited from `NetworkLatencyByCity`, so swapping the vendored
+    matrix for a measured file changes DATA, not semantics.  Node
+    ``city`` indexes the header order.
+
+    A missing or malformed file refuses at CONSTRUCTION with remedy
+    text: `ScenarioSpec.validate` routes latency names through
+    `get_by_name`, so a bad path surfaces as the request plane's 400,
+    never as a mid-campaign crash."""
+
+    def __init__(self, path: str):
+        import csv
+        import os
+
+        self.path = str(path)
+        self.name = f"NetworkCSVLatency({self.path})"
+        if not os.path.isfile(self.path):
+            raise ValueError(
+                f"NetworkCSVLatency: no CSV at {self.path!r}. Fix: "
+                "point the name at a readable file of the form "
+                "'city,CityA,CityB,...' header + one 'CityA,rtt,...' "
+                "row per city (RTT in ms)")
+        with open(self.path, newline="") as f:
+            rows = [r for r in csv.reader(f)
+                    if r and any(c.strip() for c in r)]
+        if len(rows) < 2:
+            raise ValueError(
+                f"NetworkCSVLatency: {self.path!r} holds no matrix "
+                "(need a header row + at least one city row)")
+        header = [c.strip() for c in rows[0]]
+        # an optional leading label cell ("city", "", ...) is ignored
+        # when the data rows carry one leading name cell
+        cities = header[1:] if len(header) == len(rows[1]) else header
+        n = len(cities)
+        if n < 1 or len(set(cities)) != n:
+            raise ValueError(
+                f"NetworkCSVLatency: {self.path!r} header names "
+                f"{cities!r} are empty or duplicated — one distinct "
+                "city per column")
+        mat = np.zeros((n, n), np.int32)
+        names = []
+        for i, row in enumerate(rows[1:]):
+            cells = [c.strip() for c in row]
+            if len(cells) != n + 1:
+                raise ValueError(
+                    f"NetworkCSVLatency: {self.path!r} row {i + 1} has "
+                    f"{len(cells)} cell(s); expected a city name + "
+                    f"{n} RTT values (header order: {cities})")
+            names.append(cells[0])
+            for j, cell in enumerate(cells[1:]):
+                try:
+                    val = float(cell)
+                except ValueError:
+                    raise ValueError(
+                        f"NetworkCSVLatency: {self.path!r} row "
+                        f"{i + 1} column {cities[j]!r}: {cell!r} is "
+                        "not a number (RTT in ms)") from None
+                if val < 0:
+                    raise ValueError(
+                        f"NetworkCSVLatency: {self.path!r} row "
+                        f"{i + 1} column {cities[j]!r}: RTT {val} "
+                        "must be >= 0 ms")
+                mat[i, j] = np.int32(round(val))
+        if len(names) != n or [x.lower() for x in names] != \
+                [x.lower() for x in cities]:
+            raise ValueError(
+                f"NetworkCSVLatency: {self.path!r} row names {names} "
+                f"do not match the header {cities} in order — the "
+                "matrix must be square over one city list")
+        self.cities = tuple(cities)
+        self.rtt = jnp.asarray(mat)     # (deliberately NOT the parent
+        # __init__: the matrix comes from the file, not core/geo)
+
+    def validate(self, nodes):
+        city = np.asarray(nodes.city)
+        if np.any(city < 0):
+            raise ValueError(
+                f"{self.name} needs city-positioned nodes "
+                "(NodeBuilder(location='cities')); got city == -1 "
+                "nodes")
+        if np.any(city >= len(self.cities)):
+            raise ValueError(
+                f"{self.name} covers {len(self.cities)} cities but "
+                f"nodes reference city id {int(city.max())} — the CSV "
+                "must name every city the node builder assigns")
+
+
 def latency_name(kind: str, fixed: int) -> str:
     """Reference-compatible registry names (RegistryNetworkLatencies.name,
     RegistryNetworkLatencies.java:17-26): 'NetworkFixedLatency(100)' etc."""
@@ -397,21 +495,30 @@ _PARAM_MODELS = {
     "NetworkHeterogeneousLatency": NetworkHeterogeneousLatency,
 }
 
+#: parametrised constructors taking one RAW STRING argument (a path)
+_PATH_MODELS = {
+    "NetworkCSVLatency": NetworkCSVLatency,
+}
+
 
 def get_by_name(name: str | None):
     """String-keyed latency lookup (RegistryNetworkLatencies.getByName,
-    :34-59): parametrised ``Class(int[,int...])`` names, then a
+    :34-59): parametrised ``Class(int[,int...])`` names (plus the
+    string-argument ``NetworkCSVLatency(path.csv)``), then a
     by-class-simple-name fallback; None falls back to
-    NetworkLatencyByDistanceWJitter.  A malformed parameter list is a
-    ValueError with the expected form — the request plane's 400."""
+    NetworkLatencyByDistanceWJitter.  A malformed parameter list — or
+    a missing/malformed CSV — is a ValueError with the expected form:
+    the request plane's 400."""
     if not name:
         return NetworkLatencyByDistanceWJitter()
     if "(" in name and name.endswith(")"):
         cls, arg = name[:-1].split("(", 1)
+        if cls in _PATH_MODELS:
+            return _PATH_MODELS[cls](arg.strip())
         ctor = _PARAM_MODELS.get(cls)
         if ctor is None:
             raise KeyError(f"unknown parametrised latency {name!r}; "
-                           f"known: {sorted(_PARAM_MODELS)}")
+                           f"known: {sorted(_PARAM_MODELS) + sorted(_PATH_MODELS)}")
         try:
             args = [int(x) for x in arg.split(",")] if arg.strip() else []
             return ctor(*args)

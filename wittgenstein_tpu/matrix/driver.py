@@ -107,6 +107,10 @@ def _row_artifacts(row) -> dict:
                         "violations": dict(ex.get("violations", {}))}
     if ex.get("time_to_done_ms") is not None:
         art["time_to_done_ms"] = int(ex["time_to_done_ms"])
+    if ex.get("forked_from"):
+        # fork provenance survives the ledger round trip, so a resumed
+        # campaign's report rows stay identical to the live run's
+        art["forked_from"] = dict(ex["forked_from"])
     return art
 
 
@@ -126,6 +130,27 @@ def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
 
     cells_by_id = {c.id: c for c in plan_.cells}
     rids = sch.resume_checkpoints()
+    # mid-flight MEMO PREFIX checkpoints are withdrawn, not resumed:
+    # the killed process took the prefix's pre-crash obs carries with
+    # it, and a prefix resumed without them could not stitch full-span
+    # artifacts for its forked cells — the prefix re-runs (or table-
+    # hits) instead, which is cheap relative to the campaign it saves
+    prefix_rids = [rid for rid in rids
+                   if (sch.request(rid).ledger_extra or {}
+                       ).get("memo_prefix")]
+    if prefix_rids:
+        drop_keys = set()
+        for rid in prefix_rids:
+            req = sch.request(rid)
+            if (req.ledger_extra or {}).get("grid_digest") \
+                    == plan_.grid_digest:
+                # only this grid's prefix files are discarded — a
+                # foreign campaign's checkpoint stays for ITS resume
+                drop_keys.add(req.compile_key)
+        sch.withdraw(prefix_rids)
+        for key in drop_keys:
+            sch.discard_checkpoint(key)
+        rids = [rid for rid in rids if rid not in set(prefix_rids)]
     pre = []
     try:
         for rid in rids:
@@ -190,12 +215,96 @@ def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
     return served, pre, counts
 
 
+def _run_prefixes(sch: Scheduler, plan_: MatrixPlan, fplan, table,
+                  stats: dict, max_wave: int) -> dict:
+    """The memo fork phase: run (or table-load) every fork group's
+    honest prefix ONCE through the scheduler, then hand each cell its
+    `ForkState` (state + obs carries + fork point + prefix digest).
+    Prefix requests coalesce among themselves like any other same-key
+    submissions; waves bound how many finished prefix states sit in
+    the scheduler's done table at once (its keep_done eviction must
+    never race the harvest).  Returns ``{cell id: ForkState}``."""
+    forks: dict = {}
+    run_groups = []
+    for fg in fplan.groups:
+        chunk = fg.prefix_spec.chunk_ms
+        if table is not None:
+            hit = table.get(fg.prefix_spec)
+            if hit is not None:
+                state, carries = hit
+                stats["table_hits"] += 1
+                served = _assign_forks(forks, fg, plan_, state, carries,
+                                       stats)
+                stats["prefix_chunks_saved"] += \
+                    served * (fg.fork_ms // chunk)
+                continue
+        run_groups.append(fg)
+    for lo in range(0, len(run_groups), max_wave):
+        wave = run_groups[lo:lo + max_wave]
+        pending = []
+        for fg in wave:
+            rid = sch.submit(
+                fg.prefix_spec,
+                label=f"memo:prefix:{fg.prefix_digest[:8]}",
+                ledger_extra={"grid_digest": plan_.grid_digest,
+                              "memo_prefix": fg.prefix_digest},
+                keep_carries=True)
+            pending.append((fg, rid))
+        _drain(sch, [rid for _, rid in pending])
+        for fg, rid in pending:
+            try:
+                req = sch.request(rid)
+            except KeyError:
+                req = None
+            if req is None or req.status != "done":
+                stats["prefix_failed"] += 1
+                continue        # cells fall back to the unforked path
+            stats["prefix_runs"] += 1
+            chunk = fg.prefix_spec.chunk_ms
+            state, carries = req.final_state, req.final_carries or {}
+            if table is not None:
+                table.put(fg.prefix_spec, state, carries)
+            served = _assign_forks(forks, fg, plan_, state, carries,
+                                   stats)
+            # honest accounting: the prefix itself cost fork_chunks,
+            # each forked cell saves them (a fully-vetoed group goes
+            # NEGATIVE — the prefix ran for nothing)
+            stats["prefix_chunks_saved"] += \
+                (served - 1) * (fg.fork_ms // chunk)
+    return forks
+
+
+def _assign_forks(forks: dict, fg, plan_: MatrixPlan, state, carries,
+                  stats: dict) -> int:
+    """Hand one completed prefix to its cells, gated per cell by the
+    runtime chaos-no-op soundness check (memo/prefix.py); a vetoed
+    cell runs unforked.  Returns how many cells were forked."""
+    from ..memo import chaos_noop_before_fork
+    from ..serve.scheduler import ForkState
+
+    served = 0
+    for cid in fg.cells:
+        if cid not in plan_.resolved:
+            continue
+        if not chaos_noop_before_fork(plan_.resolved[cid], state,
+                                      fg.fork_ms):
+            stats["fork_vetoed"] += 1
+            continue
+        forks[cid] = ForkState(
+            state=state,
+            carries={p: list(cs) for p, cs in carries.items()},
+            at_ms=fg.fork_ms, prefix_digest=fg.prefix_digest)
+        served += 1
+    stats["forked_cells"] += served
+    return served
+
+
 def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
              plan_: MatrixPlan | None = None, *, ledger_path=None,
              checkpoint_dir=None, max_wave: int = 64,
              keep_states=("*",), progress=None,
              strict_builds: bool = True,
-             resume: bool = False) -> MatrixRun:
+             resume: bool = False, memo=None) -> MatrixRun:
     """Run every cell of `grid` (module docstring) and build the
     `MatrixReport`.
 
@@ -223,6 +332,16 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
         uninterrupted run's (tests/test_matrix.py kill-mid-campaign
         pin); the run-local accounting (wall, program_builds, the
         `resume` block) honestly differs.
+    memo        — memoized supersteps (wittgenstein_tpu/memo; True, a
+        `MemoConfig`, or a dict of its fields): cells differing only
+        in post-fork adversity share ONE honest-prefix run and fork
+        from its chunk-boundary state (+ obs carries), bit-identical
+        to unforked runs; a configured `table` additionally reuses
+        completed prefixes ACROSS runs (content-addressed on-disk
+        store).  The report grows a `memo` block (prefix runs, table
+        hits, `prefix_chunks_saved` — matching the fork plan's
+        prediction on a veto-free cold-table run) and forked cell rows
+        carry `forked_from` provenance.
     """
     plan_ = plan_ or plan(grid)
     sch = scheduler or Scheduler(ledger_path=ledger_path,
@@ -240,6 +359,13 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
     resume_counts = None
     groups = plan_.groups
     expected_builds = plan_.expected_builds
+    mcfg = table = None
+    memo_stats = None
+    forks: dict = {}
+    if memo:
+        from ..memo import MemoConfig
+        mcfg = MemoConfig.coerce(memo)
+        table = mcfg.open_table()
     if resume:
         served, pre, resume_counts = _load_resume(
             plan_, sch, ledger_path or sch.ledger_path)
@@ -259,6 +385,32 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
             done_cells += _harvest(sch, pre, results, artifacts,
                                    states, keep_all, keep)
         groups = plan_.remaining(set(results))
+    if mcfg is not None and mcfg.fork:
+        from ..memo import plan_prefixes
+        fplan = plan_prefixes(plan_, min_cells=mcfg.min_cells,
+                              done_ids=set(results),
+                              include_singles=table is not None)
+        memo_stats = {"fork_groups": len(fplan.groups),
+                      "predicted_chunks_saved":
+                      fplan.predicted_chunks_saved,
+                      "prefix_runs": 0, "prefix_failed": 0,
+                      "table_hits": 0, "forked_cells": 0,
+                      "fork_vetoed": 0, "prefix_chunks_saved": 0}
+        # build-accounting ceiling: a prefix whose compile key is new
+        # to the plan (no clean sibling in the grid) adds its own
+        # program builds; a prefix sharing a plan key just performs
+        # that group's builds EARLY (the group then registry-hits)
+        plan_keys = {g.compile_key for g in groups}
+        seen = set()
+        for fg in fplan.groups:
+            if fg.prefix_key not in plan_keys \
+                    and fg.prefix_key not in seen:
+                seen.add(fg.prefix_key)
+                expected_builds += fg.prefix_builds
+        forks = _run_prefixes(sch, plan_, fplan, table, memo_stats,
+                              max_wave)
+        if table is not None:
+            memo_stats["table"] = table.stats()
     for gi, group in enumerate(groups):
         cells = list(group.cells)
         for lo in range(0, len(cells), max_wave):
@@ -274,7 +426,8 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                         label=f"matrix:{cell.id}",
                         ledger_extra={"grid_digest": plan_.grid_digest,
                                       "cell": cell.id,
-                                      "axes": dict(cell.labels)})
+                                      "axes": dict(cell.labels)},
+                        fork=forks.get(cell.id))
                 except ValueError as e:     # plan validated; belt and
                     # braces for env drift between plan and run
                     results[cell.id] = {"status": "error",
@@ -310,8 +463,13 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
     # expected_builds (live + checkpoint-requeued groups): a served
     # group that somehow re-compiles is a scheduling bug there too.
     clean = all(r["status"] == "done" for r in results.values())
+    # a memo-table hit or a failed prefix legitimately leaves prefix
+    # programs unbuilt: the exact-equality contract only applies when
+    # every planned program (cells + prefixes) actually ran cold
+    memo_partial = bool(memo_stats) and (
+        memo_stats["table_hits"] or memo_stats["prefix_failed"])
     if strict_builds and cold and clean and not resume \
-            and builds != expected_builds:
+            and not memo_partial and builds != expected_builds:
         raise RuntimeError(
             f"matrix: compile-key-minimal contract violated — "
             f"{builds} program builds for {expected_builds} "
@@ -328,7 +486,7 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                   "distinct_compile_keys": plan_.planned_compiles,
                   "registry": reg},
         scheduler_stats=sch.resilience,
-        resume=resume_counts)
+        resume=resume_counts, memo=memo_stats)
     return MatrixRun(report=report, artifacts=artifacts, states=states,
                      requests=requests)
 

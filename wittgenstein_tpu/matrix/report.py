@@ -64,6 +64,11 @@ def _cell_row(cell, rspec, result, twin_summary) -> dict:
         row["time_to_done_ms"] = ttd
     if art.get("resumed_from_ms"):
         row["resumed_from_ms"] = art["resumed_from_ms"]
+    if art.get("forked_from"):
+        # snapshot-fork provenance (memo): the prefix-checkpoint digest
+        # + fork ms, so tools/matrix.py --spot-check verifies forked
+        # cells against sequential twins instead of skipping them
+        row["forked_from"] = dict(art["forked_from"])
     if twin_summary is not None:
         row["impact_vs_twin"] = {
             k: row["summary"][k] - twin_summary[k] for k in IMPACT_KEYS
@@ -118,13 +123,16 @@ class MatrixReport:
     def build(cls, plan, results: dict, wall_s: float,
               compiles: dict | None = None,
               scheduler_stats: dict | None = None,
-              resume: dict | None = None) -> "MatrixReport":
+              resume: dict | None = None,
+              memo: dict | None = None) -> "MatrixReport":
         """Assemble from a `MatrixPlan` + per-cell results
         (cell id -> {"status", "artifacts"|"error"}).  `resume` is the
         driver's campaign-resume accounting (cells served from ledger
         rows / deduped across grids / checkpoint-resumed requests) —
         recorded as its own block so the cell rows stay identical to
-        an uninterrupted run's."""
+        an uninterrupted run's.  `memo` is the snapshot-fork
+        accounting (prefix runs, table hits, `prefix_chunks_saved`) —
+        its own block for the same reason."""
         grid = plan.grid
         summaries = {cid: r["artifacts"]["summary"]
                      for cid, r in results.items()
@@ -161,6 +169,8 @@ class MatrixReport:
             data["resilience"] = dict(scheduler_stats)
         if resume:
             data["resume"] = dict(resume)
+        if memo:
+            data["memo"] = dict(memo)
         return cls(data=data)
 
     # -------------------------------------------------------------- views
@@ -221,6 +231,14 @@ class MatrixReport:
             + (f", {d['program_builds']} program builds"
                if "program_builds" in d else "")
             + f", wall {d['wall_s']} s"]
+        if "memo" in d:
+            m = d["memo"]
+            lines.append(
+                f"  memo: {m.get('forked_cells', 0)} cells forked from "
+                f"{m.get('prefix_runs', 0)} prefix run(s) "
+                f"(+{m.get('table_hits', 0)} table hits), "
+                f"{m.get('prefix_chunks_saved', 0)} prefix chunks saved"
+                f" (plan predicted {m.get('predicted_chunks_saved', 0)})")
         for axis, table in d["by_axis"].items():
             lines.append(f"  axis {axis}:")
             for label, agg in table.items():

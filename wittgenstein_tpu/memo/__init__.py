@@ -1,0 +1,78 @@
+"""wittgenstein_tpu.memo — memoized supersteps: never simulate the
+same honest work twice.
+
+The memoization half of the fast-forward paper (PAPERS.md 2602.10615;
+fast-forwarding itself landed in PR 2), built on the PR-10 substrate
+(bit-exact chunk-boundary checkpoint/restore) and consumed by the
+PR-12 matrix driver:
+
+  prefix — snapshot-fork planning: cells of a sweep that differ only
+           in POST-FORK adversity (attack timing, chaos windows) share
+           one honest prefix; `plan_prefixes` finds the longest
+           chunk-aligned fork point per group, the driver runs each
+           prefix ONCE through the serve scheduler and forks the cells
+           from the restored state with the prefix's obs carries.
+  freeze — fixed-point lane freezing: a lane the `next_work` oracle
+           proves quiet to its end is sliced out of the running batch
+           at a chunk boundary; its final state (`_jump`) and
+           remaining metrics/trace/audit carries are synthesized
+           bit-identically (`Scheduler(freeze=True)` / ``WTPU_MEMO=1``).
+  table  — a content-addressed on-disk store of completed prefixes
+           (compile key + entry-state digest + chunk span), layered
+           beside the compile registry, so repeated campaigns reuse
+           simulated chunks, not just compiled programs.
+
+The acceptance bar everywhere is BIT-IDENTITY: forked/frozen runs'
+final pytrees and stitched artifacts equal unforked sequential
+`Runner` runs', enforced with the PR-5 `first_divergence` bisector
+(tests/test_memo.py, tools/memo.py).  `MemoConfig` is the driver-side
+knob bundle (`run_grid(memo=...)`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .freeze import (FREEZE_ENGINES, build_probe,  # noqa: F401
+                     freeze_supported, frozen_carries, frozen_final)
+from .prefix import (ForkGroup, ForkPlan,  # noqa: F401
+                     chaos_noop_before_fork, first_adversity_ms,
+                     plan_prefixes, strip_adversity)
+from .table import MemoTable  # noqa: F401
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoConfig:
+    """The matrix driver's memo knobs (``run_grid(memo=...)``)."""
+
+    #: snapshot-fork shared honest prefixes (prefix.py)
+    fork: bool = True
+    #: minimum cells sharing a prefix before an IN-RUN fork pays for
+    #: itself; a configured table keeps singletons too (cross-run value)
+    min_cells: int = 2
+    #: cross-run memo table directory (None = in-run memoization only)
+    table: object = None
+
+    @classmethod
+    def coerce(cls, memo) -> "MemoConfig":
+        """``True`` / dict / MemoConfig -> MemoConfig."""
+        if isinstance(memo, cls):
+            return memo
+        if memo is True:
+            return cls()
+        if isinstance(memo, dict):
+            return cls(**memo)
+        raise ValueError(f"memo must be True, a dict of MemoConfig "
+                         f"fields, or a MemoConfig; got {memo!r}")
+
+    def open_table(self) -> MemoTable | None:
+        if self.table is None:
+            return None
+        return self.table if isinstance(self.table, MemoTable) \
+            else MemoTable(self.table)
+
+
+__all__ = ["MemoConfig", "MemoTable", "ForkGroup", "ForkPlan",
+           "plan_prefixes", "strip_adversity", "first_adversity_ms",
+           "chaos_noop_before_fork", "FREEZE_ENGINES", "build_probe",
+           "freeze_supported", "frozen_carries", "frozen_final"]

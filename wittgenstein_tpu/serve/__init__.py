@@ -34,7 +34,7 @@ coalesced into few compiled device programs.
 """
 
 from .registry import CompileRegistry  # noqa: F401
-from .scheduler import (AdmissionError, Request, Scheduler,  # noqa: F401
-                        StaleCheckpointError, TenantPolicy)
+from .scheduler import (AdmissionError, ForkState, Request,  # noqa: F401
+                        Scheduler, StaleCheckpointError, TenantPolicy)
 from .service import Service  # noqa: F401
 from .spec import ENGINES, OBS_PLANES, ScenarioSpec  # noqa: F401

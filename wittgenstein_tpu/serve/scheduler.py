@@ -80,6 +80,31 @@ With no `tenants=` config the scheduler behaves exactly as before
 scheduler-side only, and the compiled programs are untouched — the
 `PingPong+tenancy` analysis target pins carry_extra_leaves=0 /
 transfer_ops=0 over a tenancy-labelled spec.
+
+Memo (PR 14 — wittgenstein_tpu/memo, ROADMAP item 3):
+
+  * Snapshot-fork seam: ``submit(spec, fork=ForkState(...))`` enqueues
+    a request that enters at a mid-run chunk boundary with a shared
+    honest-prefix state AND the prefix's per-chunk obs carries — the
+    in-memory preemption machinery reused as a fork: `_init_lanes`
+    consumes the state, `_Lane` the carries, so the finished artifacts
+    stitch the WHOLE span and the trajectory is bit-identical to an
+    unforked run.  `forked_from` provenance (prefix digest + fork ms)
+    rides the artifacts and the ledger row.
+  * Fixed-point lane freezing: with ``freeze=True`` (default: the
+    ``WTPU_MEMO=1`` env flag), lanes the `next_work` oracle proves
+    quiet to their end are sliced out of the batch at chunk boundaries
+    and their tails synthesized analytically (memo/freeze.py) —
+    bit-identical state and artifacts, engine scope and soundness
+    conditions documented there.
+  * `memo_stats()` is the `/w/batch/memo` block (forked requests,
+    frozen lanes/chunks, freeze flag).
+
+Streaming (ROADMAP item 5 leftover): every chunk boundary appends the
+request's primary-pass totals (and their per-chunk DELTA) to
+`Request.chunk_totals` and notifies a condition variable;
+`stream_chunks` long-polls it — the `/w/batch/stream/{id}` endpoint
+blocks until the next boundary and returns the new per-chunk deltas.
 """
 
 from __future__ import annotations
@@ -135,6 +160,20 @@ class StaleCheckpointError(ValueError):
     trajectory is worse than restarting.  Plain IO/decode failures
     (torn files, garbage .npz) keep the PR-10 skip-with-stderr
     behavior."""
+
+
+@dataclasses.dataclass
+class ForkState:
+    """A snapshot-fork handoff (`submit(spec, fork=...)`): the shared
+    honest prefix's final (net, pstate) lane state, its per-chunk
+    obs carries (plane -> [carry, ...]) covering ``[entry, at_ms)``,
+    the chunk-aligned fork point, and the prefix-spec digest the
+    forked request's provenance records."""
+
+    state: tuple
+    carries: dict
+    at_ms: int
+    prefix_digest: str
 
 
 class AdmissionError(RuntimeError):
@@ -196,6 +235,21 @@ class Request:
     #: group-level fast-forward skip stats accumulated across
     #: preemption segments (the artifact's `fast_forward` block)
     ff_accum: dict | None = None
+    #: snapshot-fork provenance: {"prefix_digest", "fork_ms"} — the
+    #: honest prefix this request entered from (memo; rides artifacts
+    #: AND the ledger row so forked cells verify, not skip)
+    forked_from: dict | None = None
+    #: fixed-point freeze marker: the chunk boundary this request's
+    #: lane was proven quiet-to-end and sliced out of the batch
+    frozen_from_ms: int | None = None
+    #: stash the raw per-chunk obs carries on the finished request
+    #: (the memo driver's prefix handoff needs them; artifacts keep
+    #: only the decoded blocks)
+    keep_carries: bool = False
+    final_carries: dict | None = None
+    #: per-chunk-boundary primary-pass totals + deltas (the streaming
+    #: endpoint's backing store; evicted with the request)
+    chunk_totals: list = dataclasses.field(default_factory=list)
 
     @property
     def tenant(self) -> str:
@@ -259,7 +313,8 @@ class Scheduler:
                  launcher=None, max_retries: int = 2,
                  retry_backoff_s: float = 0.05, checkpoint_dir=None,
                  tenants: dict | None = None,
-                 quantum_chunks: int | None = None):
+                 quantum_chunks: int | None = None,
+                 freeze: bool | None = None):
         self.registry = registry or CompileRegistry()
         self.ledger_path = ledger_path      # None = the shared default
         #: the device-program launch seam: ``launcher(fn, *args)``
@@ -300,6 +355,15 @@ class Scheduler:
         #: resilience accounting, surfaced in per-request artifacts
         self.resilience = {"retries": 0, "demotions": 0, "resumed": 0,
                            "preemptions": 0, "rejected": 0}
+        #: fixed-point lane freezing (memo/freeze.py); None defers to
+        #: the WTPU_MEMO env flag so an operator can flip a deployed
+        #: service without touching code
+        if freeze is None:
+            import os
+            freeze = os.environ.get("WTPU_MEMO", "0") == "1"
+        self.freeze = bool(freeze)
+        #: memo accounting (the `/w/batch/memo` block)
+        self.memo = {"forked": 0, "frozen_lanes": 0, "frozen_chunks": 0}
         #: test/ops hook: called at every chunk boundary of a running
         #: group, BEFORE admission — a callback may `submit()` and see
         #: its request join this group (the continuous-batching pin)
@@ -311,6 +375,8 @@ class Scheduler:
         #: answers unknown).  0 = unbounded (tests, short-lived tools).
         self.keep_done = int(keep_done)
         self._mu = threading.RLock()
+        #: chunk-boundary pulse for the streaming long-poll
+        self._boundary = threading.Condition(self._mu)
         self._requests: dict[str, Request] = {}
         self._queue: list[str] = []         # FIFO of queued request ids
         self._n = 0
@@ -373,6 +439,44 @@ class Scheduler:
             f"~{retry:.1f}s, raise the tenant's max_queued, or split "
             "the submission across tenants", retry_after_s=retry)
 
+    def memo_stats(self) -> dict:
+        """The `/w/batch/memo` block: snapshot-fork and lane-freeze
+        accounting plus the freeze flag (memo/freeze.py scope)."""
+        with self._mu:
+            return {"freeze": self.freeze, **self.memo}
+
+    def stream_chunks(self, rid: str, after_ms: int | None = None,
+                      timeout_s: float = 25.0) -> dict:
+        """Long-poll one request's per-chunk primary-pass totals
+        (module docstring): block until a chunk boundary newer than
+        `after_ms` lands (or the request settles / `timeout_s`
+        expires), then return the new ``{"t_ms", "totals", "delta"}``
+        entries.  ``eof`` is True once the request has settled and no
+        newer boundary is pending — the client stops polling.  Raises
+        KeyError on an unknown/evicted id (the HTTP 400)."""
+        after = -1 if after_ms is None else int(after_ms)
+        deadline = time.time() + max(0.0, min(float(timeout_s), 60.0))
+        with self._boundary:
+            while True:
+                if rid not in self._requests:
+                    raise KeyError(f"unknown request {rid!r}")
+                req = self._requests[rid]
+                fresh = [dict(c) for c in req.chunk_totals
+                         if c["t_ms"] > after]
+                status = req.status
+                if fresh or status in ("done", "error") \
+                        or time.time() >= deadline:
+                    break
+                self._boundary.wait(
+                    timeout=max(0.05, deadline - time.time()))
+        out = {"id": rid, "status": status, "after_ms": after,
+               "chunks": fresh,
+               "next_after_ms": fresh[-1]["t_ms"] if fresh else after,
+               "eof": status in ("done", "error") and not fresh}
+        if status == "error" and req.error:
+            out["error"] = req.error
+        return out
+
     def tenancy_stats(self) -> dict:
         """The `/w/batch/tenancy` block: per-tenant queue depth +
         lifetime counters, plus the scheduler-level knobs a load
@@ -397,15 +501,56 @@ class Scheduler:
     # ------------------------------------------------------------- submit
 
     def submit(self, spec: ScenarioSpec, label: str | None = None,
-               ledger_extra: dict | None = None) -> str:
+               ledger_extra: dict | None = None,
+               keep_carries: bool = False,
+               fork: ForkState | None = None) -> str:
         """Validate (raises `ValueError` with remedy text — the HTTP
         layer's 400) and enqueue; returns the request id.  An
         over-budget tenant raises `AdmissionError` (the 429 path; see
         `_admit`).  `label` / `ledger_extra` ride into the request's
         ledger row (the matrix driver's per-cell provenance — see the
-        Request fields)."""
+        Request fields).  `fork` (a `ForkState`) enters the request at
+        a mid-run chunk boundary from a shared honest-prefix state
+        with the prefix's obs carries (module docstring: the memo
+        snapshot-fork seam); `keep_carries` stashes the raw per-chunk
+        carries on the finished request (the prefix handoff)."""
         resolved = spec.validate()
         key = resolved.compile_key()
+        if fork is not None:
+            at = int(fork.at_ms)
+            if at < resolved.chunk_ms or at % resolved.chunk_ms or \
+                    at >= resolved.sim_ms:
+                raise ValueError(
+                    f"fork.at_ms={at} must be a positive multiple of "
+                    f"chunk_ms={resolved.chunk_ms} inside the span "
+                    f"[chunk_ms, sim_ms={resolved.sim_ms}): requests "
+                    "enter and leave groups only on chunk boundaries")
+            import jax
+            width = jax.tree.leaves(fork.state)[0].shape[0]
+            if width != len(resolved.seeds):
+                raise ValueError(
+                    f"fork state carries {width} lane(s) but the spec "
+                    f"has {len(resolved.seeds)} seed(s): the prefix "
+                    "must have been run with exactly the cell's seeds")
+            # the stitched-artifact contract: every captured plane must
+            # arrive with one carry per prefix CHUNK, or the finished
+            # artifacts would silently claim a full span they don't
+            # cover (same refuse-with-remedy discipline as above)
+            want_chunks = at // resolved.chunk_ms
+            carries = fork.carries or {}
+            for plane in resolved.obs:
+                got = len(carries.get(plane, ()))
+                if got != want_chunks:
+                    raise ValueError(
+                        f"fork carries cover {got} chunk(s) of the "
+                        f"{plane!r} plane but the prefix spans "
+                        f"{want_chunks} chunk(s) ([0, {at}) at "
+                        f"chunk_ms={resolved.chunk_ms}): the forked "
+                        "request could not stitch a full-span "
+                        "artifact. Fix: hand over the prefix run's "
+                        "complete per-chunk carries (submit the "
+                        "prefix with keep_carries=True), or drop the "
+                        "plane from the spec's obs")
         with self._mu:
             self._admit(resolved)
             self._n += 1
@@ -416,11 +561,22 @@ class Scheduler:
                 # scheduler's counter — never overwrite one
                 self._n += 1
                 rid = f"r{self._n:04d}"
-            self._requests[rid] = Request(id=rid, spec=resolved,
-                                          compile_key=key,
-                                          requested=spec, label=label,
-                                          ledger_extra=dict(ledger_extra)
-                                          if ledger_extra else None)
+            req = Request(id=rid, spec=resolved, compile_key=key,
+                          requested=spec, label=label,
+                          keep_carries=bool(keep_carries),
+                          ledger_extra=dict(ledger_extra)
+                          if ledger_extra else None)
+            if fork is not None:
+                req.restored_state = fork.state
+                req.saved_carries = {p: list(cs) for p, cs
+                                     in (fork.carries or {}).items()}
+                req.progress_ms = int(fork.at_ms)
+                req.forked_from = {"prefix_digest": fork.prefix_digest,
+                                   "fork_ms": int(fork.at_ms)}
+                req.ledger_extra = {**(req.ledger_extra or {}),
+                                    "forked_from": dict(req.forked_from)}
+                self.memo["forked"] += 1
+            self._requests[rid] = req
             self._queue.append(rid)
         return rid
 
@@ -553,6 +709,7 @@ class Scheduler:
                         self._queue.remove(req.id)
                     req.status, req.error = "error", msg
                     self._tstat(req.spec.tenant)["errors"] += 1
+            self._boundary.notify_all()     # wake stream long-polls
 
     # ----------------------------------------------------------- grouping
 
@@ -724,6 +881,14 @@ class Scheduler:
         import os
         with contextlib.suppress(OSError):
             os.remove(path)
+
+    def discard_checkpoint(self, key: str):
+        """Drop one compile key's group checkpoint file (public seam:
+        the matrix driver's memo resume discards mid-prefix checkpoints
+        — a prefix resumed without its pre-crash obs carries could not
+        stitch full-span artifacts for its forked cells, so the prefix
+        re-runs instead)."""
+        self._drop_checkpoint(key)
 
     def resume_checkpoints(self) -> list:
         """Re-enqueue every interrupted group found in
@@ -911,6 +1076,11 @@ class Scheduler:
         fn = self.registry.chunk_fn(spec0, primary, proto=proto0)
         shadow_fns = [(p, self.registry.chunk_fn(spec0, p, proto=proto0))
                       for p in shadows]
+        freeze_probe = None
+        if self.freeze:
+            from ..memo import build_probe, freeze_supported
+            if freeze_supported(spec0, proto0.cfg):
+                freeze_probe = build_probe(proto0)
         while lanes:
             entry = state
             widths = [ln.width for ln in lanes]
@@ -946,6 +1116,19 @@ class Scheduler:
                 for req, t_ms, snap in updates:
                     req.progress_ms = t_ms
                     req.progress = snap
+                    # the streaming endpoint's backing store: this
+                    # boundary's primary-pass totals + their delta vs
+                    # the previous boundary (cumulative counters become
+                    # per-chunk contributions client-side for free)
+                    totals = {k: v for k, v in snap.items()
+                              if k not in ("t_ms", "sim_ms")}
+                    prev = req.chunk_totals[-1]["totals"] \
+                        if req.chunk_totals else {}
+                    req.chunk_totals.append(
+                        {"t_ms": t_ms, "totals": totals,
+                         "delta": {k: v - prev.get(k, 0)
+                                   for k, v in totals.items()}})
+                self._boundary.notify_all()
             finished = [ln for ln in lanes if ln.remaining == 0]
             if finished:
                 for ln, lo in zip(lanes, offsets):
@@ -963,6 +1146,10 @@ class Scheduler:
                 lanes = [ln for ln in lanes if ln.remaining > 0]
                 if lanes:
                     state = self._take_lanes(state, keep)
+            if freeze_probe is not None and lanes:
+                state, lanes, n_frozen = self._freeze_pass(
+                    spec0, proto0, freeze_probe, lanes, state)
+                done += n_frozen
             if self.checkpoint_dir:
                 if lanes:
                     self._save_checkpoint(key, lanes, state)
@@ -998,6 +1185,72 @@ class Scheduler:
                         ([state] if lanes else []) + new)
                     lanes.extend(_Lane(r) for r in joiners)
         return done, chunks_run
+
+    # -------------------------------------------------------------- memo
+
+    def _freeze_pass(self, spec0, proto0, probe, lanes: list, state):
+        """Fixed-point lane freezing at one chunk boundary (module
+        docstring + memo/freeze.py): lanes whose every seed's
+        `next_work` lands at or past the lane's end are finalized NOW —
+        final state via the quiet-window jump, remaining obs carries
+        synthesized — and sliced out of the batch, so the surviving
+        lanes stop paying for converged neighbors.  Returns the
+        narrowed ``(state, lanes, frozen_count)``."""
+        nw = np.asarray(jax.device_get(probe(*state))).reshape(-1)
+        times = np.asarray(jax.device_get(state[0].time)).reshape(-1)
+        offsets = np.cumsum([0] + [ln.width for ln in lanes])
+        attack = spec0.attack
+        frozen = []
+        for ln, lo in zip(lanes, offsets):
+            lo = int(lo)
+            t_lane = int(times[lo])
+            if attack is not None and t_lane <= int(attack["at_ms"]):
+                continue        # a pending FaultInjector perturbation
+                # is outside the oracle's view — never freeze across it
+            t_end = t_lane + ln.remaining * spec0.chunk_ms
+            if int(nw[lo:lo + ln.width].min()) >= t_end:
+                frozen.append((ln, lo, t_lane, t_end))
+        if not frozen:
+            return state, lanes, 0
+        from ..memo import frozen_carries, frozen_final
+        for ln, lo, t_lane, t_end in frozen:
+            lane_state = jax.tree.map(
+                lambda x, lo=lo, w=ln.width: x[lo:lo + w], state)
+            final = frozen_final(proto0.cfg, lane_state, t_end)
+            tails = frozen_carries(spec0, proto0.cfg, lane_state,
+                                   t_lane, ln.remaining)
+            for plane, chunks in tails.items():
+                ln.carries.setdefault(plane, []).extend(chunks)
+            # the stream must see every boundary the ARTIFACT claims:
+            # synthesized tail chunks get their (constant — the lane is
+            # a fixed point) totals appended like executed ones, so a
+            # /w/batch/stream client and serve_load's --stream smoke
+            # count sim_ms/chunk_ms entries whether or not lanes froze
+            snap = self._snapshot(ln, t_end)
+            totals = {k: v for k, v in snap.items()
+                      if k not in ("t_ms", "sim_ms")}
+            with self._mu:
+                for i in range(int(ln.remaining)):
+                    prev = ln.req.chunk_totals[-1]["totals"] \
+                        if ln.req.chunk_totals else {}
+                    ln.req.chunk_totals.append(
+                        {"t_ms": t_lane + (i + 1) * spec0.chunk_ms,
+                         "totals": dict(totals),
+                         "delta": {k: v - prev.get(k, 0)
+                                   for k, v in totals.items()}})
+                self._boundary.notify_all()
+                ln.req.frozen_from_ms = t_lane
+                self.memo["frozen_lanes"] += 1
+                self.memo["frozen_chunks"] += int(ln.remaining)
+            self._finalize(ln, final, None)
+        gone = {id(ln) for ln, *_ in frozen}
+        keep = [i for s, ln in zip(offsets, lanes)
+                if id(ln) not in gone
+                for i in range(int(s), int(s) + ln.width)]
+        lanes = [ln for ln in lanes if id(ln) not in gone]
+        if lanes:
+            state = self._take_lanes(state, keep)
+        return state, lanes, len(frozen)
 
     # ------------------------------------------------------- per-request
 
@@ -1045,6 +1298,17 @@ class Scheduler:
         art["tenant"] = spec.tenant
         if req.preempted:
             art["preempted"] = req.preempted
+        if req.forked_from:
+            # snapshot-fork provenance: the artifacts (and the ledger
+            # row, via ledger_extra at submit) name the honest prefix
+            # this request entered from, so verification tooling checks
+            # forked cells against sequential twins instead of skipping
+            art["forked_from"] = dict(req.forked_from)
+        if req.frozen_from_ms is not None:
+            art["memo"] = {"frozen_from_ms": req.frozen_from_ms,
+                           "frozen_chunks":
+                           (spec.sim_ms - req.frozen_from_ms)
+                           // spec.chunk_ms}
         line = {"metric": f"serve_{req.id}", "sim_ms": spec.sim_ms,
                 "superstep": spec.superstep, "batch": len(spec.seeds)}
         if req.resumed_from_ms:
@@ -1112,11 +1376,15 @@ class Scheduler:
             self._tstat(spec.tenant)["done"] += 1
             req.artifacts = art
             req.final_state = final_state
+            if req.keep_carries:
+                req.final_carries = {p: list(cs)
+                                     for p, cs in ln.carries.items()}
             req.finished = now
             req.manifest_path = path
             req.progress_ms = spec.sim_ms
             req.status = "done"
             self._evict_old_done()
+            self._boundary.notify_all()     # wake stream long-polls
 
     def _evict_old_done(self):
         """Drop the oldest finished records past `keep_done` (caller

@@ -146,6 +146,24 @@ class Service:
         and the chunk-wall EMA behind retry-after estimates."""
         return self.scheduler.tenancy_stats()
 
+    def memo_stats(self) -> dict:
+        """GET /w/batch/memo — snapshot-fork / lane-freeze accounting
+        (forked requests, frozen lanes and the chunks they saved, the
+        freeze flag)."""
+        return self.scheduler.memo_stats()
+
+    def stream(self, rid: str, after_ms=None, timeout_s=25.0) -> dict:
+        """GET /w/batch/stream/{id}[?after=MS&timeout=S] — long-poll
+        streaming partial metrics: blocks until the request crosses a
+        chunk boundary newer than `after` (or settles, or the timeout
+        expires) and returns the new per-chunk primary-pass totals +
+        deltas.  Clients loop, feeding `next_after_ms` back as `after`,
+        until ``eof``."""
+        return self.scheduler.stream_chunks(
+            rid, after_ms=after_ms,
+            timeout_s=float(timeout_s if timeout_s is not None
+                            else 25.0))
+
     # ---------------------------------------------- matrix (sweep grids)
 
     def matrix_submit(self, body: dict) -> dict:

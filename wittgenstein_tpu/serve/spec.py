@@ -306,7 +306,8 @@ class ScenarioSpec:
                     "(registered: NetworkFixedLatency(ms), "
                     "NetworkUniformLatency(max), "
                     "NetworkHeterogeneousLatency(base,spread,skew[,seed])"
-                    ", class names from core/latency.py, e.g. "
+                    ", NetworkCSVLatency(path.csv), class names from "
+                    "core/latency.py, e.g. "
                     "NetworkLatencyByDistanceWJitter)") from None
         validate_parameters(self.protocol, self._effective_params())
         if self.engine not in ENGINES:

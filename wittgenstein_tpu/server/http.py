@@ -35,6 +35,11 @@ service"; spec schema in serve/spec.py):
     POST /w/batch/run                      manual queue drain
     GET  /w/batch/registry                 compile-registry hit/miss
     GET  /w/batch/tenancy                  per-tenant queue/fairness stats
+    GET  /w/batch/memo                     fork/freeze memo stats
+    GET  /w/batch/stream/{id}              long-poll: blocks until the
+                                           next chunk boundary, returns
+                                           per-chunk totals + deltas
+                                           (?after=MS&timeout=S)
 
 Matrix plane (wittgenstein_tpu/matrix — README "Scenario matrix";
 grid schema in matrix/grid.py):
@@ -136,6 +141,13 @@ class _Handler(BaseHTTPRequestHandler):
          lambda s, m, b: s.batch.registry_stats()),
         ("GET", r"^/w/batch/tenancy$",
          lambda s, m, b: s.batch.tenancy_stats()),
+        ("GET", r"^/w/batch/memo$",
+         lambda s, m, b: s.batch.memo_stats()),
+        # long-poll partial-metrics stream (?after=MS&timeout=S) —
+        # lock-free like every batch route, and REQUIRED to be: the
+        # poll blocks for seconds by design
+        ("GET", r"^/w/batch/stream/([A-Za-z0-9_-]+)(?:\?(.*))?$",
+         lambda s, m, b: s._stream(m)),
         # ---- matrix plane (wittgenstein_tpu/matrix): a whole sweep
         # grid as one request — planned at submit (400 names the bad
         # cell), driven on the batch scheduler, reported as ONE
@@ -160,6 +172,8 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/run$",
         r"^/w/batch/registry$",
         r"^/w/batch/tenancy$",
+        r"^/w/batch/memo$",
+        r"^/w/batch/stream/([A-Za-z0-9_-]+)(?:\?(.*))?$",
         r"^/w/matrix/submit$",
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
         r"^/w/matrix/report/([A-Za-z0-9_-]+)$",
@@ -178,6 +192,18 @@ class _Handler(BaseHTTPRequestHandler):
         """Dummy external node (ExternalWS.java:21-40): print, reply []."""
         print(f"Received message: {body}")
         return []
+
+    def _stream(self, m):
+        """The long-poll stream route: parse the optional query string
+        (?after=MS&timeout=S) and delegate to the batch service."""
+        from urllib.parse import parse_qs
+        qs = parse_qs(m.group(2) or "")
+        after = qs.get("after", [None])[0]
+        timeout = qs.get("timeout", [None])[0]
+        return self.batch.stream(
+            m.group(1),
+            after_ms=int(after) if after is not None else None,
+            timeout_s=float(timeout) if timeout is not None else 25.0)
 
     def _dispatch(self, method):
         body = None
