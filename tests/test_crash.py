@@ -1,0 +1,248 @@
+"""Crash-only serve (PR 15): the durable submission journal and the
+kill-anywhere recovery harness.
+
+Fast tests pin the journal's replay edge cases from the WAL contract:
+queued-but-unlaunched submits survive a process death, a double replay
+refuses duplicate rids, a torn tail line after a tombstone is
+tolerated loudly, a request with BOTH a journal entry and a group
+checkpoint resumes from the checkpoint (never from scratch), and an
+empty/missing journal is a no-op.  The slow tests drive the real
+thing: the in-process matrix campaign kill with journal+checkpoint
+resume, and tools/crash_test.py SIGKILLing a subprocess campaign at
+>= 5 seeded-random offsets with the final `MatrixReport` bit-identical
+to the uninterrupted run's.
+"""
+
+import dataclasses
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fill the registry
+from wittgenstein_tpu.serve import (CompileRegistry, ScenarioSpec,
+                                    Scheduler)
+from wittgenstein_tpu.serve.journal import SubmissionJournal
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0, 1), sim_ms=120, chunk_ms=40,
+                obs=("metrics",))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+@pytest.fixture(scope="module")
+def registry():
+    """One compiled program set for the module (the journal is
+    host-side; every test runs the same chunk program)."""
+    return CompileRegistry()
+
+
+@pytest.fixture(scope="module")
+def reference(registry, tmp_path_factory):
+    sched = Scheduler(registry=registry, ledger_path=str(
+        tmp_path_factory.mktemp("led") / "ref.jsonl"))
+    rid = sched.submit(_spec())
+    sched.run_pending()
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    return req.final_state
+
+
+def test_journal_replays_queued_but_unlaunched(registry, reference,
+                                               tmp_path):
+    """The WAL's reason to exist: submits ACCEPTED but never launched
+    when the process died replay in a fresh scheduler — with their
+    original rids, labels and ledger_extra — and run bit-identically;
+    completion tombstones them (journal lag returns to 0)."""
+    jd = str(tmp_path / "journal")
+    dying = Scheduler(registry=registry, journal_dir=jd)
+    a = dying.submit(_spec(), label="crash:a",
+                     ledger_extra={"campaign": "x"})
+    b = dying.submit(_spec(seeds=(7,)))
+    assert SubmissionJournal(jd).lag() == 2
+    # the process dies HERE — nothing ran, nothing checkpointed
+
+    fresh = Scheduler(registry=registry, journal_dir=jd,
+                      ledger_path=str(tmp_path / "led.jsonl"))
+    got = fresh.recover()
+    assert got["checkpoints"] == [] and got["journal"] == [a, b]
+    assert fresh.request(a).label == "crash:a"
+    assert fresh.request(a).ledger_extra == {"campaign": "x"}
+    fresh.run_pending()
+    assert fresh.request(a).status == "done"
+    assert fresh.request(b).status == "done"
+    _trees_equal(reference, fresh.request(a).final_state)
+    assert SubmissionJournal(jd).lag() == 0
+    assert fresh.resilience["replayed"] == 2
+
+
+def test_double_replay_refuses_duplicate_rids(registry, tmp_path):
+    jd = str(tmp_path / "journal")
+    Scheduler(registry=registry, journal_dir=jd).submit(_spec())
+    fresh = Scheduler(registry=registry, journal_dir=jd)
+    assert len(fresh.resume_journal()) == 1
+    # second replay: the rid is live — refused, not duplicated
+    assert fresh.resume_journal() == []
+    assert len(fresh.pending()) == 1
+
+
+def test_tombstone_then_torn_tail_tolerated(registry, tmp_path,
+                                            capsys):
+    """A kill mid-append leaves a torn final line AFTER valid
+    submit/tombstone rows: the tombstoned entry stays dead, the live
+    entry replays, and the torn line is skipped with a loud stderr
+    note (never raised)."""
+    jd = str(tmp_path / "journal")
+    j = SubmissionJournal(jd)
+    j.record_submit("r0001", _spec())
+    j.record_submit("r0002", _spec(seeds=(7,)))
+    j.record_settled("r0001", "done")
+    with open(j.path, "a") as f:
+        f.write('{"kind": "submit", "rid": "r00')    # the torn tail
+    fresh = Scheduler(registry=registry, journal_dir=jd)
+    rids = fresh.resume_journal()
+    assert rids == ["r0002"]
+    assert "torn final line" in capsys.readouterr().err
+    # compaction rewrote the journal down to the one live entry
+    rows = open(j.path).read().strip().splitlines()
+    assert len(rows) == 1 and '"r0002"' in rows[0]
+
+
+def test_journal_plus_checkpoint_resumes_from_checkpoint(
+        registry, reference, tmp_path):
+    """A request with BOTH a journal entry and a group checkpoint
+    resumes from the CHECKPOINT (progress kept), not from scratch —
+    the journal entry is recognized by rid and skipped."""
+    ck, jd = str(tmp_path / "ck"), str(tmp_path / "journal")
+    calls = {"n": 0}
+
+    def killer(fn, *args):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("KILLED")
+        return fn(*args)
+
+    crashed = Scheduler(registry=registry, launcher=killer,
+                        retry_backoff_s=0.0, max_retries=0,
+                        checkpoint_dir=ck, journal_dir=jd)
+    rid = crashed.submit(_spec())
+    crashed.run_pending()
+    assert crashed.request(rid).status == "error"
+    assert os.listdir(ck)                   # chunk-1 checkpoint kept
+    assert SubmissionJournal(jd).lag() == 1  # group errors replay
+
+    fresh = Scheduler(registry=registry, checkpoint_dir=ck,
+                      journal_dir=jd,
+                      ledger_path=str(tmp_path / "led.jsonl"))
+    got = fresh.recover()
+    assert len(got["checkpoints"]) == 1
+    assert got["journal"] == []             # skipped by rid — NOT a
+    # second from-scratch copy of the same request
+    req = fresh.request(got["checkpoints"][0])
+    assert req.resumed_from_ms == 40        # from the checkpoint
+    fresh.run_pending()
+    assert req.status == "done", req.error
+    _trees_equal(reference, req.final_state)
+    assert SubmissionJournal(jd).lag() == 0
+
+
+def test_empty_or_missing_journal_is_noop(tmp_path):
+    assert Scheduler().resume_journal() == []
+    sched = Scheduler(journal_dir=str(tmp_path / "fresh"))
+    assert sched.resume_journal() == []
+    assert sched.health_stats()["journal_lag"] == 0
+
+
+def test_journal_write_failure_unaccepts_the_submit(tmp_path):
+    """The durability promise: if the WAL append fails, the submit
+    must fail LOUDLY and leave no half-accepted request behind."""
+    jd = str(tmp_path / "journal")
+    sched = Scheduler(journal_dir=jd)
+    os.makedirs(sched.journal.path)         # append now raises OSError
+    with pytest.raises(RuntimeError, match="NOT accepted"):
+        sched.submit(_spec())
+    assert sched.pending() == []
+    assert sched._requests == {}
+
+
+# ------------------------------------------------------- kill anywhere
+
+
+@pytest.mark.slow
+def test_matrix_campaign_kill_resume_with_journal(tmp_path):
+    """In-process kill-anywhere: a multi-group chaos-axis campaign is
+    hard-stopped with finished cells (ledger rows), a mid-run group
+    (checkpoint) AND queued-but-unlaunched cells (journal entries
+    only).  A fresh scheduler + run_grid(resume=True) recovers all
+    three classes and the report is bit-identical to the
+    uninterrupted run's."""
+    from tools.crash_test import CRASH_GRID, normalize_report
+    from wittgenstein_tpu.matrix import SweepGrid, plan, run_grid
+
+    g = SweepGrid.from_json(CRASH_GRID)
+    p = plan(g)
+    led = str(tmp_path / "led.jsonl")
+    ck, jd = str(tmp_path / "ck"), str(tmp_path / "journal")
+    ref = run_grid(g, Scheduler(
+        ledger_path=str(tmp_path / "ref.jsonl")), plan_=p)
+    assert ref.report.clean
+
+    calls = {"n": 0}
+
+    def killer(fn, *a):
+        calls["n"] += 1
+        if calls["n"] > 8:
+            raise RuntimeError("KILLED")
+        return fn(*a)
+
+    crashed = run_grid(
+        g, Scheduler(ledger_path=led, checkpoint_dir=ck,
+                     journal_dir=jd, launcher=killer, max_retries=0,
+                     retry_backoff_s=0.0),
+        plan_=p, max_wave=2)
+    assert 0 < crashed.report.data["cells_done"] < len(p.cells)
+    assert os.listdir(ck)
+
+    resumed = run_grid(g, Scheduler(ledger_path=led,
+                                    checkpoint_dir=ck,
+                                    journal_dir=jd),
+                       plan_=p, resume=True)
+    rinfo = resumed.report.data["resume"]
+    assert rinfo["journal_replayed"] >= 1   # queued-but-unlaunched
+    assert rinfo["resumed_requests"] >= 1
+    assert resumed.report.clean
+    assert normalize_report(resumed.report.to_json()) == \
+        normalize_report(ref.report.to_json())
+    for cid, st in resumed.states.items():
+        _trees_equal(st, ref.states[cid])
+    assert not os.listdir(ck)
+    assert SubmissionJournal(jd).lag() == 0
+
+
+@pytest.mark.slow
+def test_crash_tool_kill_anywhere_bit_identical(tmp_path):
+    """THE kill-anywhere acceptance pin: tools/crash_test.py SIGKILLs
+    a subprocess campaign at >= 5 seeded-random wall offsets, resumes
+    with journal+checkpoints every time, and the final MatrixReport is
+    bit-identical to the uninterrupted run's."""
+    from tools.crash_test import run_crash_test
+
+    t0 = time.time()
+    res = run_crash_test(str(tmp_path), kills=5, seed=0)
+    assert res["ok"], res
+    assert res["kills_requested"] == 5
+    assert res["kills_landed"] + res["kills_missed"] == 5
+    print(f"kill-anywhere: {res['kills_landed']} kills landed, "
+          f"wall {time.time() - t0:.0f}s, resume={res['resume']}")

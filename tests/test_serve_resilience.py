@@ -3,17 +3,28 @@ degradation, chunk-boundary checkpoint/resume — driven by a
 fault-injected launcher (the `Scheduler(launcher=)` seam), with
 bit-identity to an uninterrupted run as the acceptance bar, plus the
 chaos plane riding the request plane end to end.
+
+Crash-only additions (PR 15): the poison-lane quarantine pin (one
+planted always-fails lane inside a coalesced group fails ALONE, its
+neighbors bit-identical to solo runs), the hung-launch watchdog pin
+(a sleeping launcher is abandoned at its deadline and the drain loop's
+wall stays bounded), and the stream-termination pin (a long-poll on a
+failing/quarantined request returns a final error record instead of
+hanging until client timeout).
 """
 
 import dataclasses
 import os
+import threading
+import time
 
 import jax
 import numpy as np
 import pytest
 
 import wittgenstein_tpu.models  # noqa: F401 — fill the registry
-from wittgenstein_tpu.serve import ScenarioSpec, Scheduler, Service
+from wittgenstein_tpu.serve import (CompileRegistry, ScenarioSpec,
+                                    Scheduler, Service)
 
 
 def _trees_equal(a, b):
@@ -164,6 +175,153 @@ def test_resume_empty_dir_is_noop(tmp_path):
     sched = Scheduler(checkpoint_dir=str(tmp_path / "none"))
     assert sched.resume_checkpoints() == []
     assert Scheduler().resume_checkpoints() == []
+
+
+# --------------------------------------------------- crash-only (PR 15)
+
+
+def _poison_launcher():
+    """The deterministic always-fails-for-one-lane launcher: the
+    poison request carries partition=(5,) (DATA — same compile key as
+    its neighbors), so its lane is identifiable in ANY batch slice by
+    node 5's down flag; every launch whose batch contains it fails."""
+    def poison(fn, *args):
+        if np.asarray(jax.device_get(args[0].nodes.down))[..., 5].any():
+            raise RuntimeError("poison lane fault")
+        return fn(*args)
+    return poison
+
+
+def test_poison_lane_quarantine_isolates_one_request(tmp_path):
+    """THE quarantine pin: a 4-lane coalesced group with one planted
+    poison lane fails ONLY that request — `quarantined` artifact +
+    ledger row + per-tenant stat — and the other 3 lanes' final
+    pytrees AND metrics/audit artifacts are bit-identical to solo
+    Runner-equivalent (single-request scheduler) runs."""
+    from wittgenstein_tpu.obs import ledger
+
+    reg = CompileRegistry()
+    healthy = [0, 1, 3]
+    spec = _spec(obs=("metrics", "audit"))
+    led = str(tmp_path / "led.jsonl")
+    sched = Scheduler(registry=reg, launcher=_poison_launcher(),
+                      retry_backoff_s=0.0, max_retries=0,
+                      ledger_path=led)
+    rids = {s: sched.submit(dataclasses.replace(spec, seeds=(s,)))
+            for s in healthy[:2]}
+    poison_rid = sched.submit(dataclasses.replace(
+        spec, seeds=(2,), partition=(5,)))
+    rids[3] = sched.submit(dataclasses.replace(spec, seeds=(3,)))
+    keys = {sched.request(r).compile_key for r in rids.values()}
+    assert keys == {sched.request(poison_rid).compile_key}  # coalesced
+    sched.run_pending()
+
+    bad = sched.request(poison_rid)
+    assert bad.status == "error"
+    assert "quarantined" in bad.error
+    assert bad.artifacts["quarantined"] is True
+    assert sched.resilience["quarantined"] == 1
+    assert sched.tenancy_stats()["tenants"]["default"]["quarantined"] \
+        == 1
+    qrows = [r for r in ledger.read_all(led)
+             if (r.extra or {}).get("quarantined")]
+    assert len(qrows) == 1 and qrows[0].run == f"serve:{poison_rid}"
+
+    # the 3 neighbors: done, and bit-identical to SOLO runs (final
+    # pytree + metrics/audit blocks) — the quarantine left no residue
+    for s in healthy:
+        req = sched.request(rids[s])
+        assert req.status == "done", req.error
+        solo = Scheduler(registry=reg)
+        solo_rid = solo.submit(dataclasses.replace(spec, seeds=(s,)))
+        solo.run_pending()
+        ref = solo.request(solo_rid)
+        _trees_equal(ref.final_state, req.final_state)
+        assert req.artifacts["summary"] == ref.artifacts["summary"]
+        assert req.artifacts["engine_metrics"] == \
+            ref.artifacts["engine_metrics"]
+        assert req.artifacts["audit"] == ref.artifacts["audit"]
+
+
+def test_watchdog_abandons_hung_launch(reference):
+    """THE watchdog pin: a launcher that sleeps far past the deadline
+    on its first call is abandoned on its worker thread, the retry
+    completes the group bit-identically, and the drain loop's wall
+    stays bounded by the deadline — never by the sleep."""
+    reg = CompileRegistry()
+    warm = Scheduler(registry=reg)
+    wid = warm.submit(_spec())
+    warm.run_pending()              # compile outside the timed window
+    assert warm.request(wid).status == "done"
+
+    calls = {"n": 0}
+
+    def sleepy(fn, *args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(60)          # the wedge; daemon thread outlives
+        return fn(*args)
+
+    sched = Scheduler(registry=reg, launcher=sleepy,
+                      retry_backoff_s=0.0, max_retries=1,
+                      watchdog_factor=8.0, watchdog_floor_s=0.5)
+    rid = sched.submit(_spec())
+    t0 = time.perf_counter()
+    sched.run_pending()
+    elapsed = time.perf_counter() - t0
+    req = sched.request(rid)
+    assert req.status == "done", req.error
+    assert sched.resilience["watchdog_trips"] == 1
+    assert sched.resilience["retries"] == 1
+    # the drain never blocked on the 60 s sleep: bound = deadline
+    # (0.5 s) + the warm re-launch + slack, far under the sleep
+    assert elapsed < 20, elapsed
+    _trees_equal(reference, req.final_state)
+    health = sched.health_stats()
+    assert health["watchdog_trips"] == 1
+    assert health["watchdog_deadline_s"] is not None
+
+
+def test_stream_terminates_on_error_and_quarantine():
+    """THE stream-termination pin: a `/w/batch/stream/{id}`-equivalent
+    long-poll on a request that fails (or is quarantined) returns a
+    final error record promptly — it must never hang until the client
+    timeout."""
+    def dead(fn, *args):
+        raise RuntimeError("device gone")
+
+    sched = Scheduler(launcher=dead, retry_backoff_s=0.0, max_retries=0)
+    rid = sched.submit(_spec())
+    out: dict = {}
+    th = threading.Thread(
+        target=lambda: out.update(sched.stream_chunks(rid,
+                                                      timeout_s=30.0)))
+    th.start()
+    time.sleep(0.1)                 # the poll is parked on the condvar
+    t0 = time.perf_counter()
+    sched.run_pending()
+    th.join(timeout=10)
+    assert not th.is_alive(), "stream long-poll hung past the failure"
+    assert time.perf_counter() - t0 < 10
+    assert out["status"] == "error" and out["eof"]
+    assert "device gone" in out["error"]
+
+    # quarantined flavor: the final record carries the verdict
+    sched2 = Scheduler(launcher=_poison_launcher(),
+                       retry_backoff_s=0.0, max_retries=0)
+    ok_rid = sched2.submit(_spec(seeds=(0,)))
+    poison_rid = sched2.submit(_spec(seeds=(2,), partition=(5,)))
+    out2: dict = {}
+    th2 = threading.Thread(
+        target=lambda: out2.update(sched2.stream_chunks(poison_rid,
+                                                        timeout_s=30.0)))
+    th2.start()
+    time.sleep(0.1)
+    sched2.run_pending()
+    th2.join(timeout=10)
+    assert not th2.is_alive()
+    assert out2["eof"] and out2.get("quarantined") is True
+    assert sched2.request(ok_rid).status == "done"
 
 
 def test_chaos_spec_through_service(tmp_path):

@@ -9,9 +9,12 @@ Configs (BASELINE.md "Tracked configs"):
     100-attester committees)
 plus smoke stages: trace_smoke (PR 5), audit_smoke (PR 6), serve_smoke
 (PR 7 — 2 coalesced requests through the in-process request plane),
-chaos_smoke (PR 10), matrix_smoke (PR 12), tenancy_smoke (PR 13) and
+chaos_smoke (PR 10), matrix_smoke (PR 12), tenancy_smoke (PR 13),
 memo_smoke (PR 14 — snapshot-fork prefix sharing bit-identical to the
-unmemoized run, prefix_chunks_saved == the fork plan's prediction).
+unmemoized run, prefix_chunks_saved == the fork plan's prediction) and
+crash_smoke (PR 15 — one real SIGKILL of a subprocess campaign,
+journal+checkpoint resume, report bit-identity asserted, plus the
+/w/batch/health round trip over real HTTP).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
@@ -675,6 +678,54 @@ def bench_memo_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_crash_smoke():
+    """Crash-safety smoke stage (PR 15): the kill-anywhere harness at
+    minimum scale — the tiny crash campaign (tools/crash_test.py
+    CRASH_GRID) runs uninterrupted once, then runs in a SUBPROCESS
+    with journal + checkpoints + ledger ON, takes one real SIGKILL at
+    a seeded offset, resumes to completion, and the final
+    `MatrixReport` is asserted BIT-IDENTICAL to the uninterrupted
+    run's (normalized over the honestly run-local keys).  Plus the
+    health-endpoint round trip: `/w/batch/health` answers with the
+    journal/quarantine/watchdog block over real HTTP."""
+    import tempfile
+    import urllib.request
+
+    from tools.crash_test import run_crash_test
+    from wittgenstein_tpu.server.http import make_server
+
+    with tempfile.TemporaryDirectory() as tmp:
+        res = run_crash_test(tmp, kills=1, seed=0)
+    assert res["ok"], f"kill+resume report diverged: {res}"
+
+    # /w/batch/health over real HTTP (the observability satellite)
+    httpd = make_server(port=0, batch_auto=False)
+    port = httpd.server_address[1]
+    import threading
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/w/batch/health",
+                timeout=10) as resp:
+            health = json.loads(resp.read())
+        for key in ("uptime_s", "queued_by_tenant", "journal_lag",
+                    "quarantined", "watchdog_trips",
+                    "chunk_wall_ema_s"):
+            assert key in health, (key, health)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    return {"metric": "crash_smoke_bit_identical",
+            "value": int(res["ok"]), "unit": "bool",
+            "kills_landed": res["kills_landed"],
+            "kills_missed": res["kills_missed"],
+            "resume": res["resume"], "cells": res["cells"],
+            "grid_digest": res["grid_digest"],
+            "health_keys": sorted(health),
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -687,6 +738,7 @@ CONFIGS = {
     "matrix_smoke": bench_matrix_smoke,
     "tenancy_smoke": bench_tenancy_smoke,
     "memo_smoke": bench_memo_smoke,
+    "crash_smoke": bench_crash_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -698,7 +750,8 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "chaos_smoke": "chaos_smoke_lost_msgs",
                 "matrix_smoke": "matrix_smoke_cells",
                 "tenancy_smoke": "tenancy_smoke_requests",
-                "memo_smoke": "memo_smoke_prefix_chunks_saved"}
+                "memo_smoke": "memo_smoke_prefix_chunks_saved",
+                "crash_smoke": "crash_smoke_bit_identical"}
 
 
 def _stage_spec(name):
@@ -777,6 +830,12 @@ def _stage_spec(name):
             protocol="PingPong", params={"node_count": 64},
             latency_model="NetworkFixedLatency(10)", seeds=(0,),
             sim_ms=240, chunk_ms=40, obs=("metrics", "audit"),
+            superstep=1),
+        # the stage SIGKILLs a whole campaign; the digested config is
+        # the crash grid's BASE cell (the memo_smoke convention)
+        "crash_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
             superstep=1),
     }
     cfg = table.get(name)

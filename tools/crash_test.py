@@ -1,0 +1,276 @@
+"""Kill-anywhere recovery harness — the crash-only serve acceptance pin.
+
+The chaos plane (PR 10) injects faults INSIDE the simulation; this
+tool injects the one fault the simulation cannot model: the serving
+process itself dying.  It runs a multi-group chaos-axis matrix
+campaign in a SUBPROCESS with the full crash-safety stack ON —
+durable submission journal + chunk-boundary group checkpoints +
+per-cell ledger rows — SIGKILLs the child at N seeded-random wall
+offsets (anywhere: mid-import, queued-but-unlaunched, mid-chunk,
+between groups), resumes after every kill, drives the final attempt
+to completion, and asserts the resulting `MatrixReport` is
+BIT-IDENTICAL to an uninterrupted run's outside the honestly
+run-local keys (wall clock, measured builds, scheduler counters,
+resume accounting) — the chaos plane's determinism discipline applied
+to the serving process.
+
+SIGKILL, not SIGTERM: nothing gets to flush, which is exactly the
+contract under test — every durable fact must already be on disk when
+the ack/boundary that promised it returned.
+
+Usage:
+    python tools/crash_test.py [--kills N] [--seed S] [--dir D]
+                               [--min-delay S] [--max-delay S] [--out P]
+    python tools/crash_test.py --child --dir D [--resume]   (internal)
+
+Exit codes: 0 bit-identical recovery, 1 divergence (diff printed),
+2 config/environment error.  The bench_suite `crash_smoke` stage runs
+`run_crash_test(kills=1)` in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import pathlib
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+#: the campaign under test — module-level like MATRIX_SMOKE_GRID so
+#: the harness, the suite stage and any consumer of its digest can
+#: never drift apart: a chaos axis (2 compile keys — one group runs
+#: under churn) x 3 seeds = 6 cells, several chunks each, driven in
+#: 2-cell waves so kills land between groups, mid-group and mid-chunk
+CRASH_GRID = {
+    "name": "crash_test",
+    "base": {"protocol": "PingPong", "params": {"node_count": 64},
+             "seeds": [0], "sim_ms": 120, "chunk_ms": 40,
+             "obs": ["metrics", "audit"]},
+    "axes": [
+        {"name": "chaos", "field": "fault_schedule",
+         "values": [{"churn": [[3, 20, 60]]}, None],
+         "labels": ["churn", "none"]},
+        {"name": "seed", "field": "seeds", "values": [[0], [1], [2]]},
+    ],
+}
+
+#: report keys that HONESTLY differ between an uninterrupted run and a
+#: kill+resume run of the same grid (run-local accounting); everything
+#: else is the bit-identity target — the tests/test_matrix.py
+#: `_norm_report` convention, shared here so the harness and the suite
+#: stage pin the same projection
+VOLATILE_KEYS = ("wall_s", "program_builds", "registry", "resilience",
+                 "resume", "memo")
+
+
+def normalize_report(rep: dict) -> dict:
+    """A report's crash-invariant projection (VOLATILE_KEYS note)."""
+    d = copy.deepcopy(rep)
+    for k in VOLATILE_KEYS:
+        d.pop(k, None)
+    for row in d.get("cells", ()):
+        row.pop("resumed_from_ms", None)
+    return d
+
+
+# ------------------------------------------------------------------ child
+
+
+def child_main(d: str, resume: bool) -> int:
+    """One campaign attempt inside the kill zone: run (or resume) the
+    grid with journal + checkpoints + ledger under `d`, then write the
+    full report atomically to ``d/report.json`` (write-temp +
+    os.replace — a kill mid-write must not leave a torn report for the
+    parent to misread)."""
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import SweepGrid, run_grid
+    from wittgenstein_tpu.serve import Scheduler
+
+    grid = SweepGrid.from_json(CRASH_GRID)
+    sch = Scheduler(ledger_path=os.path.join(d, "ledger.jsonl"),
+                    checkpoint_dir=os.path.join(d, "ck"),
+                    journal_dir=os.path.join(d, "journal"))
+    run = run_grid(grid, sch, max_wave=2, keep_states=(),
+                   resume=resume)
+    tmp = os.path.join(d, "report.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(run.report.to_json(), f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(d, "report.json"))
+    return 0 if run.report.clean else 1
+
+
+# ----------------------------------------------------------------- parent
+
+
+def _spawn(d: str, resume: bool) -> subprocess.Popen:
+    os.makedirs(d, exist_ok=True)
+    log = open(os.path.join(d, "child.log"), "a")
+    args = [sys.executable, str(pathlib.Path(__file__).resolve()),
+            "--child", "--dir", d]
+    if resume:
+        args.append("--resume")
+    return subprocess.Popen(args, stdout=log, stderr=log,
+                            cwd=str(REPO))
+
+
+def _run_to_completion(d: str, resume: bool) -> dict:
+    p = _spawn(d, resume)
+    p.wait()
+    report = os.path.join(d, "report.json")
+    if p.returncode != 0 or not os.path.exists(report):
+        raise RuntimeError(
+            f"child run in {d} failed (rc={p.returncode}); see "
+            f"{d}/child.log")
+    with open(report) as f:
+        return json.load(f)
+
+
+def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
+                   min_delay: float = 1.0,
+                   max_delay: float | None = None) -> dict:
+    """The whole harness (module docstring): reference run, N
+    SIGKILLs at seeded-random offsets with resume after each, final
+    resume to completion, normalized-report comparison.  Returns the
+    result block (``ok`` is the bit-identity verdict); raises
+    RuntimeError when a child fails outright."""
+    out = pathlib.Path(out_dir)
+    ref_dir, camp_dir = str(out / "ref"), str(out / "campaign")
+    t0 = time.time()
+    ref = _run_to_completion(ref_dir, resume=False)
+    ref_wall = time.time() - t0
+    # kill offsets span the child's working life: from early import (a
+    # kill before anything durable exists — resume must cope with
+    # empty state) into mid-campaign.  The ceiling sits at ~half the
+    # reference wall: an attempt that outlives its kill offset runs to
+    # COMPLETION, after which the remaining kills can only hit the
+    # (sub-second) all-served resume path — early offsets keep real
+    # work on the table for every kill
+    hi = max_delay if max_delay is not None else max(2.0,
+                                                     0.45 * ref_wall)
+    rng = random.Random(seed)
+    landed, early_done = 0, 0
+    for i in range(kills):
+        p = _spawn(camp_dir, resume=i > 0)
+        delay = rng.uniform(min_delay, hi)
+        t_spawn = time.time()
+        while time.time() - t_spawn < delay and p.poll() is None:
+            time.sleep(0.05)
+        if p.poll() is None:
+            os.kill(p.pid, signal.SIGKILL)
+            landed += 1
+            print(f"crash_test: kill {i + 1}/{kills} landed at "
+                  f"+{delay:.2f}s", flush=True)
+        else:
+            # the attempt finished before its kill offset: resumed
+            # children get faster (warm caches, ledger-served cells),
+            # so ADAPT the ceiling to the observed wall — later kills
+            # land inside the shrinking window (import, journal
+            # replay, ledger join are all legitimate kill points too)
+            early_done += 1
+            wall = time.time() - t_spawn
+            hi = max(min_delay + 0.5, 0.9 * wall)
+            print(f"crash_test: kill {i + 1}/{kills} missed (child "
+                  f"finished at +{wall:.2f}s < +{delay:.2f}s); "
+                  f"ceiling -> {hi:.2f}s", flush=True)
+        p.wait()
+    final = _run_to_completion(camp_dir, resume=True)
+    ok = normalize_report(final) == normalize_report(ref)
+    return {"ok": ok, "kills_requested": kills, "kills_landed": landed,
+            "kills_missed": early_done, "seed": seed,
+            "ref_wall_s": round(ref_wall, 2),
+            "cells": final.get("cells_total"),
+            "resume": final.get("resume"),
+            "grid_digest": final.get("grid_digest")}
+
+
+def _print_divergence(ref: dict, final: dict):
+    a, b = normalize_report(ref), normalize_report(final)
+    for key in sorted(set(a) | set(b)):
+        if a.get(key) != b.get(key):
+            print(f"  DIVERGENCE in {key!r}:", file=sys.stderr)
+            if key == "cells":
+                for ra, rb in zip(a.get(key, ()), b.get(key, ())):
+                    if ra != rb:
+                        print(f"    cell {ra.get('cell')}: "
+                              f"ref={json.dumps(ra, sort_keys=True)} "
+                              f"resumed={json.dumps(rb, sort_keys=True)}",
+                              file=sys.stderr)
+            else:
+                print(f"    ref={json.dumps(a.get(key), sort_keys=True)}"
+                      f" resumed="
+                      f"{json.dumps(b.get(key), sort_keys=True)}",
+                      file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/crash_test.py",
+        description="kill-anywhere recovery harness: SIGKILL a matrix "
+                    "campaign N times, resume, assert report "
+                    "bit-identity vs the uninterrupted run")
+    ap.add_argument("--kills", type=int, default=5,
+                    help="SIGKILLs before the final resume (default 5)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seed for the kill-offset draws (default 0)")
+    ap.add_argument("--dir", default=None, metavar="DIR",
+                    help="working directory (default: a temp dir)")
+    ap.add_argument("--min-delay", type=float, default=1.0,
+                    help="earliest kill offset in seconds (default 1.0 "
+                         "— lands mid-import)")
+    ap.add_argument("--max-delay", type=float, default=None,
+                    help="latest kill offset (default: 0.9 x the "
+                         "reference run's wall)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the JSON result line here")
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--resume", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        if not args.dir:
+            print("config error: --child needs --dir", file=sys.stderr)
+            return 2
+        os.makedirs(args.dir, exist_ok=True)
+        return child_main(args.dir, resume=args.resume)
+
+    if args.kills < 1:
+        print("config error: --kills must be >= 1", file=sys.stderr)
+        return 2
+    import tempfile
+    work = args.dir or tempfile.mkdtemp(prefix="wtpu-crash-")
+    try:
+        res = run_crash_test(work, kills=args.kills, seed=args.seed,
+                             min_delay=args.min_delay,
+                             max_delay=args.max_delay)
+    except RuntimeError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+    line = json.dumps({"metric": "crash_test_bit_identical",
+                       "value": int(res["ok"]), "unit": "bool", **res})
+    print(line)
+    if args.out:
+        pathlib.Path(args.out).write_text(line + "\n")
+    if not res["ok"]:
+        with open(os.path.join(work, "ref", "report.json")) as f:
+            ref = json.load(f)
+        with open(os.path.join(work, "campaign", "report.json")) as f:
+            final = json.load(f)
+        _print_divergence(ref, final)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,10 +66,18 @@ def main(argv=None) -> int:
                          "killed campaign restarts with --resume from "
                          "exactly where it died (bit-identical "
                          "continuation, spec digests verified)")
+    ap.add_argument("--journal-dir", default=None, metavar="DIR",
+                    help="durable submission journal (WAL): every "
+                         "accepted cell submit is fsync'd before ack "
+                         "and tombstoned on completion, so --resume "
+                         "recovers even cells that were queued but "
+                         "never launched when the process died")
     ap.add_argument("--resume", action="store_true",
                     help="resume a killed campaign: re-enqueue this "
                          "grid's per-group checkpoints (needs the "
-                         "interrupted run's --checkpoint-dir), serve "
+                         "interrupted run's --checkpoint-dir), replay "
+                         "the submission journal (--journal-dir, if "
+                         "the interrupted run used one), serve "
                          "finished cells from their ledger rows "
                          "(--ledger; exact config-digest matches from "
                          "other grids dedup too), and re-run only the "
@@ -136,7 +144,8 @@ def main(argv=None) -> int:
     if args.memo or args.memo_table:
         memo = {"table": args.memo_table} if args.memo_table else True
     sch = Scheduler(ledger_path=args.ledger,
-                    checkpoint_dir=args.checkpoint_dir)
+                    checkpoint_dir=args.checkpoint_dir,
+                    journal_dir=args.journal_dir)
     try:
         run = run_grid(grid, sch, plan_=mplan, max_wave=args.max_wave,
                        keep_states=tuple(spot), progress=progress,
@@ -155,7 +164,8 @@ def main(argv=None) -> int:
         print(f"resume: {r['from_ledger']} cells from this grid's "
               f"ledger rows, {r['deduped']} deduped from exact config "
               f"matches, {r['resumed_requests']} requests resumed "
-              "from checkpoints")
+              f"from checkpoints ({r.get('journal_replayed', 0)} of "
+              "them replayed from the submission journal)")
     print(report.format())
     if args.out:
         path = report.save(args.out)

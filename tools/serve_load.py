@@ -25,12 +25,16 @@ Tenant mix (weights/budgets exercise every tenancy mechanism):
   batch       — weight 2, unbounded, mixed spans.
 
 Usage: python tools/serve_load.py [--requests N] [--out PATH]
-       [--stream]
+       [--stream] [--kill-after S]
        (default 120 requests; --out writes the JSON line to a file
        as well as stdout; --stream adds the long-poll partial-metrics
        smoke check: one spec streamed boundary by boundary over
        `/w/batch/stream`-equivalent `Service.stream`, asserting one
-       delta per chunk)
+       delta per chunk; --kill-after S hard-stops the clients after S
+       seconds and reports the `/w/batch/health` snapshot taken at
+       the kill — the crash-safety observability block under real
+       load: uptime, queue depths, journal lag, quarantine count,
+       watchdog trips, chunk-wall EMA)
 """
 
 from __future__ import annotations
@@ -84,10 +88,15 @@ def tenant_specs(name: str, count: int):
     return out
 
 
-def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50):
+def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50,
+                 stop=None):
     """One tenant's client thread: submit each spec (backing off on
-    429s), poll to completion, record the submit->result wall."""
+    429s), poll to completion, record the submit->result wall.  A set
+    `stop` event (--kill-after) abandons the remaining work — the
+    hard-stop shape a killed client population actually has."""
     for spec in specs:
+        if stop is not None and stop.is_set():
+            return
         t0 = time.perf_counter()
         rid = None
         for _ in range(max_attempts):
@@ -97,6 +106,8 @@ def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50):
             except AdmissionError as e:
                 rec["rejected"] += 1
                 time.sleep(min(e.retry_after_s, 0.5))
+                if stop is not None and stop.is_set():
+                    return
         if rid is None:
             rec["gave_up"] += 1
             continue
@@ -104,6 +115,8 @@ def drive_tenant(svc, specs, rec, poll_s=0.02, max_attempts=50):
             st = svc.status(rid)
             if st["status"] in ("done", "error"):
                 break
+            if stop is not None and stop.is_set():
+                return
             time.sleep(poll_s)
         if st["status"] == "done":
             rec["done"] += 1
@@ -167,6 +180,14 @@ def main(argv=None) -> int:
     ap.add_argument("--stream", action="store_true",
                     help="add the long-poll partial-metrics smoke "
                          "check (one spec streamed chunk by chunk)")
+    ap.add_argument("--kill-after", type=float, default=None,
+                    metavar="S",
+                    help="hard-stop the client threads after S "
+                         "seconds and report the /w/batch/health "
+                         "snapshot taken at the kill (the crash-"
+                         "safety observability exercise; completion "
+                         "checks are skipped — a killed run cannot "
+                         "promise completion)")
     args = ap.parse_args(argv)
 
     per = max(1, args.requests // 3)
@@ -181,12 +202,23 @@ def main(argv=None) -> int:
                    "rejected": 0, "gave_up": 0, "lat_ms": []}
             for name in ("interactive", "campaign", "batch")}
     t0 = time.perf_counter()
+    stop = threading.Event() if args.kill_after is not None else None
     threads = [threading.Thread(target=drive_tenant,
                                 args=(svc, tenant_specs(n, per), recs[n]),
+                                kwargs={"stop": stop},
                                 name=f"load-{n}")
                for n in recs]
     for t in threads:
         t.start()
+    health_at_kill = None
+    if stop is not None:
+        # the --kill-after exercise: snapshot /w/batch/health UNDER
+        # load at the kill instant, then hard-stop the clients — the
+        # health block is what an operator's probe would have seen
+        # just before the process died
+        time.sleep(max(0.0, args.kill_after))
+        health_at_kill = svc.health()
+        stop.set()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
@@ -227,8 +259,12 @@ def main(argv=None) -> int:
         "wall_total_s": round(wall, 2),
         "tenancy": tenancy,
         "registry": reg,
+        "health": svc.health(),
         "platform": jax.default_backend(),
     }
+    if health_at_kill is not None:
+        out["killed_after_s"] = args.kill_after
+        out["health_at_kill"] = health_at_kill
     if stream_block is not None:
         out["stream"] = stream_block
     line = json.dumps(out)
@@ -238,6 +274,11 @@ def main(argv=None) -> int:
     if stream_block is not None and not stream_block["ok"]:
         print(f"STREAM smoke failed: {stream_block}", file=sys.stderr)
         return 1
+    if health_at_kill is not None:
+        # a killed run cannot promise completion: the health snapshot
+        # IS the product; starvation/error gates apply only to full
+        # runs
+        return 0
     if starved:
         print(f"STARVATION: tenant(s) {starved} did not complete their "
               "requests", file=sys.stderr)

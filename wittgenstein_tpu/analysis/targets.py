@@ -476,6 +476,60 @@ def _tenancy_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
     return t
 
 
+#: The journal build (PR 15 — crash-only serve) audited under
+#: "<name>+journal": the SAME spec-path compile as the tenancy target,
+#: submitted through a `Scheduler(journal_dir=)` whose WAL append runs
+#: BEFORE the ack.  Journaling (and the whole crash-safety ladder:
+#: quarantine bisection, watchdog deadlines) is host-side only — the
+#: zero-cost rules (carry_extra_leaves=0, transfer_ops=0) prove the
+#: compiled chunk program carries ZERO crash-safety residue, and the
+#: build asserts the replay contract's static half: the journaled spec
+#: JSON round-trips to the submitted spec's digest and compile key, so
+#: a replay re-runs EXACTLY the accepted config.
+JOURNAL_PROTOCOLS = ("PingPong",)
+JOURNAL_SUFFIX = "+journal"
+
+
+def _journal_target(name: str, seeds=SEEDS, chunk=CHUNK) -> AnalysisTarget:
+    base_name = name[:-len(JOURNAL_SUFFIX)]
+
+    def build():
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.network import scan_chunk
+        from ..serve import Scheduler
+        from ..serve.spec import ScenarioSpec
+
+        spec = ScenarioSpec(
+            protocol=base_name, params={"node_count": 64},
+            seeds=(0,), sim_ms=chunk, chunk_ms=chunk, obs=()).validate()
+        with tempfile.TemporaryDirectory() as jd:
+            sch = Scheduler(journal_dir=jd)
+            sch.submit(spec)
+            entries = sch.journal.replay()
+            assert len(entries) == 1, entries
+            stored = ScenarioSpec.from_json(entries[0]["spec"])
+            # the replay contract: the WAL row IS the accepted config
+            assert stored.digest() == spec.digest(), \
+                "journaled spec does not round-trip to the submitted " \
+                "digest (a replay would re-run a different config)"
+            assert stored.validate().compile_key() == \
+                spec.compile_key(), \
+                "journaled spec resolves to a different compile key"
+        proto = spec.build_protocol()
+        base = jax.vmap(scan_chunk(proto, chunk,
+                                   superstep=spec.superstep))
+        args = jax.vmap(proto.init)(jnp.arange(seeds, dtype=jnp.int32))
+        return base, args, proto, "vmapped+journal"
+
+    t = AnalysisTarget(name, None)
+    t._build_fn = build
+    return t
+
+
 #: The memo build (PR 14 — wittgenstein_tpu/memo) audited under
 #: "<name>+memo": the honest-prefix program a snapshot-fork campaign
 #: runs, compiled through the same grid/spec path.  Memoization is
@@ -729,6 +783,8 @@ def target_names() -> tuple:
                  sorted(f"{n}{TENANCY_SUFFIX}"
                         for n in TENANCY_PROTOCOLS) +
                  sorted(f"{n}{MEMO_SUFFIX}" for n in MEMO_PROTOCOLS) +
+                 sorted(f"{n}{JOURNAL_SUFFIX}"
+                        for n in JOURNAL_PROTOCOLS) +
                  sorted(SS_PROTOCOLS) + sorted(ROUTE_PROTOCOLS))
 
 
@@ -759,6 +815,12 @@ def get_target(name: str) -> AnalysisTarget:
                 f"unknown memo target {name!r}; known: "
                 f"{sorted(f'{n}{MEMO_SUFFIX}' for n in MEMO_PROTOCOLS)}")
         return _memo_target(name)
+    if name.endswith(JOURNAL_SUFFIX):
+        if name[:-len(JOURNAL_SUFFIX)] not in JOURNAL_PROTOCOLS:
+            raise KeyError(
+                f"unknown journal target {name!r}; known: "
+                f"{sorted(f'{n}{JOURNAL_SUFFIX}' for n in JOURNAL_PROTOCOLS)}")
+        return _journal_target(name)
     if name.endswith(CHAOS_SUFFIX):
         if name[:-len(CHAOS_SUFFIX)] not in CHAOS_PROTOCOLS:
             raise KeyError(

@@ -117,15 +117,17 @@ def _row_artifacts(row) -> dict:
 def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
     """The campaign-resume join (run_grid(resume=True)): per-group
     checkpoints re-enqueued through `Scheduler.resume_checkpoints`
-    (spec digests verified file-side) plus finished-cell ledger rows
-    keyed on the grid digest — and, for cells not in THIS grid's rows,
-    a cross-grid dedup by exact config digest.  Returns
-    ``(served, pre, counts)``: ledger-served results by cell id,
-    checkpoint-requeued (cell, rid) pairs, and the resume accounting.
-    Refuses LOUDLY (ValueError with remedy) on checkpoints from a
-    different grid or cells whose spec no longer digests to the
-    checkpointed one — silently mixing trajectories of two different
-    campaigns is the one thing resume must never do."""
+    (spec digests verified file-side), the scheduler's durable
+    submission journal replayed through `Scheduler.resume_journal`
+    (queued-but-never-launched cells survive the kill too), plus
+    finished-cell ledger rows keyed on the grid digest — and, for
+    cells not in THIS grid's rows, a cross-grid dedup by exact config
+    digest.  Returns ``(served, pre, counts)``: ledger-served results
+    by cell id, checkpoint/journal-requeued (cell, rid) pairs, and the
+    resume accounting.  Refuses LOUDLY (ValueError with remedy) on
+    checkpoints from a different grid or cells whose spec no longer
+    digests to the checkpointed one — silently mixing trajectories of
+    two different campaigns is the one thing resume must never do."""
     from ..obs import ledger as ledger_mod
 
     cells_by_id = {c.id: c for c in plan_.cells}
@@ -151,6 +153,33 @@ def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
         for key in drop_keys:
             sch.discard_checkpoint(key)
         rids = [rid for rid in rids if rid not in set(prefix_rids)]
+    # the durable submission journal: cells that were ACCEPTED but
+    # never launched (no checkpoint, no ledger row) replay here —
+    # entries a checkpoint already restored are skipped by rid inside
+    # resume_journal.  This grid's replayed CELLS are adopted below
+    # exactly like checkpoint-requeued ones (they re-run their full
+    # span from scratch, bit-identically); replayed memo-PREFIX
+    # entries are withdrawn (the fork machinery re-runs or table-hits
+    # them); entries from OTHER campaigns stay queued — they are that
+    # campaign's durable submits, and the drain completes them with
+    # their own ledger rows.
+    journal_rids = sch.resume_journal()
+    adopt, foreign = [], 0
+    for rid in journal_rids:
+        ex = sch.request(rid).ledger_extra or {}
+        if ex.get("grid_digest") == plan_.grid_digest:
+            if ex.get("memo_prefix"):
+                sch.withdraw([rid])
+            else:
+                adopt.append(rid)
+        else:
+            foreign += 1
+    if foreign:
+        import sys
+        print(f"matrix resume: {foreign} journal-replayed request(s) "
+              "belong to other campaigns; left queued for their own "
+              "resume/drain", file=sys.stderr)
+    rids = rids + adopt
     pre = []
     try:
         for rid in rids:
@@ -197,7 +226,8 @@ def _load_resume(plan_: MatrixPlan, sch: Scheduler, ledger_path):
         by_digest.setdefault(row.config_digest, row)
     served: dict = {}
     counts = {"from_ledger": 0, "deduped": 0,
-              "resumed_requests": len(pre)}
+              "resumed_requests": len(pre),
+              "journal_replayed": len(adopt)}
     for cell in plan_.cells:
         if cell.id in requeued:
             continue        # mid-flight, not finished — must re-run
@@ -301,7 +331,7 @@ def _assign_forks(forks: dict, fg, plan_: MatrixPlan, state, carries,
 
 def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
              plan_: MatrixPlan | None = None, *, ledger_path=None,
-             checkpoint_dir=None, max_wave: int = 64,
+             checkpoint_dir=None, journal_dir=None, max_wave: int = 64,
              keep_states=("*",), progress=None,
              strict_builds: bool = True,
              resume: bool = False, memo=None) -> MatrixRun:
@@ -345,7 +375,8 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
     """
     plan_ = plan_ or plan(grid)
     sch = scheduler or Scheduler(ledger_path=ledger_path,
-                                 checkpoint_dir=checkpoint_dir)
+                                 checkpoint_dir=checkpoint_dir,
+                                 journal_dir=journal_dir)
     keep_all = "*" in keep_states
     keep = set(keep_states)
     stats0 = sch.registry.stats()

@@ -155,16 +155,15 @@ def manifest_from_spec(line: dict, spec, label: str | None = None,
 
 def append(manifest: RunManifest, path=None) -> str | None:
     """Append one manifest row to the JSONL ledger (default
-    ``reports/ledger/ledger.jsonl``); returns the path written, or None
-    when the write failed (logged to stderr — provenance must never
-    kill a metric line)."""
+    ``reports/ledger/ledger.jsonl``) through the shared atomic
+    write-then-flush helper (utils/jsonl.py — one append path for
+    every append-only log in the tree); returns the path written, or
+    None when the write failed (logged to stderr — provenance must
+    never kill a metric line)."""
+    from ..utils import jsonl
     path = pathlib.Path(path) if path else LEDGER_PATH
     try:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(manifest.to_json(), sort_keys=True,
-                               default=str) + "\n")
-        return str(path)
+        return jsonl.append_line(path, manifest.to_json())
     except OSError as e:
         print(f"ledger: append failed ({e}); row dropped",
               file=sys.stderr)
@@ -210,20 +209,18 @@ def append_from_env(line: dict, label: str | None = None,
 
 
 def read_all(path=None) -> list:
-    """All ledger rows as `RunManifest`s (malformed lines skipped with
-    a stderr note — an append-only log must tolerate a torn tail)."""
+    """All ledger rows as `RunManifest`s, read through the shared
+    torn-tail-tolerant JSONL reader (utils/jsonl.py): a line torn by a
+    crash mid-append — or any malformed row — is skipped with a stderr
+    note instead of raising, so the matrix campaign resume's dedup
+    join and every other consumer survive a kill mid-`append`."""
+    from ..utils import jsonl
     path = pathlib.Path(path) if path else LEDGER_PATH
-    if not path.exists():
-        return []
     out = []
-    with open(path) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(RunManifest.from_json(json.loads(line)))
-            except (json.JSONDecodeError, TypeError) as e:
-                print(f"ledger: skipping malformed row {i}: {e}",
-                      file=sys.stderr)
+    for i, row in jsonl.iter_lines(path, label="ledger"):
+        try:
+            out.append(RunManifest.from_json(row))
+        except TypeError as e:
+            print(f"ledger: skipping malformed row {i}: {e}",
+                  file=sys.stderr)
     return out

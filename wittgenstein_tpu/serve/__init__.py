@@ -31,10 +31,18 @@ coalesced into few compiled device programs.
   `service`   — `Service`: submit/status/result surface (in-process
                 and behind `server/http.py`'s `/w/batch/*` routes)
                 streaming progress from the on-device metrics plane.
+  `journal`   — `SubmissionJournal` (PR 15): the durable submission
+                WAL behind `Scheduler(journal_dir=)` — accepted
+                submits fsync'd before ack, tombstoned on settle,
+                replayed by `resume_journal()`/`recover()`; with the
+                poison-lane quarantine and hung-launch watchdog it
+                makes serve crash-only (scheduler module docstring).
 """
 
+from .journal import SubmissionJournal  # noqa: F401
 from .registry import CompileRegistry  # noqa: F401
 from .scheduler import (AdmissionError, ForkState, Request,  # noqa: F401
-                        Scheduler, StaleCheckpointError, TenantPolicy)
+                        Scheduler, StaleCheckpointError, TenantPolicy,
+                        WatchdogTimeout)
 from .service import Service  # noqa: F401
 from .spec import ENGINES, OBS_PLANES, ScenarioSpec  # noqa: F401

@@ -1,0 +1,150 @@
+"""Durable submission journal — the serve plane's write-ahead log.
+
+The PR-10/13 checkpoint machinery makes a RUNNING group survivable: a
+kill mid-chunk resumes from the last chunk boundary.  What it cannot
+cover is the window this module exists for — a request that was
+ACCEPTED but had not launched when the process died.  Its spec lived
+only in the scheduler's in-memory queue, so the client holds an ack
+for work that no longer exists anywhere.
+
+`SubmissionJournal` closes that window with the classic WAL shape:
+
+  * `record_submit` appends the accepted request (canonical spec JSON
+    + rid + label/ledger_extra — everything `Scheduler.submit` was
+    handed) to an append-only JSONL file and fsyncs BEFORE the submit
+    acks.  An ack therefore implies a durable record; a journal write
+    failure fails the submit loudly instead of promising durability
+    the disk refused.
+  * `record_settled` appends a tombstone when the request COMPLETES
+    (done), is QUARANTINED (a deterministic poison verdict — re-running
+    it would only re-quarantine) or is WITHDRAWN.  A generic group
+    error is deliberately NOT tombstoned: it is presumed transient
+    (dead device), and the crash-only contract is redo-beats-lose —
+    those entries replay on the next recovery.  Tombstones are appends
+    too — the journal is never edited in place, so a crash at ANY byte
+    offset leaves at worst one torn tail line.
+  * `replay` returns the un-tombstoned submit entries in submission
+    order, reading through the shared torn-tail-tolerant JSONL reader
+    (utils/jsonl.py): a line torn by the kill is skipped with a loud
+    stderr note (one in-flight row, already un-acked), never raised.
+  * `compact` atomically rewrites the file down to the live entries —
+    `Scheduler.resume_journal` runs it after a replay so the journal's
+    size tracks the live queue, not the service's lifetime.
+
+The journal stores SPECS, not states: a replayed request re-runs from
+scratch (bit-identical — the engine is a deterministic pure function
+of the spec), and a request that ALSO left a group checkpoint resumes
+from the checkpoint instead (`Scheduler.recover` orders the two).  A
+memo snapshot-fork submission is journaled as its plain full-span
+spec: the fork state died with the process, and an unforked re-run is
+bit-identical by the fork contract — the fork provenance is dropped
+on replay so the re-run's ledger row never claims a fork it didn't
+take.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils import jsonl
+
+#: journal entry schema (bump on field changes; replay keys on it)
+SCHEMA = 1
+
+#: the journal file inside `journal_dir` (one per scheduler)
+FILENAME = "submissions.jsonl"
+
+
+class SubmissionJournal:
+    """One scheduler's WAL (module docstring)."""
+
+    def __init__(self, journal_dir):
+        self.dir = str(journal_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, FILENAME)
+        #: one lock serializes every file operation (append, replay,
+        #: compact): a reader can never observe a half-written line
+        #: from a concurrent in-process append (no false torn-tail
+        #: warnings from `lag()` health polls), and compaction can
+        #: never rewrite the file from a stale snapshot and erase a
+        #: row appended since — the journal is per-scheduler, so
+        #: in-process exclusion is the whole story
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------ appends
+
+    def record_submit(self, rid: str, spec, label=None,
+                      ledger_extra=None) -> None:
+        """Durably record one accepted submission (fsync'd — this runs
+        BEFORE the submit acks).  Raises OSError through: the caller
+        must not ack a request the journal could not hold."""
+        with self._mu:
+            jsonl.append_line(self.path, {
+                "schema": SCHEMA, "kind": "submit", "rid": rid,
+                "spec": spec.to_json(), "label": label,
+                "ledger_extra": dict(ledger_extra) if ledger_extra
+                else None,
+                "ts_unix": time.time()}, fsync=True)
+
+    def record_settled(self, rid: str, status: str) -> None:
+        """Tombstone a settled request (done/quarantined/withdrawn —
+        module docstring; transient group errors stay replayable).
+        Never raises — a tombstone lost to a full disk costs one
+        redundant (bit-identical) re-run on the next replay, which is
+        the crash-only trade: redo beats lose."""
+        import sys
+        try:
+            with self._mu:
+                jsonl.append_line(self.path, {
+                    "schema": SCHEMA, "kind": "tombstone", "rid": rid,
+                    "status": status, "ts_unix": time.time()})
+        except OSError as e:
+            print(f"journal: tombstone append failed for {rid} ({e}); "
+                  "the entry replays once more on the next resume",
+                  file=sys.stderr)
+
+    # ------------------------------------------------------------- replay
+
+    def _replay_locked(self) -> list:
+        live: dict = {}
+        for _, row in jsonl.iter_lines(self.path, label="journal"):
+            kind, rid = row.get("kind"), row.get("rid")
+            if not rid:
+                continue
+            if kind == "submit" and row.get("schema") == SCHEMA:
+                live.setdefault(rid, row)
+            elif kind == "tombstone":
+                live.pop(rid, None)
+        return list(live.values())
+
+    def replay(self) -> list:
+        """The un-tombstoned submit entries, in submission order (the
+        crash's survivors).  Torn/malformed lines are skipped loudly by
+        the shared reader; a tombstone whose submit line is missing
+        (or torn) is simply inert."""
+        with self._mu:
+            return self._replay_locked()
+
+    def lag(self) -> int:
+        """Entries accepted but not yet tombstoned — the health
+        endpoint's "journal lag" number (0 = every acked request has
+        settled)."""
+        return len(self.replay())
+
+    def compact(self) -> None:
+        """Atomically rewrite the journal down to its CURRENT live
+        entries — recomputed under the lock at rewrite time, so a
+        submit or tombstone appended after an earlier `replay()`
+        snapshot can never be erased (the fsync-before-ack promise
+        survives compaction on a live scheduler).  Crash-safe via
+        write-temp + os.replace; a failure leaves the uncompacted
+        (still correct) file."""
+        import sys
+        try:
+            with self._mu:
+                jsonl.rewrite(self.path, self._replay_locked())
+        except OSError as e:
+            print(f"journal: compaction failed ({e}); the uncompacted "
+                  "journal remains valid", file=sys.stderr)

@@ -105,6 +105,50 @@ request's primary-pass totals (and their per-chunk DELTA) to
 `Request.chunk_totals` and notifies a condition variable;
 `stream_chunks` long-polls it — the `/w/batch/stream/{id}` endpoint
 blocks until the next boundary and returns the new per-chunk deltas.
+A stream always TERMINATES: settling a request any way at all (done,
+error, quarantined, withdrawn) notifies the boundary condition, so a
+long-poll on a failed request returns its final error/quarantined
+record instead of hanging until the client timeout.
+
+Crash-only serve (PR 15): every failure mode either recovers
+bit-identically or is isolated to exactly the request that caused it.
+
+  * Durable submission journal: `Scheduler(journal_dir=)` appends
+    every ACCEPTED submit (canonical spec JSON + rid + label/
+    ledger_extra) to an append-only JSONL WAL — fsync'd BEFORE the
+    submit acks — and tombstones it when the request completes, is
+    quarantined or is withdrawn (serve/journal.py; transient group
+    errors stay replayable).  `resume_journal()` replays un-tombstoned
+    entries after a crash; composed with `resume_checkpoints()` (use
+    `recover()`, which orders them) a kill at ANY point — queued,
+    mid-chunk, between groups — loses nothing: checkpointed groups
+    resume from their chunk boundary, queued-but-unlaunched requests
+    re-run from their journaled specs, and both continuations are
+    bit-identical (deterministic pure engine).  Torn tail lines are
+    tolerated loudly (utils/jsonl.py).
+  * Poison-lane quarantine: when `_launch` exhausts retries and width
+    degradation still fails, the halving recursion bottoms out at ONE
+    lane (log2 launches — the bisection IS the degradation tree) and
+    that request alone is QUARANTINED: status error with a
+    `quarantined` artifact, its own ledger row, a per-tenant stat and
+    a journal tombstone — while every coalesced neighbor completes
+    bit-identically to a solo run (per-lane trajectories never depend
+    on batch neighbors; tests/test_serve_resilience.py pins it with a
+    deterministic always-fails-for-one-lane launcher).  A launch where
+    EVERY lane fails is a dead device, not poison (a bisection that
+    eliminates everything isolated nothing): it keeps the PR-10
+    group-failure semantics — error + RETAINED group checkpoint, so a
+    recovered device resumes mid-run work.
+  * Hung-launch watchdog: with `watchdog_factor` set, every launch
+    gets a wall deadline of max(`watchdog_floor_s`, factor x the
+    PR-13 chunk-wall EMA) — the floor covers cold compiles.  A launch
+    past deadline is ABANDONED on its daemon worker thread and
+    surfaces as a `WatchdogTimeout` failure into the existing
+    retry -> degrade -> quarantine ladder, so a wedged device stalls
+    one group (at worst one request) — the drain loop's waits are
+    bounded by the deadline per launch attempt, never by the hang, and
+    only the top-level attempt retries a timeout (bisection subsets of
+    a wedged device would all time out identically).
 """
 
 from __future__ import annotations
@@ -150,6 +194,14 @@ class TenantPolicy:
         if self.max_queued < 0 or self.retry_after_s < 0:
             raise ValueError("TenantPolicy: max_queued and "
                              "retry_after_s must be >= 0")
+
+
+class WatchdogTimeout(RuntimeError):
+    """A device-program launch abandoned past its per-chunk wall
+    deadline (module docstring).  The launch may still complete on its
+    abandoned worker thread; its result is discarded — the retried
+    launch recomputes the identical chunk (pure function), so the
+    trajectory stays bit-identical."""
 
 
 class StaleCheckpointError(ValueError):
@@ -314,7 +366,9 @@ class Scheduler:
                  retry_backoff_s: float = 0.05, checkpoint_dir=None,
                  tenants: dict | None = None,
                  quantum_chunks: int | None = None,
-                 freeze: bool | None = None):
+                 freeze: bool | None = None, journal_dir=None,
+                 watchdog_factor: float | None = None,
+                 watchdog_floor_s: float = 30.0):
         self.registry = registry or CompileRegistry()
         self.ledger_path = ledger_path      # None = the shared default
         #: the device-program launch seam: ``launcher(fn, *args)``
@@ -327,6 +381,20 @@ class Scheduler:
         self.retry_backoff_s = float(retry_backoff_s)
         #: directory for chunk-boundary group checkpoints (None = off)
         self.checkpoint_dir = checkpoint_dir
+        #: durable submission journal (None = off): every accepted
+        #: submit is WAL'd before ack, settled requests are
+        #: tombstoned, `resume_journal()` replays the survivors
+        if journal_dir:
+            from .journal import SubmissionJournal
+            self.journal = SubmissionJournal(journal_dir)
+        else:
+            self.journal = None
+        #: hung-launch watchdog (None = off): per-launch wall deadline
+        #: = max(floor, factor x chunk_wall_ema_s); the floor alone
+        #: applies while the EMA is cold (first chunk = compile time)
+        self.watchdog_factor = (None if watchdog_factor is None
+                                else float(watchdog_factor))
+        self.watchdog_floor_s = float(watchdog_floor_s)
         #: tenancy: tenant name -> `TenantPolicy` (plain dicts accepted
         #: for JSON-authored configs; "*" sets the default policy).
         #: Empty = the single-tenant PR-7 behavior: FIFO within the top
@@ -354,7 +422,11 @@ class Scheduler:
         self._tstats: dict = {}
         #: resilience accounting, surfaced in per-request artifacts
         self.resilience = {"retries": 0, "demotions": 0, "resumed": 0,
-                           "preemptions": 0, "rejected": 0}
+                           "preemptions": 0, "rejected": 0,
+                           "quarantined": 0, "watchdog_trips": 0,
+                           "replayed": 0}
+        #: scheduler birth time — the health endpoint's uptime anchor
+        self._t0 = time.time()
         #: fixed-point lane freezing (memo/freeze.py); None defers to
         #: the WTPU_MEMO env flag so an operator can flip a deployed
         #: service without touching code
@@ -410,7 +482,7 @@ class Scheduler:
                     break
         return self._tstats.setdefault(
             tenant, {"submitted": 0, "rejected": 0, "done": 0,
-                     "errors": 0, "preemptions": 0})
+                     "errors": 0, "preemptions": 0, "quarantined": 0})
 
     def _admit(self, spec: ScenarioSpec):
         """Refuse an over-budget submission with a retry-after remedy
@@ -474,7 +546,12 @@ class Scheduler:
                "next_after_ms": fresh[-1]["t_ms"] if fresh else after,
                "eof": status in ("done", "error") and not fresh}
         if status == "error" and req.error:
+            # the stream TERMINATES with the final failure record — a
+            # failed/quarantined request must never leave its client
+            # long-polling until timeout (module docstring)
             out["error"] = req.error
+            if (req.artifacts or {}).get("quarantined"):
+                out["quarantined"] = True
         return out
 
     def tenancy_stats(self) -> dict:
@@ -578,6 +655,30 @@ class Scheduler:
                 self.memo["forked"] += 1
             self._requests[rid] = req
             self._queue.append(rid)
+            if self.journal is not None:
+                # the WAL write precedes the ack BY CONSTRUCTION: a
+                # journal failure un-accepts the request — promising
+                # durability the disk refused would be worse than a
+                # loud 500.  The append+fsync deliberately runs under
+                # the scheduler lock: releasing first would let the
+                # drain launch (or even finalize) the request before
+                # its submit row exists — a tombstone-before-submit
+                # ordering the replay would mis-resurrect.  The cost
+                # is one fsync of lock hold per submit; the journal
+                # is an explicit opt-in for deployments that want
+                # durability over submit throughput.
+                try:
+                    self.journal.record_submit(
+                        rid, spec, label=label,
+                        ledger_extra=req.ledger_extra)
+                except OSError as e:
+                    self._queue.remove(rid)
+                    del self._requests[rid]
+                    raise RuntimeError(
+                        f"serve: submission journal append failed "
+                        f"({e}); request NOT accepted — fix the "
+                        f"journal_dir volume or disable journaling"
+                    ) from e
         return rid
 
     def request(self, rid: str) -> Request:
@@ -597,16 +698,23 @@ class Scheduler:
         fails validation, the earlier files' re-enqueued requests must
         not be left orphaned on a shared scheduler — they would run
         with no harvester."""
+        gone = []
         with self._mu:
-            n = 0
             for rid in rids:
                 req = self._requests.get(rid)
                 if req is not None and req.status == "queued":
                     if rid in self._queue:
                         self._queue.remove(rid)
                     del self._requests[rid]
-                    n += 1
-            return n
+                    gone.append(rid)
+            # a long-poll streaming a withdrawn id must terminate NOW
+            # (it re-checks membership on wake and raises the 400),
+            # not at its client timeout
+            self._boundary.notify_all()
+        if self.journal is not None:
+            for rid in gone:
+                self.journal.record_settled(rid, "withdrawn")
+        return len(gone)
 
     # -------------------------------------------------------------- drain
 
@@ -710,6 +818,12 @@ class Scheduler:
                     req.status, req.error = "error", msg
                     self._tstat(req.spec.tenant)["errors"] += 1
             self._boundary.notify_all()     # wake stream long-polls
+        # deliberately NO journal tombstone: a group failure is
+        # presumed transient (dead device, wedged runtime) — the
+        # journal's crash-only contract is redo-beats-lose, so these
+        # entries REPLAY on the next recovery.  Only a completed,
+        # quarantined (deterministic verdict) or withdrawn request
+        # tombstones.
 
     # ----------------------------------------------------------- grouping
 
@@ -798,36 +912,195 @@ class Scheduler:
             out.append(jax.tree.map(cat, a[-1], b[-1]))
         return tuple(out)
 
-    def _launch(self, fn, entry, widths, engine: str, has_plane: bool):
-        """Run one chunk program with retry-with-backoff and batch-width
-        degradation (module docstring).  `entry` is the concatenated
-        (net, pstate) batch; `widths` the per-lane seed counts — the
-        only legal split points (a lane's seeds stay together so carry
-        slicing by lane offset keeps working)."""
+    def launch_deadline_s(self) -> float | None:
+        """The watchdog's per-launch wall deadline (None = watchdog
+        off): max(floor, factor x chunk-wall EMA); the floor alone
+        while the EMA is cold, so a first-chunk compile is never
+        mistaken for a hang."""
+        if self.watchdog_factor is None:
+            return None
+        if not self.chunk_wall_ema_s:
+            return self.watchdog_floor_s
+        return max(self.watchdog_floor_s,
+                   self.watchdog_factor * self.chunk_wall_ema_s)
+
+    def _call_bounded(self, call, fn, entry):
+        """One launch attempt under the watchdog deadline (module
+        docstring).  Past deadline the worker thread is ABANDONED
+        (daemon — its late result is discarded; the retried launch
+        recomputes the identical pure-function chunk) and the hang
+        surfaces as a `WatchdogTimeout` failure into the retry ->
+        degrade -> quarantine ladder, so the drain loop's wait is
+        bounded by the deadline, never by the wedged call."""
+        deadline = self.launch_deadline_s()
+        if deadline is None:
+            return call(fn, *entry)
+        box: dict = {}
+        settled = threading.Event()
+
+        def work():
+            try:
+                box["out"] = call(fn, *entry)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+            finally:
+                settled.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="wtpu-launch")
+        t.start()
+        if not settled.wait(deadline):
+            self.resilience["watchdog_trips"] += 1
+            raise WatchdogTimeout(
+                f"launch exceeded its {deadline:.2f}s wall deadline "
+                f"(chunk-wall EMA {self.chunk_wall_ema_s:.3f}s x "
+                f"factor {self.watchdog_factor}, floor "
+                f"{self.watchdog_floor_s}s); abandoned on its worker "
+                "thread and fed to the retry->degrade->quarantine "
+                "ladder")
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def _try_launch(self, fn, entry, retry_timeouts: bool = True):
+        """One width level of the resilience ladder: retry-with-backoff
+        around the (watchdog-bounded) launch; raises the last failure
+        once retries are exhausted.  `retry_timeouts=False` (the
+        bisection's inner nodes) gives a `WatchdogTimeout` ONE attempt:
+        a transient hang earns its retries at full width, but once the
+        ladder is bisecting a wedged device every subset would time out
+        identically — re-retrying each one would multiply the total
+        stall by (max_retries+1) for no information."""
         call = self.launcher or (lambda f, *a: f(*a))
         last = None
         for attempt in range(self.max_retries + 1):
             try:
-                return call(fn, *entry)
+                return self._call_bounded(call, fn, entry)
             except Exception as e:      # noqa: BLE001 — retry any launch
                 last = e
+                if isinstance(e, WatchdogTimeout) and not retry_timeouts:
+                    break
                 if attempt < self.max_retries:
                     self.resilience["retries"] += 1
                     if self.retry_backoff_s:
                         time.sleep(self.retry_backoff_s * (2 ** attempt))
-        if len(widths) > 1:
+        raise last
+
+    def _launch(self, fn, entry, widths, engine: str, has_plane: bool,
+                _nested: bool = False):
+        """Run one chunk program through the full resilience ladder:
+        retry-with-backoff (+ watchdog), then batch-width degradation,
+        then poison-lane quarantine (module docstring).  `entry` is
+        the concatenated (net, pstate) batch; `widths` the per-lane
+        seed counts — the only legal split points (a lane's seeds stay
+        together so carry slicing by lane offset keeps working).
+
+        Returns ``(out, lane_errors)``: `lane_errors` has one entry
+        per lane (None = healthy); `out` is the chunk result for the
+        healthy lanes ONLY, concatenated in lane order (None when every
+        lane failed).  The halving recursion IS the bisection —
+        isolating one poison lane among 2^k costs log2 launches, and
+        every healthy lane's result comes from a launch that ran it
+        (possibly at reduced width — bit-identical per lane, since a
+        lane's trajectory never depends on its batch neighbors).
+
+        Wedged-device bound: only the TOP-level attempt retries a
+        `WatchdogTimeout` (`_try_launch(retry_timeouts=)`), so a fully
+        hung device costs at most (max_retries + deadline-per-
+        bisection-node) deadlines before the all-lanes-failed
+        dead-device raise — bounded per launch attempt, never by the
+        hang itself."""
+        try:
+            return (self._try_launch(fn, entry,
+                                     retry_timeouts=not _nested),
+                    [None] * len(widths))
+        except Exception as e:      # noqa: BLE001 — the ladder continues
+            if len(widths) == 1:
+                # bottom of the bisection: exactly this lane is the
+                # poison — the caller quarantines its request alone
+                return None, [e]
             # graceful degradation: halve the lane batch and run the
             # halves sequentially instead of dropping the requests
             self.resilience["demotions"] += 1
             mid = len(widths) // 2
             w_left = int(sum(widths[:mid]))
             left, right = self._split_state(entry, w_left)
-            out_l = self._launch(fn, left, widths[:mid], engine,
-                                 has_plane)
-            out_r = self._launch(fn, right, widths[mid:], engine,
-                                 has_plane)
-            return self._combine(out_l, out_r, engine, has_plane)
-        raise last
+            out_l, err_l = self._launch(fn, left, widths[:mid], engine,
+                                        has_plane, _nested=True)
+            out_r, err_r = self._launch(fn, right, widths[mid:],
+                                        engine, has_plane,
+                                        _nested=True)
+            errs = err_l + err_r
+            if out_l is None:
+                return out_r, errs
+            if out_r is None:
+                return out_l, errs
+            return self._combine(out_l, out_r, engine, has_plane), errs
+
+    def _quarantine(self, ln: _Lane, err: Exception):
+        """Settle ONE poison request (module docstring): status error
+        with a `quarantined` artifact, its own ledger row (extra
+        carries `quarantined` + the chunk boundary it died at), a
+        per-tenant stat and a journal tombstone — its coalesced
+        neighbors keep running untouched."""
+        req = ln.req
+        spec = req.spec
+        requested = req.requested or spec
+        msg = (f"quarantined: the lane bisection isolated this request "
+               f"after retry+width-degradation failed — "
+               f"{type(err).__name__}: {err!s:.300}")
+        art = {"request": req.id, "compile_key": req.compile_key,
+               "quarantined": True, "error": msg,
+               "spec_digest": requested.digest(),
+               "spec": requested.to_json(),
+               "seeds": list(spec.seeds), "sim_ms": spec.sim_ms,
+               "tenant": spec.tenant, "progress_ms": req.progress_ms}
+        line = {"metric": f"serve_{req.id}", "sim_ms": spec.sim_ms,
+                "superstep": spec.superstep, "batch": len(spec.seeds),
+                "quarantined": True}
+        req.ledger_extra = {**(req.ledger_extra or {}),
+                            "quarantined": True,
+                            "quarantined_at_ms": req.progress_ms}
+        path = self._append_ledger(req, line)
+        with self._mu:
+            self.resilience["quarantined"] += 1
+            st = self._tstat(spec.tenant)
+            st["quarantined"] = st.get("quarantined", 0) + 1
+            st["errors"] += 1
+            req.artifacts = art
+            req.status, req.error = "error", msg
+            req.finished = time.time()
+            req.manifest_path = path
+            self._evict_old_done()
+            # the stream long-poll must terminate with this final
+            # quarantined record, not hang until its client timeout
+            self._boundary.notify_all()
+        if self.journal is not None:
+            self.journal.record_settled(req.id, "quarantined")
+        import sys
+        print(f"serve: QUARANTINED request {req.id} "
+              f"({spec.tenant}/{req.label or 'serve'}): {msg}",
+              file=sys.stderr)
+
+    def _quarantine_failed(self, lanes: list, lane_errors: list,
+                           *trees):
+        """Quarantine every lane with a recorded error and narrow the
+        given state trees (seed axis) to the survivors.  Returns
+        ``(surviving_lanes, *narrowed_trees)`` (trees become None when
+        no lane survives)."""
+        offsets = np.cumsum([0] + [ln.width for ln in lanes])
+        keep_lanes, keep_idx = [], []
+        for ln, lo, err in zip(lanes, offsets, lane_errors):
+            if err is None:
+                keep_lanes.append(ln)
+                keep_idx.extend(range(int(lo), int(lo) + ln.width))
+            else:
+                self._quarantine(ln, err)
+        narrowed = tuple(
+            self._take_lanes(t, keep_idx) if keep_lanes and t is not None
+            else None
+            for t in trees)
+        return (keep_lanes, *narrowed)
 
     # -------------------------------------------------------- checkpoints
 
@@ -963,6 +1236,107 @@ class Scheduler:
             self.resilience["resumed"] += len(rids)
         return rids
 
+    # ------------------------------------------------------------ journal
+
+    def resume_journal(self) -> list:
+        """Replay the durable submission journal (module docstring):
+        every un-tombstoned entry re-enters the queue from its
+        journaled spec, with its ORIGINAL request id, label and
+        ledger_extra.  Run AFTER `resume_checkpoints()` — `recover()`
+        orders the two — so a request that ALSO left a group
+        checkpoint resumes from the checkpoint (its rid is already
+        live here and the journal entry is skipped), never re-run from
+        scratch.  A second replay is a no-op: duplicate rids are
+        refused with a stderr note.  Finishes by compacting the
+        journal down to the live entries.  Returns the re-enqueued
+        request ids."""
+        if self.journal is None:
+            return []
+        import sys
+        entries = self.journal.replay()
+        rids = []
+        with self._mu:
+            for e in entries:
+                rid = e.get("rid")
+                if rid in self._requests:
+                    # already live — resumed from its checkpoint, or a
+                    # double replay: refuse the duplicate (re-running
+                    # a live request would fork its identity)
+                    print(f"serve: journal entry {rid} is already "
+                          "live (checkpoint-resumed or double "
+                          "replay); refused", file=sys.stderr)
+                    continue
+                try:
+                    spec = ScenarioSpec.from_json(e["spec"])
+                    resolved = spec.validate()
+                except (KeyError, ValueError, TypeError) as err:
+                    print(f"serve: journal entry {rid} no longer "
+                          f"validates ({err!s:.200}); skipped — the "
+                          "request must be re-submitted under the "
+                          "current tree", file=sys.stderr)
+                    continue
+                extra = dict(e.get("ledger_extra") or {})
+                # a replayed request re-runs its FULL span (the fork
+                # state died with the process — unforked is
+                # bit-identical): the provenance must not claim a
+                # fork the re-run didn't take
+                extra.pop("forked_from", None)
+                req = Request(id=rid, spec=resolved,
+                              compile_key=resolved.compile_key(),
+                              requested=spec, label=e.get("label"),
+                              ledger_extra=extra or None)
+                self._requests[rid] = req
+                self._queue.append(rid)
+                rids.append(rid)
+            self.resilience["replayed"] += len(rids)
+        self.journal.compact()
+        return rids
+
+    def recover(self) -> dict:
+        """Crash-only restart, one call: checkpoints first (mid-run
+        groups restore their chunk-boundary state under their original
+        ids), then the journal (queued-but-unlaunched submits replay
+        from their specs; entries a checkpoint already restored are
+        skipped by rid).  Returns the two request-id lists.  Drive
+        with `run_pending()` (or the service worker) afterwards."""
+        return {"checkpoints": self.resume_checkpoints(),
+                "journal": self.resume_journal()}
+
+    # -------------------------------------------------------------- health
+
+    def health_stats(self) -> dict:
+        """The `/w/batch/health` block: uptime, per-tenant queue
+        depths, journal lag (accepted-but-unsettled entries),
+        quarantine count, watchdog trips and the chunk-wall EMA — the
+        numbers an operator needs to decide whether a serve process is
+        healthy, wedged, or bleeding requests."""
+        # journal lag reads the WAL file — outside the lock (IO)
+        lag = self.journal.lag() if self.journal is not None else None
+        deadline = self.launch_deadline_s()
+        with self._mu:
+            queued: dict = {}
+            running = 0
+            for r in self._requests.values():
+                if r.status == "queued":
+                    queued[r.spec.tenant] = queued.get(r.spec.tenant,
+                                                       0) + 1
+                elif r.status == "running":
+                    running += 1
+            return {"uptime_s": round(time.time() - self._t0, 3),
+                    "queued": sum(queued.values()),
+                    "queued_by_tenant": queued,
+                    "running": running,
+                    "journal": self.journal is not None,
+                    "journal_lag": lag,
+                    "quarantined": self.resilience["quarantined"],
+                    "watchdog_trips": self.resilience["watchdog_trips"],
+                    "watchdog_deadline_s": (round(deadline, 3)
+                                            if deadline is not None
+                                            else None),
+                    "chunk_wall_ema_s": round(self.chunk_wall_ema_s, 4),
+                    "resilience": dict(self.resilience),
+                    "draining": self._draining}
+
     # --------------------------------------------------------- preemption
 
     def _waiting_elsewhere(self, key: str, engine: str) -> list:
@@ -1085,8 +1459,25 @@ class Scheduler:
             entry = state
             widths = [ln.width for ln in lanes]
             t_chunk = time.time()
-            out = self._launch(fn, entry, widths, spec0.engine,
-                               primary is not None)
+            out, lane_errs = self._launch(fn, entry, widths,
+                                          spec0.engine,
+                                          primary is not None)
+            if out is None:
+                # EVERY lane failed: that is a dead device, not a
+                # poison verdict (a bisection that eliminates
+                # everything isolated nothing) — keep the PR-10
+                # group-failure semantics: raise into _fail_group,
+                # group checkpoint RETAINED for a later resume
+                raise lane_errs[0]
+            if any(e is not None for e in lane_errs):
+                # poison-lane quarantine: a lane that failed while its
+                # batch siblings succeeded is the poison — it settles
+                # alone; `out` already covers the survivors — narrow
+                # `entry` to match (the shadow passes below must run
+                # the identical surviving batch)
+                lanes, entry = self._quarantine_failed(
+                    lanes, lane_errs, entry)
+                widths = [ln.width for ln in lanes]
             state = (out[0], out[1])
             if spec0.engine == "fast_forward":
                 st = out[2]
@@ -1099,8 +1490,22 @@ class Scheduler:
                 for ln, lo in zip(lanes, offsets):
                     ln.stash(primary, out[-1], int(lo))
             for plane, sfn in shadow_fns:
-                sout = self._launch(sfn, entry, widths, spec0.engine,
-                                    True)
+                sout, serrs = self._launch(sfn, entry, widths,
+                                           spec0.engine, True)
+                if sout is None:
+                    # whole-batch shadow failure = dead device, like
+                    # the primary case above
+                    raise serrs[0]
+                if any(e is not None for e in serrs):
+                    # a lane poisoning only its SHADOW pass is
+                    # quarantined too: its state advanced but the
+                    # plane carry is unrecoverable, and an artifact
+                    # silently missing a requested plane would lie
+                    lanes, state, entry = self._quarantine_failed(
+                        lanes, serrs, state, entry)
+                    widths = [ln.width for ln in lanes]
+                    offsets = np.cumsum([0] + [ln.width
+                                               for ln in lanes])
                 for ln, lo in zip(lanes, offsets):
                     ln.stash(plane, sout[-1], int(lo))
             # snapshots force a device sync — compute them OUTSIDE the
@@ -1385,6 +1790,8 @@ class Scheduler:
             req.status = "done"
             self._evict_old_done()
             self._boundary.notify_all()     # wake stream long-polls
+        if self.journal is not None:
+            self.journal.record_settled(req.id, "done")
 
     def _evict_old_done(self):
         """Drop the oldest finished records past `keep_done` (caller

@@ -152,6 +152,23 @@ class Service:
         freeze flag)."""
         return self.scheduler.memo_stats()
 
+    def health(self) -> dict:
+        """GET /w/batch/health — the crash-safety observability block:
+        uptime, per-tenant queue depths, journal lag (accepted but
+        unsettled submissions), quarantine count, watchdog trips, and
+        the last-chunk wall EMA (Scheduler.health_stats)."""
+        return self.scheduler.health_stats()
+
+    def recover(self) -> dict:
+        """Crash-only restart seam: replay group checkpoints, then the
+        submission journal (`Scheduler.recover`), and — in auto mode —
+        kick the worker so the survivors drain immediately."""
+        out = self.scheduler.recover()
+        if self._auto and (out["checkpoints"] or out["journal"]):
+            self._ensure_worker()
+            self._wake.set()
+        return out
+
     def stream(self, rid: str, after_ms=None, timeout_s=25.0) -> dict:
         """GET /w/batch/stream/{id}[?after=MS&timeout=S] — long-poll
         streaming partial metrics: blocks until the request crosses a

@@ -36,6 +36,10 @@ service"; spec schema in serve/spec.py):
     GET  /w/batch/registry                 compile-registry hit/miss
     GET  /w/batch/tenancy                  per-tenant queue/fairness stats
     GET  /w/batch/memo                     fork/freeze memo stats
+    GET  /w/batch/health                   crash-safety health: uptime,
+                                           queue depths, journal lag,
+                                           quarantine count, watchdog
+                                           trips, chunk-wall EMA
     GET  /w/batch/stream/{id}              long-poll: blocks until the
                                            next chunk boundary, returns
                                            per-chunk totals + deltas
@@ -143,6 +147,10 @@ class _Handler(BaseHTTPRequestHandler):
          lambda s, m, b: s.batch.tenancy_stats()),
         ("GET", r"^/w/batch/memo$",
          lambda s, m, b: s.batch.memo_stats()),
+        # crash-safety observability: uptime, queue depths, journal
+        # lag, quarantine count, watchdog trips (Service.health)
+        ("GET", r"^/w/batch/health$",
+         lambda s, m, b: s.batch.health()),
         # long-poll partial-metrics stream (?after=MS&timeout=S) —
         # lock-free like every batch route, and REQUIRED to be: the
         # poll blocks for seconds by design
@@ -173,6 +181,7 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/registry$",
         r"^/w/batch/tenancy$",
         r"^/w/batch/memo$",
+        r"^/w/batch/health$",
         r"^/w/batch/stream/([A-Za-z0-9_-]+)(?:\?(.*))?$",
         r"^/w/matrix/submit$",
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
