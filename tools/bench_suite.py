@@ -14,7 +14,9 @@ memo_smoke (PR 14 — snapshot-fork prefix sharing bit-identical to the
 unmemoized run, prefix_chunks_saved == the fork plan's prediction) and
 crash_smoke (PR 15 — one real SIGKILL of a subprocess campaign,
 journal+checkpoint resume, report bit-identity asserted, plus the
-/w/batch/health round trip over real HTTP).
+/w/batch/health round trip over real HTTP) and analysis_smoke (PR 16
+— the full `--source` static-analysis pass as a subprocess, budgets
+enforced, wall time under 60 s).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
@@ -726,6 +728,41 @@ def bench_crash_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_analysis_smoke():
+    """Host-plane static-analysis smoke stage (ISSUE 16): the full
+    ``--source`` pass (determinism + host_locks/durability/digest/
+    except against the checked-in budgets) as a SUBPROCESS — the same
+    invocation CI and pre-commit hooks use, so a budget regression or
+    a rule crash fails this stage, not just the test suite.  The
+    metric is the scan's wall time (BENCH_NOTES.md pins it well under
+    the 60 s smoke bound)."""
+    import os
+    import subprocess
+    import tempfile
+    import time
+
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "report.json")
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "wittgenstein_tpu.analysis",
+             "--source", "--json", out],
+            cwd=str(REPO), capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        wall = time.monotonic() - t0
+        assert proc.returncode == 0, \
+            f"--source analysis failed:\n{proc.stdout}{proc.stderr}"
+        with open(out) as fh:
+            payload = json.load(fh)
+    assert payload["ok"], payload
+    assert wall < 60.0, f"source scan took {wall:.1f}s (budget 60s)"
+    return {"metric": "analysis_smoke_wall_s",
+            "value": round(wall, 2), "unit": "s",
+            "schema": payload["schema"], "rules": payload["rules"],
+            "n_findings": len(payload["findings"]),
+            "platform": "cpu"}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -739,6 +776,7 @@ CONFIGS = {
     "tenancy_smoke": bench_tenancy_smoke,
     "memo_smoke": bench_memo_smoke,
     "crash_smoke": bench_crash_smoke,
+    "analysis_smoke": bench_analysis_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -751,7 +789,8 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "matrix_smoke": "matrix_smoke_cells",
                 "tenancy_smoke": "tenancy_smoke_requests",
                 "memo_smoke": "memo_smoke_prefix_chunks_saved",
-                "crash_smoke": "crash_smoke_bit_identical"}
+                "crash_smoke": "crash_smoke_bit_identical",
+                "analysis_smoke": "analysis_smoke_wall_s"}
 
 
 def _stage_spec(name):

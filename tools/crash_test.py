@@ -87,9 +87,9 @@ def normalize_report(rep: dict) -> dict:
 def child_main(d: str, resume: bool) -> int:
     """One campaign attempt inside the kill zone: run (or resume) the
     grid with journal + checkpoints + ledger under `d`, then write the
-    full report atomically to ``d/report.json`` (write-temp +
-    os.replace — a kill mid-write must not leave a torn report for the
-    parent to misread)."""
+    full report to ``d/report.json`` via `MatrixReport.save` (atomic:
+    write-temp + fsync + os.replace — a kill mid-write must not leave
+    a torn report for the parent to misread)."""
     import wittgenstein_tpu.models  # noqa: F401 — fills the registry
     from wittgenstein_tpu.matrix import SweepGrid, run_grid
     from wittgenstein_tpu.serve import Scheduler
@@ -100,12 +100,10 @@ def child_main(d: str, resume: bool) -> int:
                     journal_dir=os.path.join(d, "journal"))
     run = run_grid(grid, sch, max_wave=2, keep_states=(),
                    resume=resume)
-    tmp = os.path.join(d, "report.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(run.report.to_json(), f, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(d, "report.json"))
+    # MatrixReport.save is the atomic (write-temp + fsync +
+    # os.replace) path — a kill mid-write must not leave a torn
+    # report for the parent to misread
+    run.report.save(os.path.join(d, "report.json"))
     return 0 if run.report.clean else 1
 
 

@@ -2,11 +2,25 @@
 
     python -m wittgenstein_tpu.analysis                 # all rules, all protocols
     python -m wittgenstein_tpu.analysis --protocol Handel --rule carry_copy
+    python -m wittgenstein_tpu.analysis --source        # host source rules only
     python -m wittgenstein_tpu.analysis --json report.json
     python -m wittgenstein_tpu.analysis --update-budgets   # ratchet down
 
+``--source`` runs only the global source rules (determinism plus the
+host-plane family: host_locks, host_durability, host_digest,
+host_except) — no protocol compiles, seconds instead of minutes, the
+mode CI pre-commit hooks and `tools/bench_suite.py analysis_smoke`
+use.
+
 Exit code 0 iff no error findings.  Runs on CPU (force JAX_PLATFORMS=cpu
 to audit from a TPU host without touching the chip).
+
+The ``--json`` payload is versioned: ``{"schema": N, ...}``
+(framework.REPORT_SCHEMA).  Schema 2 = report fields ok / targets /
+rules / n_errors / findings, each finding carrying rule / target /
+severity / message / metric / value plus repo-relative ``path`` and
+1-based ``line`` spans for source findings.  Fields are only ever
+added within a version; removals or renames bump it.
 """
 
 from __future__ import annotations
@@ -23,50 +37,66 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m wittgenstein_tpu.analysis",
         description="jaxpr/HLO/source lints over every protocol's "
-                    "compiled superstep")
+                    "compiled superstep, plus host-plane source rules")
     ap.add_argument("--protocol", action="append", metavar="NAME",
                     help="restrict to protocol(s) (repeatable; default all)")
     ap.add_argument("--rule", action="append", metavar="NAME",
                     choices=sorted(framework.RULES),
                     help="restrict to rule(s) (repeatable; default all)")
+    ap.add_argument("--source", action="store_true",
+                    help="source rules only: skip every compiled "
+                         "protocol target (fast, no XLA)")
     ap.add_argument("--json", metavar="PATH",
                     help="write the machine-readable report to PATH "
-                         "('-' for stdout)")
+                         "('-' for stdout; schema: see module docstring)")
     ap.add_argument("--update-budgets", action="store_true",
                     help="ratchet analysis/budgets.json down to the "
                          "measured values (never up)")
     ap.add_argument("--list", action="store_true",
-                    help="list rules and targets, then exit")
+                    help="list each rule's scope and target count, "
+                         "then exit")
     args = ap.parse_args(argv)
 
     if args.list:
-        print("rules:   ", " ".join(sorted(framework.RULES)))
-        print("targets: ", " ".join(targets.target_names()))
+        names = targets.target_names()
+        print(f"rules ({len(framework.RULES)}):")
+        for name in sorted(framework.RULES):
+            rule = framework.RULES[name]
+            desc = rule.describe() if rule.scope == "global" \
+                else f"{len(names)} compiled protocol targets"
+            print(f"  {name:18s} {rule.scope:9s} {desc}")
+        print(f"targets ({len(names)}): {' '.join(names)}")
         return 0
 
-    import wittgenstein_tpu.models  # noqa: F401  (fill the registry)
+    if args.protocol and args.source:
+        ap.error("--source runs no protocol targets; drop --protocol")
 
-    known = set(targets.target_names())
-    for name in args.protocol or ():
-        if name not in known:
-            ap.error(f"unknown protocol {name!r}; known: "
-                     f"{' '.join(sorted(known))}")
+    if not args.source:
+        import wittgenstein_tpu.models  # noqa: F401  (fill the registry)
+        known = set(targets.target_names())
+        for name in args.protocol or ():
+            if name not in known:
+                ap.error(f"unknown protocol {name!r}; known: "
+                         f"{' '.join(sorted(known))}")
 
     def progress(msg):
         print(f"[analysis] {msg}", file=sys.stderr, flush=True)
 
     report = framework.run_analysis(target_names=args.protocol,
                                     rule_names=args.rule,
-                                    progress=progress)
+                                    progress=progress,
+                                    source_only=args.source)
 
     for f in report.findings:
         if f.severity != "info":
-            print(f"{f.severity.upper():8s} {f.rule:12s} {f.target}: "
+            where = f.span() or f.target
+            print(f"{f.severity.upper():8s} {f.rule:16s} {where}: "
                   f"{f.message}")
     info = sum(1 for f in report.findings if f.severity == "info")
     warn = sum(1 for f in report.findings if f.severity == "warning")
-    print(f"[analysis] {len(report.targets)} targets x "
-          f"{len(report.rules)} rules: {len(report.errors)} errors, "
+    what = "source rules" if args.source else \
+        f"{len(report.targets)} targets x {len(report.rules)} rules"
+    print(f"[analysis] {what}: {len(report.errors)} errors, "
           f"{warn} warnings, {info} checks passed")
 
     if args.update_budgets:

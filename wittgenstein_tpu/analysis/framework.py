@@ -29,6 +29,13 @@ BUDGETS_PATH = pathlib.Path(__file__).resolve().parent / "budgets.json"
 
 SEVERITIES = ("error", "warning", "info")
 
+#: machine-readable report schema (`Report.to_json()["schema"]`).
+#: 1 = the original unversioned shape (no schema field, findings
+#: without spans); 2 adds this field plus per-finding `path`/`line`
+#: source spans.  Consumers should accept unknown EXTRA fields within
+#: a schema version; field removals/renames bump it.
+REPORT_SCHEMA = 2
+
 
 @dataclasses.dataclass
 class Finding:
@@ -38,9 +45,17 @@ class Finding:
     message: str
     metric: str | None = None   # budgetable metric name
     value: object = None        # measured value for `metric`
+    path: str | None = None     # repo-relative source file, for lints
+    line: int | None = None     # 1-based line within `path`
 
     def to_json(self):
         return dataclasses.asdict(self)
+
+    def span(self) -> str:
+        """``path:line`` when the finding carries a source span."""
+        if self.path is None:
+            return ""
+        return f"{self.path}:{self.line}" if self.line else self.path
 
 
 class Rule:
@@ -53,6 +68,11 @@ class Rule:
 
     def run(self, target, budget: dict) -> list[Finding]:
         raise NotImplementedError
+
+    def describe(self) -> str:
+        """One-line target summary for ``--list`` (global rules
+        override this to say what they scan)."""
+        return ""
 
 
 RULES: dict[str, Rule] = {}
@@ -68,8 +88,23 @@ def register_rule(cls):
 def _install_rules():
     """Import the rule modules for their registration side effect."""
     from . import (rules_audit, rules_carry, rules_determinism,  # noqa: F401
-                   rules_dtype, rules_hostsync, rules_metrics,
-                   rules_superstep, rules_trace, rules_vmem)
+                   rules_dtype, rules_host_digest, rules_host_durability,
+                   rules_host_except, rules_host_locks, rules_hostsync,
+                   rules_metrics, rules_superstep, rules_trace, rules_vmem)
+
+
+def parse_allow(budget: dict) -> frozenset:
+    """The rule's suppression list from its budget block: a frozenset
+    of ``"relpath::qualname::pattern"`` strings (``budgets.json`` key
+    ``<rule>.allow``).  The syntax is shared across every source rule
+    (determinism and the host-plane family), so an exemption is always
+    a reviewed budget-file diff, never a code-side skip."""
+    return frozenset(budget.get("allow", ()))
+
+
+def is_allowed(allow, relpath: str, qualname: str, pattern: str) -> bool:
+    """True when ``relpath::qualname::pattern`` is suppressed."""
+    return f"{relpath}::{qualname}::{pattern}" in allow
 
 
 def load_budgets(path=BUDGETS_PATH) -> dict:
@@ -136,25 +171,32 @@ class Report:
         return not self.errors
 
     def to_json(self):
-        return {"ok": self.ok, "targets": self.targets, "rules": self.rules,
+        return {"schema": REPORT_SCHEMA, "ok": self.ok,
+                "targets": self.targets, "rules": self.rules,
                 "n_errors": len(self.errors),
                 "findings": [f.to_json() for f in self.findings]}
 
 
 def run_analysis(target_names=None, rule_names=None, budgets=None,
-                 progress=None) -> Report:
+                 progress=None, source_only=False) -> Report:
     """Run `rule_names` (default: all) over `target_names` (default: the
     full pinned registry) against `budgets` (default: the checked-in
     file).  Compile failures become error findings, not crashes — a
     protocol whose superstep stops compiling on CPU is itself a
-    regression the report must surface."""
-    from . import targets as targets_mod
-
+    regression the report must surface.  ``source_only`` restricts the
+    run to global (source-lint) rules and skips the compiled-target
+    registry entirely — no protocol import, no XLA, seconds not
+    minutes (the ``--source`` CLI mode)."""
     _install_rules()
     budgets = load_budgets() if budgets is None else budgets
-    names = list(target_names) if target_names is not None \
-        else list(targets_mod.target_names())
     rules = [RULES[r] for r in (rule_names or sorted(RULES))]
+    if source_only:
+        rules = [r for r in rules if r.scope == "global"]
+        names = []
+    else:
+        from . import targets as targets_mod
+        names = list(target_names) if target_names is not None \
+            else list(targets_mod.target_names())
 
     findings: list[Finding] = []
     for rule in rules:

@@ -144,3 +144,8 @@ class DeterminismRule(Rule):
                 message="no wall-clock/stateful-PRNG/env reads in "
                         "simulation sources"))
         return findings
+
+    def describe(self):
+        n = sum(len(list((PKG_DIR / sub).glob("*.py")))
+                for sub in LINT_DIRS)
+        return f"source: {n} files (models/, core/)"

@@ -148,3 +148,6 @@ class VmemBudgetRule(Rule):
                         "estimate — unbudgeted Pallas launch (the r5 "
                         "56 MB scoped-VMEM compile failure mode)"))
         return findings
+
+    def describe(self):
+        return f"{len(_kernel_models())} Pallas kernel cost models"

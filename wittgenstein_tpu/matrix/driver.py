@@ -54,10 +54,9 @@ def _drain(sch: Scheduler, rids: list, poll_s: float = 0.05):
         sch.run_pending()
         statuses = []
         for rid in rids:
-            try:
-                statuses.append(sch.request(rid).status)
-            except KeyError:        # evicted already-done request
-                statuses.append("done")
+            req = sch.peek(rid)
+            # evicted == already-done (keep_done retention bound)
+            statuses.append("done" if req is None else req.status)
         if all(s in ("done", "error") for s in statuses):
             return
         time.sleep(poll_s)
@@ -71,9 +70,8 @@ def _harvest(sch: Scheduler, pairs, results, artifacts, states,
     number of cells done."""
     done = 0
     for cell, rid in pairs:
-        try:
-            req = sch.request(rid)
-        except KeyError:
+        req = sch.peek(rid)
+        if req is None:
             results[cell.id] = {
                 "status": "error",
                 "error": "request evicted before harvest "
@@ -282,10 +280,7 @@ def _run_prefixes(sch: Scheduler, plan_: MatrixPlan, fplan, table,
             pending.append((fg, rid))
         _drain(sch, [rid for _, rid in pending])
         for fg, rid in pending:
-            try:
-                req = sch.request(rid)
-            except KeyError:
-                req = None
+            req = sch.peek(rid)
             if req is None or req.status != "done":
                 stats["prefix_failed"] += 1
                 continue        # cells fall back to the unforked path

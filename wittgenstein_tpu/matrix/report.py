@@ -210,12 +210,22 @@ class MatrixReport:
         return cls(data=dict(data))
 
     def save(self, path) -> str:
+        """Write the report atomically (write-temp + fsync +
+        os.replace): the campaign report is what a resume run or an
+        operator reads after a crash, so a kill mid-write must leave
+        either the previous report or the new one — never a torn
+        file (the crash-test parent reads this exact artifact)."""
+        import os
         import pathlib
         p = pathlib.Path(path)
         p.parent.mkdir(parents=True, exist_ok=True)
-        with open(p, "w") as f:
+        tmp = str(p) + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self.to_json(), f, sort_keys=True)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, str(p))
         return str(p)
 
     # -------------------------------------------------------------- human

@@ -60,6 +60,12 @@ FILENAME = "submissions.jsonl"
 class SubmissionJournal:
     """One scheduler's WAL (module docstring)."""
 
+    #: lock inventory (analysis rule ``host_locks``): `_mu` guards the
+    #: FILE, not attributes — every append/replay/compact serializes
+    #: on it inside the methods below; no self attribute is mutated
+    #: after __init__, so the owned set is empty by design.
+    _LOCK_OWNS: dict = {"_mu": ()}
+
     def __init__(self, journal_dir):
         self.dir = str(journal_dir)
         os.makedirs(self.dir, exist_ok=True)

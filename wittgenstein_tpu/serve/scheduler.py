@@ -360,6 +360,17 @@ class Scheduler:
     `status` are safe from any thread; `run_pending` drains from one
     thread at a time (a second concurrent call returns immediately)."""
 
+    #: lock inventory (checked by analysis rule ``host_locks``): every
+    #: read or write of these attributes must hold `_mu`.  Listed:
+    #: the queue/request tables, tenancy accounting, the resilience
+    #: and memo counters (mutated from drain, watchdog and HTTP
+    #: threads), and the chunk-wall EMA the watchdog deadline reads.
+    _LOCK_OWNS = {"_mu": ("_requests", "_queue", "_n", "_draining",
+                          "_deficit", "_last_tenant", "_tstats",
+                          "resilience", "memo", "chunk_wall_ema_s")}
+    #: `_boundary` is Condition(self._mu): holding it IS holding `_mu`
+    _LOCK_ALIASES = {"_boundary": "_mu"}
+
     def __init__(self, registry: CompileRegistry | None = None,
                  ledger_path=None, on_boundary=None, keep_done: int = 256,
                  launcher=None, max_retries: int = 2,
@@ -687,6 +698,15 @@ class Scheduler:
                 raise KeyError(f"unknown request {rid!r}")
             return self._requests[rid]
 
+    def peek(self, rid: str) -> Request | None:
+        """The Request for `rid`, or None when unknown — which for a
+        previously-valid rid means the keep_done eviction already
+        dropped the finished record (its ledger row is the durable
+        artifact).  The lookup drivers polling after a drain want,
+        without the try/except-KeyError dance at every site."""
+        with self._mu:
+            return self._requests.get(rid)
+
     def pending(self) -> list:
         with self._mu:
             return list(self._queue)
@@ -919,10 +939,11 @@ class Scheduler:
         mistaken for a hang."""
         if self.watchdog_factor is None:
             return None
-        if not self.chunk_wall_ema_s:
+        with self._mu:      # EMA is written at chunk boundaries
+            ema = self.chunk_wall_ema_s
+        if not ema:
             return self.watchdog_floor_s
-        return max(self.watchdog_floor_s,
-                   self.watchdog_factor * self.chunk_wall_ema_s)
+        return max(self.watchdog_floor_s, self.watchdog_factor * ema)
 
     def _call_bounded(self, call, fn, entry):
         """One launch attempt under the watchdog deadline (module
@@ -950,10 +971,12 @@ class Scheduler:
                              name="wtpu-launch")
         t.start()
         if not settled.wait(deadline):
-            self.resilience["watchdog_trips"] += 1
+            with self._mu:      # drain thread holds no lock here
+                self.resilience["watchdog_trips"] += 1
+                ema = self.chunk_wall_ema_s
             raise WatchdogTimeout(
                 f"launch exceeded its {deadline:.2f}s wall deadline "
-                f"(chunk-wall EMA {self.chunk_wall_ema_s:.3f}s x "
+                f"(chunk-wall EMA {ema:.3f}s x "
                 f"factor {self.watchdog_factor}, floor "
                 f"{self.watchdog_floor_s}s); abandoned on its worker "
                 "thread and fed to the retry->degrade->quarantine "
@@ -981,7 +1004,8 @@ class Scheduler:
                 if isinstance(e, WatchdogTimeout) and not retry_timeouts:
                     break
                 if attempt < self.max_retries:
-                    self.resilience["retries"] += 1
+                    with self._mu:
+                        self.resilience["retries"] += 1
                     if self.retry_backoff_s:
                         time.sleep(self.retry_backoff_s * (2 ** attempt))
         raise last
@@ -1021,7 +1045,8 @@ class Scheduler:
                 return None, [e]
             # graceful degradation: halve the lane batch and run the
             # halves sequentially instead of dropping the requests
-            self.resilience["demotions"] += 1
+            with self._mu:
+                self.resilience["demotions"] += 1
             mid = len(widths) // 2
             w_left = int(sum(widths[:mid]))
             left, right = self._split_state(entry, w_left)
@@ -1565,9 +1590,10 @@ class Scheduler:
             # coalesced chunk's wall time (the snapshot above already
             # synced the device, so this is honest compute time)
             dt = time.time() - t_chunk
-            self.chunk_wall_ema_s = (dt if not self.chunk_wall_ema_s
-                                     else 0.8 * self.chunk_wall_ema_s
-                                     + 0.2 * dt)
+            with self._mu:      # read by watchdog/health threads
+                ema = self.chunk_wall_ema_s
+                self.chunk_wall_ema_s = (dt if not ema
+                                         else 0.8 * ema + 0.2 * dt)
             if self.on_boundary is not None:
                 self.on_boundary()
             if lanes:
@@ -1699,7 +1725,8 @@ class Scheduler:
             acc = req.ff_accum or {}
             art["fast_forward"] = {k: ff_stats[k] + acc.get(k, 0)
                                    for k in ff_stats}   # group-level
-        art["resilience"] = dict(self.resilience)   # scheduler-level
+        with self._mu:      # watchdog/retry threads mutate counters
+            art["resilience"] = dict(self.resilience)   # scheduler-level
         art["tenant"] = spec.tenant
         if req.preempted:
             art["preempted"] = req.preempted

@@ -68,6 +68,13 @@ class _MatrixJob:
 
 
 class Service:
+    #: lock inventory (analysis rule ``host_locks``): the matrix-job
+    #: table is shared between the caller's thread (submit/status) and
+    #: the per-job driver threads; `_wake`/`_stop` are intentionally
+    #: unowned (Event is self-synchronizing; `_stop` is a monotonic
+    #: close flag read by the drain loop).
+    _LOCK_OWNS = {"_matrix_mu": ("_matrix", "_matrix_n")}
+
     def __init__(self, scheduler: Scheduler | None = None,
                  auto: bool = True):
         self.scheduler = scheduler or Scheduler()

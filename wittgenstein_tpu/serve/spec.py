@@ -95,8 +95,10 @@ def int_env(name: str, default: int, env=None,
         return default
     try:
         val = int(raw)
-    except ValueError:
-        val = 0
+    except ValueError as e:
+        print(f"{prefix}: ignoring malformed {name}={raw!r} ({e}); "
+              f"using {default}", file=sys.stderr)
+        return default
     if val <= 0:
         print(f"{prefix}: ignoring malformed {name}={raw!r}; using "
               f"{default}", file=sys.stderr)

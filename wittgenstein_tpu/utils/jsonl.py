@@ -21,6 +21,13 @@ tail" can never mean two different things in two files:
 Readers that need a list use `read_lines`.  Neither reader raises on
 content problems — an append-only log's job is to survive the crash
 that wrote it.
+
+This module is the sanctioned write path the `host_durability`
+analysis rule points everyone else at (and its one EXEMPT_FILES
+entry): raw `open(..., "w")`/`json.dump` on a journal/ledger/
+checkpoint path anywhere else in the host plane is a budgeted error —
+route it through `append_line`/`rewrite` here, or the write-temp +
+fsync + `os.replace` idiom (`MatrixReport.save`).
 """
 
 from __future__ import annotations
