@@ -177,6 +177,144 @@ def test_journal_write_failure_unaccepts_the_submit(tmp_path):
     assert sched._requests == {}
 
 
+# -------------------------------------------------------- fleet leases
+
+
+def test_lease_double_claim_refuses_without_append(tmp_path):
+    """The common contention case: a second worker's claim on a LIVE
+    lease returns False and appends NOTHING — refusal costs no disk
+    row, so a hot rid can't bloat the lease file."""
+    from wittgenstein_tpu.serve.journal import LeaseTable
+    lt = LeaseTable(str(tmp_path), ttl_s=30.0)
+    assert lt.claim("r1", "wa")
+    lines = open(lt.path).read().splitlines()
+    assert not lt.claim("r1", "wb")
+    assert open(lt.path).read().splitlines() == lines
+    assert lt.holder("r1") == "wa"
+    # a renewal by the HOLDER is allowed (and does append)
+    assert lt.claim("r1", "wa")
+    assert len(open(lt.path).read().splitlines()) == len(lines) + 1
+
+
+def test_lease_race_lexicographic_winner_and_no_resurrection(tmp_path):
+    """Two workers that append before seeing each other (the genuine
+    cross-process race window): the lexicographically smallest worker
+    id holds, deterministically; the loser's next claim is refused —
+    it must not resurrect the lease."""
+    from wittgenstein_tpu.serve.journal import LEASE_SCHEMA, LeaseTable
+    from wittgenstein_tpu.utils import jsonl
+    lt = LeaseTable(str(tmp_path), ttl_s=30.0)
+    assert lt.claim("r1", "wb")
+    # "wa" raced: its row landed without seeing wb's (simulated by a
+    # raw append — claim() would have refused after reading the file)
+    jsonl.append_line(lt.path, {
+        "schema": LEASE_SCHEMA, "kind": "claim", "rid": "r1",
+        "worker": "wa", "deadline_unix": time.time() + 30.0,
+        "ts_unix": time.time()}, fsync=True)
+    assert lt.holder("r1") == "wa"          # lex-min wins
+    assert not lt.claim("r1", "wb")         # loser backs off
+    # release by the winner frees the rid for anyone
+    lt.release("r1", "wa")
+    assert lt.holder("r1") is None or lt.holder("r1") == "wb"
+
+
+def test_lease_torn_tail_skipped_loudly(tmp_path, capsys):
+    """A worker SIGKILLed mid-claim-append leaves a torn final line:
+    the reader skips it with a named stderr note and every earlier
+    claim still stands."""
+    from wittgenstein_tpu.serve.journal import LeaseTable
+    lt = LeaseTable(str(tmp_path), ttl_s=30.0)
+    assert lt.claim("r1", "wa")
+    with open(lt.path, "a") as f:
+        f.write('{"kind": "claim", "rid": "r2", "worker": "w')
+    assert lt.holder("r1") == "wa"
+    assert lt.holder("r2") is None
+    err = capsys.readouterr().err
+    assert "leases" in err and "torn final line" in err
+
+
+def test_expired_lease_reclaim_replays_original_rid(
+        registry, reference, tmp_path):
+    """The dead-worker story end to end: a worker claims a journal
+    entry and dies (stops renewing); after the deadline a survivor
+    reclaims the rid and the PR-15 replay path runs it under the
+    ORIGINAL rid, bit-identical."""
+    from wittgenstein_tpu.serve.journal import LeaseTable
+    jd = str(tmp_path / "journal")
+    dead = Scheduler(registry=registry, journal_dir=jd)
+    rid = dead.submit(_spec())
+    LeaseTable(jd, ttl_s=0.05).claim(rid, "wdead")
+    # "wdead" is SIGKILLed here: no renewal, no release
+    time.sleep(0.12)
+    survivor = LeaseTable(jd, ttl_s=30.0)
+    assert survivor.holder(rid) is None     # expired = reclaimable
+    assert survivor.claim(rid, "walive")
+    fresh = Scheduler(registry=registry, journal_dir=jd,
+                      ledger_path=str(tmp_path / "led.jsonl"))
+    [entry] = fresh.journal.replay()
+    assert fresh.adopt_journal_entry(entry) == rid
+    fresh.run_pending()
+    req = fresh.request(rid)
+    assert req.status == "done", req.error
+    _trees_equal(reference, req.final_state)
+    assert SubmissionJournal(jd).lag() == 0
+
+
+def test_lease_compaction_preserves_live_claims(tmp_path):
+    """compact() drops released/expired/superseded history but every
+    CURRENT holder survives the rewrite (fleets only compact at
+    quiescent time — this pins that even then it can't drop a live
+    claim)."""
+    from wittgenstein_tpu.serve.journal import LeaseTable
+    lt = LeaseTable(str(tmp_path), ttl_s=30.0)
+    assert lt.claim("r1", "wa")
+    assert lt.claim("r1", "wa")             # renewal (superseded row)
+    assert lt.claim("r2", "wb")
+    lt.release("r2", "wb")                  # released
+    lt.claim("r3", "wc", now=time.time() - 100.0)   # long expired
+    lt.compact()
+    assert lt.live() == {"r1": "wa"}
+    rows = open(lt.path).read().splitlines()
+    assert len(rows) == 1 and '"wa"' in rows[0]
+
+
+def test_fleet_workers_partition_and_dedup_in_process(tmp_path):
+    """Two in-process FleetWorkers over one fleet directory: every
+    journal entry is claimed by exactly ONE worker (cold-key claim
+    budget leaves the second compile key for the peer), both settle,
+    and a duplicate resubmit after settle is served from the shared
+    ledger without running (cross-worker dedup).  Fresh per-worker
+    registries — the budget only bites on COLD keys, exactly the
+    fleet-startup shape (compiles re-hit the persistent cache, so
+    this stays fast)."""
+    from wittgenstein_tpu.serve.fleet import FleetWorker, fleet_paths
+    fd = str(tmp_path / "fleet")
+    jd = fleet_paths(fd)["journal_dir"]
+    j = SubmissionJournal(jd)
+    j.record_submit("fw0001", _spec())
+    # chunk_ms differs => a DISTINCT compile key (seeds alone share
+    # one: width re-specializes inside the jitted callable)
+    j.record_submit("fw0002", _spec(seeds=(7,), chunk_ms=60))
+    wa = FleetWorker(fd, "wa", lease_ttl_s=30.0)
+    wb = FleetWorker(fd, "wb", lease_ttl_s=30.0)
+    for _ in range(6):
+        wa.step()
+        wb.step()
+        if j.lag() == 0:
+            break
+    assert j.lag() == 0
+    assert j.settled() == {"fw0001": "done", "fw0002": "done"}
+    assert wa.counters["claimed"] + wb.counters["claimed"] == 2
+    assert wa.counters["claimed"] == 1      # budget split the cold
+    assert wb.counters["claimed"] == 1      # keys across the pair
+    # duplicate of a settled spec: ledger join, no third launch
+    j.record_submit("fw0003", _spec())
+    wa.step()
+    assert j.lag() == 0 and j.settled()["fw0003"] == "done"
+    assert wa.counters["deduped"] == 1
+    assert wa.sched.peek("fw0003") is None  # never entered the queue
+
+
 # ------------------------------------------------------- kill anywhere
 
 

@@ -13,6 +13,7 @@ Acceptance pins:
 
 import dataclasses
 import json
+import os
 import time
 import urllib.request
 
@@ -514,6 +515,202 @@ def test_serve_fast_forward_variant_bit_identity(tmp_path):
                  "bytes_received", "done_count", "live_count",
                  "drop_count"):
         assert td[name] == tf[name], name
+
+
+# ------------------------------------------------- lane repacking (PR 17)
+
+
+_HANDEL = dict(node_count=64, threshold=56, nodes_down=6,
+               pairing_time=4, dissemination_period_ms=20,
+               level_wait_time=50, fast_path=10)
+
+
+def _handel_batched(sim_ms, seeds):
+    """Batched K=4 Handel — the lockstep engine whose fused mailbox
+    makes mid-run joins non-trivial.  NetworkFixedLatency(10) raises
+    the latency floor above K-1 (the family default floor of 2 caps
+    K at 3)."""
+    return ScenarioSpec(protocol="Handel", params=_HANDEL, seeds=seeds,
+                        sim_ms=sim_ms, chunk_ms=40, engine="batched",
+                        superstep=4, obs=("metrics", "audit"),
+                        stat_each_ms=20,
+                        latency_model="NetworkFixedLatency(10)")
+
+
+@pytest.mark.slow
+def test_repack_fork_join_into_batched_group_bit_identical(tmp_path):
+    """Chunk-boundary lane repacking, full identity: a fork-restored
+    request (carries travel with the fork) joins a RUNNING batched-K4
+    group at its 80ms boundary, and BOTH requests finish with final
+    pytrees and metrics/audit artifacts bit-identical to their solo
+    runs — the joiner ran 2 chunks in the prefix + 2 repacked instead
+    of 4 solo, with zero compiled-program residue (one compile key
+    throughout)."""
+    from wittgenstein_tpu.serve import ForkState
+    solo = Scheduler(ledger_path=str(tmp_path / "solo.jsonl"))
+    ra = solo.submit(_handel_batched(160, (0, 1)), keep_carries=True)
+    rb = solo.submit(_handel_batched(160, (2, 3)), keep_carries=True)
+    solo.run_pending()
+    a0, b0 = solo.request(ra), solo.request(rb)
+    assert a0.status == "done" and b0.status == "done", (a0.error,
+                                                         b0.error)
+    pre = Scheduler(registry=solo.registry)
+    rp = pre.submit(_handel_batched(80, (2, 3)), keep_carries=True)
+    pre.run_pending()
+    p = pre.request(rp)
+    assert p.status == "done", p.error
+    fork = ForkState(state=p.final_state, carries=p.final_carries,
+                     at_ms=80, prefix_digest=p.spec.digest())
+
+    sch = Scheduler(registry=solo.registry,
+                    ledger_path=str(tmp_path / "re.jsonl"))
+    misses0 = sch.registry.stats()["misses"]
+    boundaries = []
+
+    def joiner():
+        boundaries.append(len(boundaries))
+        if len(boundaries) == 2:            # the boundary AT 80ms
+            rids["b"] = sch.submit(_handel_batched(160, (2, 3)),
+                                   fork=fork, keep_carries=True)
+
+    sch.on_boundary = joiner
+    rids = {"a": sch.submit(_handel_batched(160, (0, 1)),
+                            keep_carries=True)}
+    sch.run_pending()
+    a1, b1 = sch.request(rids["a"]), sch.request(rids["b"])
+    assert a1.status == "done" and b1.status == "done", (a1.error,
+                                                         b1.error)
+    assert sch.resilience["repacked"] == 1
+    assert len(boundaries) == 4             # 4 launches, not 4 + 2
+    _trees_equal(a1.final_state, a0.final_state)
+    _trees_equal(b1.final_state, b0.final_state)
+    for k in ("engine_metrics", "audit"):
+        assert a1.artifacts[k] == a0.artifacts[k], k
+        assert b1.artifacts[k] == b0.artifacts[k], k
+    # zero compiled residue: the repack reused the group's program
+    assert sch.registry.stats()["misses"] == misses0
+
+
+@pytest.mark.slow
+def test_repack_group_split_across_two_checkpoints(tmp_path):
+    """The fleet-recovery shape: one compile key's work lands in TWO
+    dead workers' checkpoints at DIFFERENT boundaries (A@40 from w1,
+    B@80 from w2).  A survivor adopts both, runs A to 80, and repacks
+    B into the running group at the matching boundary — final states
+    bit-identical to solo runs and the audit verdict clean.  (Metrics
+    artifacts cover the resumed span only: checkpoints persist
+    `(nets, ps)`, not obs carries — full-artifact identity is the
+    fork test's pin.)"""
+    reg = CompileRegistry()
+    solo = Scheduler(registry=reg)
+    ra = solo.submit(_handel_batched(160, (0, 1)))
+    rb = solo.submit(_handel_batched(160, (2, 3)))
+    solo.run_pending()
+    a0, b0 = solo.request(ra), solo.request(rb)
+    assert a0.status == "done" and b0.status == "done", (a0.error,
+                                                         b0.error)
+    ck = str(tmp_path / "ck")
+
+    def die_after(n):
+        calls = {"n": 0}
+
+        def launcher(fn, *args):
+            calls["n"] += 1
+            if calls["n"] > n:
+                raise RuntimeError("KILLED")
+            return fn(*args)
+        return launcher
+
+    # one chunk = TWO launcher calls here (metrics primary + audit
+    # shadow), so die_after(2*n) kills after n whole chunks
+    for wid, n, seeds in (("w1", 2, (0, 1)), ("w2", 4, (2, 3))):
+        dying = Scheduler(registry=reg, checkpoint_dir=ck,
+                          worker_id=wid, launcher=die_after(n),
+                          max_retries=0, retry_backoff_s=0.0)
+        rid = dying.submit(_handel_batched(160, seeds))
+        dying.run_pending()
+        assert dying.request(rid).status == "error"
+    assert len(os.listdir(ck)) == 2         # two boundary files
+
+    survivor = Scheduler(registry=reg, checkpoint_dir=ck)
+    got = survivor.resume_checkpoints()
+    assert len(got) == 2
+    ga, gb = survivor.request(got[0]), survivor.request(got[1])
+    assert {ga.resumed_from_ms, gb.resumed_from_ms} == {40, 80}
+    survivor.run_pending()
+    assert ga.status == "done" and gb.status == "done", (ga.error,
+                                                         gb.error)
+    assert survivor.resilience["repacked"] == 1
+    by_seed = {survivor.request(r).spec.seeds: survivor.request(r)
+               for r in got}
+    _trees_equal(by_seed[(0, 1)].final_state, a0.final_state)
+    _trees_equal(by_seed[(2, 3)].final_state, b0.final_state)
+    for r in got:
+        assert survivor.request(r).artifacts["audit"]["clean"]
+    assert not os.listdir(ck)               # both files consumed
+
+
+@pytest.mark.slow
+def test_repack_fast_forward_group_cross_check_clean(tmp_path):
+    """A fork-restored request repacked into a running FAST-FORWARD
+    group: final states bit-identical to solo, and the audit-vs-
+    metrics cross-check over the stitched carries stays empty — the
+    shared jump never skips a window the joiner's invariants would
+    have flagged."""
+    from wittgenstein_tpu.obs.audit import AuditSpec, monitored_invariants
+    from wittgenstein_tpu.obs.audit_report import (AuditReport,
+                                                   cross_check_metrics)
+    from wittgenstein_tpu.obs.export import MetricsFrame
+    from wittgenstein_tpu.serve import ForkState
+
+    def mk(sim_ms, seeds):
+        return _spec(seeds=seeds, sim_ms=sim_ms, chunk_ms=40,
+                     engine="fast_forward")
+
+    reg = CompileRegistry()
+    solo = Scheduler(registry=reg)
+    ra = solo.submit(mk(160, (0,)), keep_carries=True)
+    rb = solo.submit(mk(160, (1,)), keep_carries=True)
+    solo.run_pending()
+    a0, b0 = solo.request(ra), solo.request(rb)
+    assert a0.status == "done" and b0.status == "done", (a0.error,
+                                                         b0.error)
+    pre = Scheduler(registry=reg)
+    rp = pre.submit(mk(80, (1,)), keep_carries=True)
+    pre.run_pending()
+    p = pre.request(rp)
+    assert p.status == "done", p.error
+    fork = ForkState(state=p.final_state, carries=p.final_carries,
+                     at_ms=80, prefix_digest=p.spec.digest())
+
+    sch = Scheduler(registry=reg)
+    seen = []
+
+    def joiner():
+        seen.append(len(seen))
+        if len(seen) == 2:
+            rids["b"] = sch.submit(mk(160, (1,)), fork=fork,
+                                   keep_carries=True)
+
+    sch.on_boundary = joiner
+    rids = {"a": sch.submit(mk(160, (0,)), keep_carries=True)}
+    sch.run_pending()
+    a1, b1 = sch.request(rids["a"]), sch.request(rids["b"])
+    assert a1.status == "done" and b1.status == "done", (a1.error,
+                                                         b1.error)
+    assert sch.resilience["repacked"] == 1
+    _trees_equal(a1.final_state, a0.final_state)
+    _trees_equal(b1.final_state, b0.final_state)
+    aspec = AuditSpec()
+    for req in (a1, b1):
+        frame = MetricsFrame.from_carries(
+            MetricsSpec(stat_each_ms=req.spec.stat_each_ms),
+            req.final_carries["metrics"])
+        report = AuditReport.from_carries(
+            aspec, req.final_carries["audit"],
+            monitored=monitored_invariants(aspec, req.cfg))
+        assert report.clean
+        assert cross_check_metrics(report, frame) == []
 
 
 @pytest.mark.slow

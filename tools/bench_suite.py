@@ -763,6 +763,76 @@ def bench_analysis_smoke():
             "platform": "cpu"}
 
 
+def bench_fleet_smoke():
+    """Fleet smoke stage (PR 17): two REAL worker subprocesses over
+    one shared fleet directory complete a four-request mix submitted
+    through the `FleetService` front tier.  One spec is pre-completed
+    in-process first, so the stage asserts BOTH fleet mechanisms: the
+    cross-worker ledger-dedup join (the duplicate settles without
+    running, `deduped >= 1`) and lease-partitioned completion of the
+    rest (every request `done`, aggregate throughput reported).  The
+    workers' published stats snapshots are the measurement source —
+    the same files `run_grid(workers=N)` aggregates."""
+    import tempfile
+    import time
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+
+    from wittgenstein_tpu.serve import FleetService
+    from wittgenstein_tpu.serve.fleet import (FleetWorker,
+                                              aggregate_worker_stats,
+                                              spawn_worker)
+    from wittgenstein_tpu.serve.spec import ScenarioSpec
+
+    mk = lambda seed: ScenarioSpec(          # noqa: E731
+        protocol="PingPong", params={"node_count": 64}, seeds=(seed,),
+        sim_ms=120, chunk_ms=40, obs=("metrics", "audit"))
+    with tempfile.TemporaryDirectory() as tmp:
+        svc = FleetService(tmp)
+        # pre-seed the shared ledger: one spec completed in-process
+        # (an in-process FleetWorker — same code path, no subprocess;
+        # step() alone publishes no stats snapshot, so the aggregate
+        # below is the subprocess workers' alone)
+        seed_worker = FleetWorker(tmp, "seed0")
+        svc.submit(mk(0).to_json())
+        for _ in range(60):
+            seed_worker.step()
+            if svc.journal.lag() == 0:
+                break
+        assert svc.journal.lag() == 0, "pre-seed request never settled"
+        # the mix: the SAME spec again (dedup target) + three fresh
+        rids = [svc.submit(mk(s).to_json())["id"] for s in
+                (0, 1, 2, 3)]
+        t0 = time.perf_counter()
+        procs = [spawn_worker(tmp, f"w{i}", idle_exit_s=2.0,
+                              max_wall_s=300.0) for i in (0, 1)]
+        deadline = time.time() + 300.0
+        for p in procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.2)
+        wall = time.perf_counter() - t0
+        assert all(p.poll() is not None for p in procs), \
+            "fleet workers did not idle-exit (wedged?)"
+        statuses = {rid: svc.status(rid)["status"] for rid in rids}
+        agg = aggregate_worker_stats(tmp)
+        health = svc.health()
+    assert all(s == "done" for s in statuses.values()), statuses
+    c = agg["counters"]
+    assert c.get("deduped", 0) >= 1, \
+        f"ledger dedup never fired: {c}"
+    assert c.get("processed", 0) >= 3, \
+        f"subprocess workers processed too little: {c}"
+    assert health["journal_lag"] == 0, health
+    return {"metric": "fleet_smoke_requests", "value": len(rids),
+            "unit": "requests", "wall_s": round(wall, 2),
+            "throughput_rps": round(len(rids) / wall, 3),
+            "workers": 2, "deduped": c.get("deduped", 0),
+            "claimed": c.get("claimed", 0),
+            "processed": c.get("processed", 0),
+            "program_builds": agg["registry"].get("misses", 0),
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -776,6 +846,7 @@ CONFIGS = {
     "tenancy_smoke": bench_tenancy_smoke,
     "memo_smoke": bench_memo_smoke,
     "crash_smoke": bench_crash_smoke,
+    "fleet_smoke": bench_fleet_smoke,
     "analysis_smoke": bench_analysis_smoke,
 }
 
@@ -790,6 +861,7 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "tenancy_smoke": "tenancy_smoke_requests",
                 "memo_smoke": "memo_smoke_prefix_chunks_saved",
                 "crash_smoke": "crash_smoke_bit_identical",
+                "fleet_smoke": "fleet_smoke_requests",
                 "analysis_smoke": "analysis_smoke_wall_s"}
 
 
@@ -873,6 +945,12 @@ def _stage_spec(name):
         # the stage SIGKILLs a whole campaign; the digested config is
         # the crash grid's BASE cell (the memo_smoke convention)
         "crash_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
+            superstep=1),
+        # the stage drives a two-worker fleet; the digested config is
+        # its canonical request spec (the crash_smoke convention)
+        "fleet_smoke": dict(
             protocol="PingPong", params={"node_count": 64}, seeds=(0,),
             sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
             superstep=1),

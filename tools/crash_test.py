@@ -191,6 +191,86 @@ def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
             "grid_digest": final.get("grid_digest")}
 
 
+def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
+                         seed: int = 0, min_delay: float = 1.0,
+                         max_delay: float | None = None,
+                         lease_ttl_s: float = 3.0) -> dict:
+    """The fleet variant (--workers N): run the SAME campaign as a
+    lease-based worker fleet (matrix/driver.py run_grid(workers=N)),
+    SIGKILL a seeded-random WORKER — not the whole campaign — at
+    seeded offsets, and assert the surviving workers complete the grid
+    with a `MatrixReport` bit-identical (normalized) to a 1-worker
+    uninterrupted fleet run's.  At least one worker is never targeted,
+    so survivors always exist to reclaim the dead workers' expired
+    leases (short ttl keeps the reclaim window inside the test's
+    wall); recovery is checkpoint adoption or journal replay — the
+    same PR-15 paths the single-process harness pins."""
+    import threading
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import SweepGrid, run_grid
+
+    out = pathlib.Path(out_dir)
+    grid = SweepGrid.from_json(CRASH_GRID)
+    t0 = time.time()
+    ref = run_grid(grid, workers=1, fleet_dir=str(out / "ref-fleet"),
+                   keep_states=(),
+                   fleet_opts={"lease_ttl_s": lease_ttl_s,
+                               "timeout_s": 600.0})
+    ref_wall = time.time() - t0
+    ref.report.save(str(out / "ref-report.json"))
+    # kill offsets span the fleet's working life (worker import included
+    # — a kill mid-import must leave nothing adopted); the ceiling sits
+    # inside the reference wall so real work stays on the table
+    hi = max_delay if max_delay is not None else max(2.0,
+                                                     0.6 * ref_wall)
+    rng = random.Random(seed)
+    kills = max(1, min(kills, workers - 1))
+    victims = rng.sample(range(workers - 1), kills)
+    delays = sorted(rng.uniform(min_delay, hi) for _ in victims)
+    kill_log: list = []
+
+    def on_spawned(procs):
+        def killer():
+            t_start = time.time()
+            for v, d in zip(victims, delays):
+                while time.time() - t_start < d:
+                    time.sleep(0.05)
+                p = procs[v]
+                landed = p.poll() is None
+                if landed:
+                    os.kill(p.pid, signal.SIGKILL)
+                kill_log.append({"worker": f"w{v}",
+                                 "at_s": round(d, 2),
+                                 "landed": landed})
+                print(f"crash_test: SIGKILL w{v} at +{d:.2f}s "
+                      f"({'landed' if landed else 'already exited'})",
+                      flush=True)
+        threading.Thread(target=killer, daemon=True,
+                         name="fleet-killer").start()
+
+    t1 = time.time()
+    final = run_grid(grid, workers=workers,
+                     fleet_dir=str(out / "fleet"), keep_states=(),
+                     fleet_opts={"lease_ttl_s": lease_ttl_s,
+                                 "timeout_s": 600.0,
+                                 "on_spawned": on_spawned})
+    wall = time.time() - t1
+    final.report.save(str(out / "report.json"))
+    ok = normalize_report(final.report.to_json()) \
+        == normalize_report(ref.report.to_json())
+    fl = final.report.data.get("resume", {})
+    return {"ok": ok, "workers": workers, "kills": kill_log,
+            "kills_landed": sum(1 for k in kill_log if k["landed"]),
+            "seed": seed, "ref_wall_s": round(ref_wall, 2),
+            "wall_s": round(wall, 2),
+            "cells": final.report.data.get("cells_total"),
+            "adopted_checkpoints": fl.get("adopted_checkpoints"),
+            "entries_claimed": fl.get("journal_replayed"),
+            "worker_deduped": fl.get("worker_deduped"),
+            "grid_digest": final.report.data.get("grid_digest")}
+
+
 def _print_divergence(ref: dict, final: dict):
     a, b = normalize_report(ref), normalize_report(final)
     for key in sorted(set(a) | set(b)):
@@ -217,7 +297,20 @@ def main(argv=None) -> int:
                     "campaign N times, resume, assert report "
                     "bit-identity vs the uninterrupted run")
     ap.add_argument("--kills", type=int, default=5,
-                    help="SIGKILLs before the final resume (default 5)")
+                    help="SIGKILLs before the final resume (default 5; "
+                         "with --workers: workers killed, capped at "
+                         "N-1 so survivors always exist)")
+    ap.add_argument("--workers", type=int, default=None, metavar="N",
+                    help="fleet variant: run the campaign as N lease-"
+                         "based worker processes and SIGKILL seeded-"
+                         "random WORKERS (not the campaign); the "
+                         "survivors must finish with a report bit-"
+                         "identical to a 1-worker uninterrupted "
+                         "fleet run")
+    ap.add_argument("--lease-ttl", type=float, default=3.0,
+                    metavar="S", help="fleet lease ttl (--workers; "
+                    "short keeps the dead workers' reclaim window "
+                    "inside the test wall; default 3.0)")
     ap.add_argument("--seed", type=int, default=0,
                     help="seed for the kill-offset draws (default 0)")
     ap.add_argument("--dir", default=None, metavar="DIR",
@@ -248,6 +341,34 @@ def main(argv=None) -> int:
         return 2
     import tempfile
     work = args.dir or tempfile.mkdtemp(prefix="wtpu-crash-")
+    if args.workers is not None:
+        if args.workers < 2:
+            print("config error: --workers needs N >= 2 (a 1-worker "
+                  "fleet has no survivors to recover a kill)",
+                  file=sys.stderr)
+            return 2
+        try:
+            res = run_fleet_crash_test(
+                work, workers=args.workers, kills=args.kills,
+                seed=args.seed, min_delay=args.min_delay,
+                max_delay=args.max_delay, lease_ttl_s=args.lease_ttl)
+        except RuntimeError as e:
+            print(f"config error: {e}", file=sys.stderr)
+            return 2
+        line = json.dumps({"metric": "fleet_crash_bit_identical",
+                           "value": int(res["ok"]), "unit": "bool",
+                           **res})
+        print(line)
+        if args.out:
+            pathlib.Path(args.out).write_text(line + "\n")
+        if not res["ok"]:
+            with open(os.path.join(work, "ref-report.json")) as f:
+                ref = json.load(f)
+            with open(os.path.join(work, "report.json")) as f:
+                final = json.load(f)
+            _print_divergence(ref, final)
+            return 1
+        return 0
     try:
         res = run_crash_test(work, kills=args.kills, seed=args.seed,
                              min_delay=args.min_delay,

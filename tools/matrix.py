@@ -97,6 +97,17 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wave", type=int, default=64,
                     help="max cells per coalesced launch wave "
                          "(default 64)")
+    ap.add_argument("--workers", type=int, default=None, metavar="N",
+                    help="fleet mode (serve/fleet.py): enqueue the "
+                         "grid into a shared journal and run it with "
+                         "N worker PROCESSES over --fleet-dir; cell "
+                         "rows come back through the shared-ledger "
+                         "join, bit-identical to a single-process run")
+    ap.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="the shared fleet directory for --workers "
+                         "(holds journal/, checkpoints/, ledger.jsonl, "
+                         "workers/); re-running over the same dir "
+                         "resumes an interrupted fleet campaign")
     ap.add_argument("--spot-check", type=int, default=0, metavar="N",
                     help="verify N cells (deterministic spread) "
                          "bit-for-bit against sequential Runner runs")
@@ -140,6 +151,46 @@ def main(argv=None) -> int:
         print("config error: --resume needs --checkpoint-dir (the "
               "interrupted run's checkpoint directory)", file=sys.stderr)
         return 2
+    if args.workers is not None:
+        if not args.fleet_dir:
+            print("config error: --workers needs --fleet-dir (the one "
+                  "shared directory the worker processes derive "
+                  "journal/checkpoint/ledger paths from)",
+                  file=sys.stderr)
+            return 2
+        if args.resume or args.memo or args.memo_table:
+            print("config error: --workers is a separate-process "
+                  "fleet; --resume/--memo are single-process drivers "
+                  "(the fleet serves finished cells from the shared "
+                  "ledger automatically)", file=sys.stderr)
+            return 2
+
+        def fleet_progress(p):
+            if not args.quiet:
+                print(f"  [{p['wall_s']:8.1f}s] {p['done']}/"
+                      f"{p['total']} cells, journal lag "
+                      f"{p['journal_lag']}", file=sys.stderr,
+                      flush=True)
+
+        run = run_grid(grid, plan_=mplan, keep_states=(),
+                       progress=fleet_progress, workers=args.workers,
+                       fleet_dir=args.fleet_dir)
+        report = run.report
+        r = report.data["resume"]
+        print(f"fleet: {r['fleet_workers']} workers, "
+              f"{r['journal_replayed']} entries claimed, "
+              f"{r['worker_deduped']} worker-deduped, "
+              f"{r['adopted_checkpoints']} checkpoints adopted")
+        print(report.format())
+        if args.out:
+            print(f"report -> {report.save(args.out)}")
+        if spot:
+            print("spot checks: SKIPPED (fleet cells' final states "
+                  "live in the worker processes; re-run without "
+                  "--workers to verify)")
+        if report.clean:
+            print("CLEAN: all cells done, audits clean")
+        return 0 if report.clean else 1
     memo = None
     if args.memo or args.memo_table:
         memo = {"table": args.memo_table} if args.memo_table else True

@@ -156,6 +156,133 @@ def stream_smoke(svc) -> dict:
             "final_totals": chunks[-1]["totals"] if chunks else None}
 
 
+def fleet_tenants() -> dict:
+    """The tenancy policies of the mix, shared by the single-process
+    scheduler and the fleet front tier so both runs refuse/weight the
+    same way."""
+    return {"interactive": {"weight": 4},
+            "campaign": {"weight": 1, "max_queued": 4,
+                         "retry_after_s": 0.2},
+            "batch": {"weight": 2}}
+
+
+def fleet_load_once(workers: int, per: int, *, base_dir,
+                    lease_ttl_s: float = 10.0,
+                    ready_timeout_s: float = 300.0) -> dict:
+    """One fleet measurement: spawn `workers` worker processes over a
+    fresh fleet directory, wait until every worker has published a
+    stats snapshot (measuring steady-state submit->result throughput,
+    not worker cold-start), then run the SAME three-tenant client mix
+    through a `FleetService` front tier and report per-worker-count
+    latency/throughput/builds."""
+    import glob
+    import os
+
+    from wittgenstein_tpu.serve import FleetService
+    from wittgenstein_tpu.serve.fleet import (aggregate_worker_stats,
+                                              fleet_paths, spawn_worker)
+
+    d = os.path.join(base_dir, f"fleet-{workers}w")
+    svc = FleetService(d, tenants=fleet_tenants())
+    procs = [spawn_worker(d, f"w{i}", lease_ttl_s=lease_ttl_s,
+                          idle_exit_s=4.0, max_wall_s=900.0)
+             for i in range(workers)]
+    stats_glob = os.path.join(fleet_paths(d)["stats_dir"],
+                              "worker-*.json")
+    t_ready = time.time()
+    while len(glob.glob(stats_glob)) < workers:
+        if time.time() - t_ready > ready_timeout_s:
+            for p in procs:
+                p.terminate()
+            raise RuntimeError(
+                f"fleet-load: only {len(glob.glob(stats_glob))}/"
+                f"{workers} workers became ready in "
+                f"{ready_timeout_s:.0f}s; see worker logs in {d}")
+        if all(p.poll() is not None for p in procs):
+            raise RuntimeError(
+                f"fleet-load: every worker exited before becoming "
+                f"ready; see worker logs in {d}")
+        time.sleep(0.1)
+    recs = {name: {"submitted": per, "done": 0, "errors": 0,
+                   "rejected": 0, "gave_up": 0, "lat_ms": []}
+            for name in ("interactive", "campaign", "batch")}
+    t0 = time.perf_counter()
+    threads = [threading.Thread(
+        target=drive_tenant, args=(svc, tenant_specs(n, per), recs[n]),
+        kwargs={"poll_s": 0.1}, name=f"fleet-load-{n}")
+        for n in recs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    # let the workers idle-exit so their FINAL stats snapshots (the
+    # build counters) are on disk before aggregating
+    deadline = time.time() + 60.0
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.terminate()
+    agg = aggregate_worker_stats(d)
+    all_lat = sorted(x for r in recs.values() for x in r["lat_ms"])
+    done_total = sum(r["done"] for r in recs.values())
+    builds = agg["registry"].get("misses", 0)
+    return {
+        "workers": workers,
+        "completed": done_total,
+        "submitted": 3 * per,
+        "errors": sum(r["errors"] + r["gave_up"] for r in recs.values()),
+        "rejections_429": sum(r["rejected"] for r in recs.values()),
+        "wall_s": round(wall, 2),
+        "throughput_rps": round(done_total / max(wall, 1e-9), 3),
+        "p50_ms": pct(all_lat, 0.50),
+        "p99_ms": pct(all_lat, 0.99),
+        "program_builds": builds,
+        "requests_per_build": round(done_total / max(1, builds), 1),
+        "repacked": agg["resilience"].get("repacked", 0),
+        "worker_deduped": agg["counters"].get("deduped", 0),
+        "per_tenant": {n: {"completed": r["done"],
+                           "rejected_429": r["rejected"],
+                           "p50_ms": pct(sorted(r["lat_ms"]), 0.50),
+                           "p99_ms": pct(sorted(r["lat_ms"]), 0.99)}
+                       for n, r in recs.items()},
+        "per_worker": {w: {k: blk.get(k) for k in
+                           ("claimed", "processed", "deduped")}
+                       | {"builds": (blk.get("registry") or {}
+                                     ).get("misses")}
+                       for w, blk in agg["workers"].items()},
+    }
+
+
+def fleet_load(worker_counts, requests: int, *, base_dir=None) -> dict:
+    """The --workers sweep: the same request mix at each worker count
+    (fresh fleet directory each — no cross-run dedup), with the
+    scaling ratios the ISSUE pins (submit->result throughput at N
+    workers vs 1) computed when 1 is in the sweep."""
+    import tempfile
+
+    base = base_dir or tempfile.mkdtemp(prefix="wtpu-serve-fleet-")
+    per = max(1, requests // 3)
+    by = {}
+    for w in worker_counts:
+        print(f"fleet-load: measuring {w} worker(s)...", flush=True,
+              file=sys.stderr)
+        by[str(w)] = fleet_load_once(w, per, base_dir=base)
+    block = {"schema": 1, "requests": 3 * per, "by_workers": by,
+             "dir": base}
+    if "1" in by:
+        base_rps = by["1"]["throughput_rps"]
+        block["speedup_vs_1"] = {
+            w: round(b["throughput_rps"] / max(base_rps, 1e-9), 2)
+            for w, b in by.items() if w != "1"}
+        block["requests_per_build_vs_1"] = {
+            w: round(b["requests_per_build"]
+                     / max(by["1"]["requests_per_build"], 1e-9), 2)
+            for w, b in by.items() if w != "1"}
+    return block
+
+
 def pct(sorted_vals, q):
     """Upper nearest-rank percentile (ceil, not floor: a floored p99
     over ~100 samples would read ~p98 and hide the one true tail
@@ -180,6 +307,17 @@ def main(argv=None) -> int:
     ap.add_argument("--stream", action="store_true",
                     help="add the long-poll partial-metrics smoke "
                          "check (one spec streamed chunk by chunk)")
+    ap.add_argument("--workers", default=None, metavar="N[,M...]",
+                    help="fleet scaling sweep (serve/fleet.py): run "
+                         "the same request mix through a FleetService "
+                         "front tier at each worker-process count "
+                         "(e.g. '1,2,4') and report per-count p50/p99, "
+                         "aggregate submit->result throughput and "
+                         "requests-per-build; a fresh fleet directory "
+                         "per count keeps the runs independent")
+    ap.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="base directory for the --workers sweep "
+                         "(default: a temp dir)")
     ap.add_argument("--kill-after", type=float, default=None,
                     metavar="S",
                     help="hard-stop the client threads after S "
@@ -190,12 +328,38 @@ def main(argv=None) -> int:
                          "promise completion)")
     args = ap.parse_args(argv)
 
+    if args.workers is not None:
+        try:
+            counts = [int(x) for x in args.workers.split(",") if x]
+            if not counts or any(c < 1 for c in counts):
+                raise ValueError(args.workers)
+        except ValueError:
+            print(f"config error: --workers wants a comma list of "
+                  f"positive ints, got {args.workers!r}",
+                  file=sys.stderr)
+            return 2
+        block = fleet_load(counts, args.requests,
+                           base_dir=args.fleet_dir)
+        worst_p99 = max((b["p99_ms"] or 0)
+                        for b in block["by_workers"].values())
+        line = json.dumps({"metric": "serve_fleet_p99_ms",
+                           "value": worst_p99, "unit": "ms",
+                           "fleet": block,
+                           "platform": jax.default_backend()})
+        print(line)
+        if args.out:
+            pathlib.Path(args.out).write_text(line + "\n")
+        bad = {w: b for w, b in block["by_workers"].items()
+               if b["errors"] or b["completed"] < b["submitted"]}
+        if bad:
+            print(f"fleet-load: incomplete counts {sorted(bad)}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
     per = max(1, args.requests // 3)
     sch = Scheduler(
-        tenants={"interactive": {"weight": 4},
-                 "campaign": {"weight": 1, "max_queued": 4,
-                              "retry_after_s": 0.2},
-                 "batch": {"weight": 2}},
+        tenants=fleet_tenants(),
         quantum_chunks=2)
     svc = Service(scheduler=sch, auto=True)
     recs = {name: {"submitted": per, "done": 0, "errors": 0,

@@ -29,9 +29,20 @@ rule makes that a static error:
 `utils/jsonl.py` itself is exempt — it is the sanctioned
 implementation the rule points everyone else at.
 
+The fleet layer (PR 17) widened the strict zone's surface without
+widening the rule: `serve/fleet.py` and the lease table in
+`serve/journal.py` are covered by the serve/ prefix, and every
+cross-process fleet write — journal submits, lease claims/releases,
+ledger completion rows — already routes through `jsonl.append_line`
+(fsync'd where the write is an ack or claim barrier) or whole-file
+atomic replaces (worker stats snapshots, checkpoint files).
+
 Suppressions: "relpath::qualname::sink" (e.g. the checked-in
 ``utils/checkpoint.py::save::numpy.savez_compressed`` — the documented
-non-atomic primitive whose callers own the write-temp+replace dance).
+non-atomic primitive whose callers own the write-temp+replace dance;
+``serve/fleet.py::spawn_worker::open`` — a worker's append-mode
+STDOUT/STDERR log handed to Popen, operator diagnostics rather than
+durable state).
 """
 
 from __future__ import annotations

@@ -329,7 +329,9 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
              checkpoint_dir=None, journal_dir=None, max_wave: int = 64,
              keep_states=("*",), progress=None,
              strict_builds: bool = True,
-             resume: bool = False, memo=None) -> MatrixRun:
+             resume: bool = False, memo=None,
+             workers: int | None = None, fleet_dir=None,
+             fleet_opts: dict | None = None) -> MatrixRun:
     """Run every cell of `grid` (module docstring) and build the
     `MatrixReport`.
 
@@ -367,7 +369,33 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
         hits, `prefix_chunks_saved` — matching the fork plan's
         prediction on a veto-free cold-table run) and forked cell rows
         carry `forked_from` provenance.
+    workers     — fleet mode (PR 17): enqueue every cell into the
+        shared fleet journal and complete the campaign with N worker
+        PROCESSES over `fleet_dir` (serve/fleet.py's directory-sharing
+        contract) instead of this process's scheduler.  Results come
+        back through the shared-ledger join, so the report's cell rows
+        are bit-identical to a single-process run's; final states stay
+        in the worker processes (`MatrixRun.states` is empty — pass
+        cells through tools/matrix.py --spot-check for verification).
+        `fleet_opts` forwards run_grid_fleet keywords (lease_ttl_s,
+        timeout_s, on_spawned, ...).
     """
+    if workers is not None:
+        if scheduler is not None or resume or memo:
+            raise ValueError(
+                "run_grid(workers=N) is a separate-process fleet: it "
+                "cannot reuse an in-process scheduler, and resume/memo "
+                "are single-process drivers (the fleet serves finished "
+                "cells from the shared ledger automatically). Fix: "
+                "drop workers=, or drop scheduler=/resume=/memo=")
+        if fleet_dir is None:
+            raise ValueError(
+                "run_grid(workers=N) needs fleet_dir= — the one shared "
+                "directory every worker process derives journal/"
+                "checkpoints/ledger paths from (serve.fleet_paths)")
+        return run_grid_fleet(grid, plan_, fleet_dir=fleet_dir,
+                              workers=workers, progress=progress,
+                              **dict(fleet_opts or {}))
     plan_ = plan_ or plan(grid)
     sch = scheduler or Scheduler(ledger_path=ledger_path,
                                  checkpoint_dir=checkpoint_dir,
@@ -514,6 +542,210 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
         scheduler_stats=sch.resilience,
         resume=resume_counts, memo=memo_stats)
     return MatrixRun(report=report, artifacts=artifacts, states=states,
+                     requests=requests)
+
+
+# ------------------------------------------------------------ fleet mode
+
+
+def _fleet_join(plan_: MatrixPlan, ledger_path):
+    """One scan of the shared ledger -> ``(by_cell, by_digest)`` clean
+    summary-bearing rows (the `_load_resume` join, re-read every poll
+    because worker processes append concurrently)."""
+    from ..obs import ledger as ledger_mod
+
+    by_cell: dict = {}
+    by_digest: dict = {}
+    for row in ledger_mod.read_all(ledger_path):
+        ex = row.extra or {}
+        if "summary" not in ex or row.audit_clean is False:
+            continue
+        if ex.get("grid_digest") == plan_.grid_digest and ex.get("cell"):
+            by_cell.setdefault(ex["cell"], row)
+        by_digest.setdefault(row.config_digest, row)
+    return by_cell, by_digest
+
+
+def fleet_enqueue(plan_: MatrixPlan, fleet_dir) -> dict:
+    """Append one durable journal entry per not-yet-finished cell of
+    the grid (fsync'd submit rows — the fleet's shared work queue) and
+    return ``{cell id: rid}`` for the cells enqueued.  Cells already
+    served by a clean ledger row, or already live in the journal from
+    an interrupted fleet run of the SAME grid, are skipped — re-running
+    a campaign driver over an existing fleet directory resumes it."""
+    import uuid
+
+    from ..serve.fleet import fleet_paths
+    from ..serve.journal import SubmissionJournal
+
+    paths = fleet_paths(fleet_dir)
+    journal = SubmissionJournal(paths["journal_dir"])
+    by_cell, by_digest = _fleet_join(plan_, paths["ledger_path"])
+    live = {}
+    for e in journal.replay():
+        ex = e.get("ledger_extra") or {}
+        if ex.get("grid_digest") == plan_.grid_digest and ex.get("cell"):
+            live[ex["cell"]] = e["rid"]
+    nonce = uuid.uuid4().hex[:8]
+    rids = {}
+    for i, cell in enumerate(plan_.cells):
+        if cell.id in by_cell or cell.spec.digest() in by_digest:
+            continue                    # the row IS the result
+        if cell.id in live:
+            rids[cell.id] = live[cell.id]
+            continue                    # survivor of an interrupted run
+        rid = f"mx{nonce}-{i:04d}"
+        journal.record_submit(
+            rid, cell.spec, label=f"matrix:{cell.id}",
+            ledger_extra={"grid_digest": plan_.grid_digest,
+                          "cell": cell.id, "axes": dict(cell.labels)})
+        rids[cell.id] = rid
+    return rids
+
+
+def fleet_wait(plan_: MatrixPlan, fleet_dir, *, procs=(),
+               timeout_s: float = 900.0, poll_s: float = 0.5,
+               progress=None) -> dict:
+    """Poll the shared ledger until every cell of the grid has a clean
+    row (or a quarantine tombstone), building the per-cell results
+    table.  Raises RuntimeError when every worker process has exited
+    with cells still unserved (their logs are named), or on timeout —
+    a wedged fleet must fail loudly, not hang a campaign forever."""
+    from ..serve.fleet import fleet_paths
+    from ..serve.journal import SubmissionJournal
+
+    paths = fleet_paths(fleet_dir)
+    journal = SubmissionJournal(paths["journal_dir"])
+    cells = plan_.cells
+    t0 = time.time()
+    saw_all_exited = False
+    while True:
+        by_cell, by_digest = _fleet_join(plan_, paths["ledger_path"])
+        results: dict = {}
+        counts = {"from_ledger": 0, "deduped": 0, "quarantined": 0}
+        for cell in cells:
+            row = by_cell.get(cell.id)
+            dedup = False
+            if row is None:
+                row, dedup = by_digest.get(cell.spec.digest()), True
+            if row is not None:
+                results[cell.id] = {"status": "done",
+                                    "artifacts": _row_artifacts(row)}
+                counts["deduped" if dedup else "from_ledger"] += 1
+        # a quarantined entry never grows a ledger row — surface it as
+        # the cell's error instead of waiting for the timeout
+        for rid, st in journal.settled().items():
+            if st != "quarantined":
+                continue
+            ex = (journal.lookup(rid) or {}).get("ledger_extra") or {}
+            cid = ex.get("cell")
+            if ex.get("grid_digest") == plan_.grid_digest \
+                    and cid and cid not in results:
+                results[cid] = {
+                    "status": "error",
+                    "error": f"fleet: entry {rid} quarantined (poison "
+                             "lane) — see the workers' logs"}
+                counts["quarantined"] += 1
+        if progress is not None:
+            progress({"done": len(results), "total": len(cells),
+                      "journal_lag": journal.lag(),
+                      "wall_s": round(time.time() - t0, 3)})
+        if len(results) == len(cells):
+            return {"results": results, "counts": counts}
+        if procs and all(p.poll() is not None for p in procs):
+            if not saw_all_exited:
+                # one more immediate join: a worker may have appended
+                # the final ledger row just after this poll's scan
+                saw_all_exited = True
+                continue
+            missing = [c.id for c in cells if c.id not in results]
+            logs = sorted({getattr(p, "log_path", "?") for p in procs})
+            raise RuntimeError(
+                f"fleet: all {len(procs)} worker process(es) exited "
+                f"with {len(missing)} cell(s) unserved "
+                f"({missing[:4]}{'...' if len(missing) > 4 else ''}). "
+                f"Worker logs: {logs}")
+        if time.time() - t0 > timeout_s:
+            missing = [c.id for c in cells if c.id not in results]
+            raise RuntimeError(
+                f"fleet: campaign incomplete after {timeout_s:.0f}s — "
+                f"{len(missing)} cell(s) unserved ({missing[:4]}...). "
+                "The journal entries survive; re-running the driver "
+                "over the same fleet_dir resumes them")
+        time.sleep(poll_s)
+
+
+def run_grid_fleet(grid: SweepGrid, plan_: MatrixPlan | None = None, *,
+                   fleet_dir, workers: int = 2,
+                   lease_ttl_s: float = 10.0, idle_exit_s: float = 2.0,
+                   poll_s: float = 0.5, timeout_s: float = 900.0,
+                   progress=None, on_spawned=None,
+                   spawn: bool = True) -> MatrixRun:
+    """`run_grid(workers=N)`'s engine, decomposed (enqueue / spawn /
+    wait / report) so tools/crash_test.py can SIGKILL workers between
+    the pieces.  Enqueues the grid into the shared fleet journal,
+    spawns `workers` worker subprocesses over `fleet_dir`, waits for
+    the shared-ledger join to serve every cell, and builds the same
+    `MatrixReport` a single-process run would — cell rows are ledger
+    round-trips, bit-identical by the `_row_artifacts` contract; the
+    run-local accounting (wall, aggregate program builds, the `resume`
+    block's fleet counters) honestly differs and is exactly the
+    volatile set crash_test normalizes away.
+
+    `on_spawned(procs)` fires after the workers launch (the crash
+    harness's kill hook); `spawn=False` skips launching (the caller
+    runs its own workers).  A dead worker needs no respawn: its leases
+    expire and survivors adopt its work (serve/fleet.py)."""
+    from ..serve.fleet import aggregate_worker_stats, spawn_worker
+
+    plan_ = plan_ or plan(grid)
+    t0 = time.time()
+    requests = fleet_enqueue(plan_, fleet_dir)
+    procs = []
+    if spawn:
+        procs = [spawn_worker(fleet_dir, f"w{i}",
+                              lease_ttl_s=lease_ttl_s,
+                              idle_exit_s=idle_exit_s,
+                              max_wall_s=timeout_s)
+                 for i in range(int(workers))]
+    if on_spawned is not None:
+        on_spawned(procs)
+    try:
+        waited = fleet_wait(plan_, fleet_dir, procs=procs,
+                            timeout_s=timeout_s, poll_s=poll_s,
+                            progress=progress)
+    finally:
+        # reap: workers idle-exit on their own once the journal is
+        # fully settled (their final stats snapshot lands in their
+        # `finally`); only a wedged/errored fleet gets terminated
+        deadline = time.time() + max(10.0, 3 * idle_exit_s)
+        for p in procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    results = waited["results"]
+    agg = aggregate_worker_stats(fleet_dir)
+    wall = time.time() - t0
+    resume_counts = {
+        "fleet_workers": int(workers),
+        **waited["counts"],
+        "resumed_requests": 0,
+        "journal_replayed": agg["counters"].get("claimed", 0),
+        "worker_deduped": agg["counters"].get("deduped", 0),
+        "adopted_checkpoints": agg["counters"].get(
+            "adopted_checkpoints", 0)}
+    compiles = {"program_builds": agg["registry"].get("misses", 0),
+                "distinct_compile_keys": plan_.planned_compiles,
+                "registry": agg["registry"]}
+    report = MatrixReport.build(
+        plan_, results, wall_s=wall, compiles=compiles,
+        scheduler_stats=agg["resilience"] or None,
+        resume=resume_counts)
+    artifacts = {cid: r["artifacts"] for cid, r in results.items()
+                 if r.get("status") == "done"}
+    return MatrixRun(report=report, artifacts=artifacts, states={},
                      requests=requests)
 
 

@@ -37,12 +37,24 @@ coalesced into few compiled device programs.
                 replayed by `resume_journal()`/`recover()`; with the
                 poison-lane quarantine and hung-launch watchdog it
                 makes serve crash-only (scheduler module docstring).
+                Since PR 17 it also holds `LeaseTable`: append-only
+                fsync'd work claims with deadlines — the fleet's
+                partition of one shared journal across N workers.
+  `fleet`     — `FleetWorker`/`spawn_worker` (PR 17): lease-based
+                multi-process scale-out over the crash-only substrate
+                — N worker processes share one journal/ledger/
+                checkpoint directory, a dead worker's leases expire
+                and any survivor replays or checkpoint-adopts its
+                work, and the PR-13 ledger join dedups across workers.
+                `FleetService` (service.py) is the thin front tier
+                behind the same `/w/batch/*` routes.
 """
 
-from .journal import SubmissionJournal  # noqa: F401
+from .fleet import FleetWorker, fleet_paths, spawn_worker  # noqa: F401
+from .journal import LeaseTable, SubmissionJournal  # noqa: F401
 from .registry import CompileRegistry  # noqa: F401
 from .scheduler import (AdmissionError, ForkState, Request,  # noqa: F401
                         Scheduler, StaleCheckpointError, TenantPolicy,
                         WatchdogTimeout)
-from .service import Service  # noqa: F401
+from .service import FleetService, Service  # noqa: F401
 from .spec import ENGINES, OBS_PLANES, ScenarioSpec  # noqa: F401

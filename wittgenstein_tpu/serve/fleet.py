@@ -1,0 +1,512 @@
+"""Fleet: lease-based multi-process scale-out of the serve plane.
+
+One scheduler process is the reference's shape (PAPER.md §0: a single
+single-threaded simulator) and was ours until this module: aggregate
+campaign throughput was bounded by one drain loop no matter how many
+cores exist.  The crash-only substrate built in PRs 13-15 is exactly
+what horizontal scale-out needs, and this module adds ONLY the
+coordination layer on top of it:
+
+  * The durable submission journal (journal.py) is the shared work
+    queue: a front tier (`FleetService`) appends fsync'd submit rows;
+    N `FleetWorker` processes poll the same file.
+  * Workers claim entries through `LeaseTable` — append-only fsync'd
+    claim rows with a worker id and an absolute deadline.  Expired
+    leases are reclaimable; a double claim resolves deterministically
+    to the lexicographically smallest worker id (journal.py).
+  * Crash recovery of a dead worker IS the PR-15 replay path, run by
+    any survivor: the dead worker stops renewing, its leases expire,
+    and a survivor either adopts its group checkpoint (lease-gated
+    through `Scheduler.resume_checkpoints(accept=)` — resuming from
+    the last chunk boundary, bit-identical) or replays the journal
+    entry from its spec.
+  * Cross-worker dedup is the PR-13 ledger join: an entry whose spec
+    digest already has a clean, summary-bearing row in the shared
+    ledger is tombstoned as done without running — the row IS the
+    result, bit-identical by the determinism contract.
+  * Completion facts flow through the shared ledger (every worker's
+    `Scheduler._finalize` appends rows to one file), so results
+    outlive the worker that computed them.
+
+Directory-sharing contract (`fleet_paths`): one fleet directory holds
+``journal/`` (submissions.jsonl + leases.jsonl), ``checkpoints/``
+(worker-prefixed group files — `Scheduler(worker_id=)` keeps two
+workers from clobbering each other), ``ledger.jsonl`` and ``workers/``
+(per-worker stats snapshots, atomically replaced).  All cross-process
+writes are APPENDS to JSONL files or whole-file atomic replaces —
+safe under concurrent writers on POSIX.  Compaction (journal or
+leases) rewrites a whole file from one process's snapshot and is
+therefore a QUIESCENT-TIME operation in a fleet: workers never
+compact shared files; run it from the campaign driver after the
+workers exit (or any single-process deployment, where the PR-15
+behavior is unchanged).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+from ..utils import jsonl
+from .journal import LeaseTable, SubmissionJournal
+from .scheduler import Scheduler
+from .spec import ScenarioSpec
+
+
+def fleet_paths(fleet_dir) -> dict:
+    """The directory-sharing contract: every fleet participant derives
+    the same layout from the one shared directory."""
+    d = str(fleet_dir)
+    return {"dir": d,
+            "journal_dir": os.path.join(d, "journal"),
+            "checkpoint_dir": os.path.join(d, "checkpoints"),
+            "ledger_path": os.path.join(d, "ledger.jsonl"),
+            "stats_dir": os.path.join(d, "workers")}
+
+
+def clean_rows_by_digest(ledger_path) -> dict:
+    """config_digest -> first clean, summary-bearing `RunManifest` row
+    of the shared ledger — the PR-13 dedup/result join, shared by the
+    workers (dedup) and the front tier (serving results)."""
+    from ..obs import ledger as ledger_mod
+    out: dict = {}
+    for row in ledger_mod.read_all(ledger_path):
+        ex = row.extra or {}
+        if "summary" in ex and row.audit_clean is not False:
+            out.setdefault(row.config_digest, row)
+    return out
+
+
+def _clean_row(raw: dict):
+    """Parse one raw ledger row; return the `RunManifest` iff it is a
+    clean, summary-bearing completion row (the dedup-join predicate of
+    `clean_rows_by_digest`), else None."""
+    from ..obs import ledger as ledger_mod
+    try:
+        row = ledger_mod.RunManifest.from_json(raw)
+    except (TypeError, ValueError) as e:
+        print(f"fleet: unparseable ledger row skipped from the dedup "
+              f"join ({type(e).__name__}: {e!s:.120})", file=sys.stderr)
+        return None
+    ex = row.extra or {}
+    if "summary" in ex and row.audit_clean is not False:
+        return row
+    return None
+
+
+def aggregate_worker_stats(fleet_dir) -> dict:
+    """Aggregate the fleet's atomically-published per-worker stats
+    snapshots (`FleetWorker.write_stats`): summed counters / registry /
+    resilience blocks plus the raw per-worker blocks under
+    ``workers``.  Unreadable snapshots are skipped loudly — a reader
+    never sees a half-written file (atomic replace), but a worker
+    SIGKILLed before its first write has no file at all."""
+    import glob
+
+    stats_dir = fleet_paths(fleet_dir)["stats_dir"]
+    per: dict = {}
+    for path in sorted(glob.glob(os.path.join(stats_dir,
+                                              "worker-*.json"))):
+        try:
+            with open(path) as f:
+                blk = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"fleet: unreadable worker stats {path} ({e}); "
+                  "skipped from the aggregate", file=sys.stderr)
+            continue
+        per[blk.get("worker", os.path.basename(path))] = blk
+    agg = {"counters": {}, "registry": {}, "resilience": {}}
+    for blk in per.values():
+        for k, v in blk.items():
+            if isinstance(v, (int, float)) and k != "worker":
+                agg["counters"][k] = agg["counters"].get(k, 0) + v
+        for sub in ("registry", "resilience"):
+            for k, v in (blk.get(sub) or {}).items():
+                if isinstance(v, (int, float)):
+                    agg[sub][k] = agg[sub].get(k, 0) + v
+    agg["workers"] = per
+    return agg
+
+
+class FleetWorker:
+    """One worker process of a fleet (module docstring): a standard
+    `Scheduler` with a fleet identity, plus the poll-claim-adopt loop
+    and a daemon lease-renewal thread."""
+
+    #: lock inventory (analysis rule ``host_locks``): `_mu` guards the
+    #: held-lease set and the counters — both mutated from the step
+    #: loop AND read from the renewal thread / stats writer.
+    _LOCK_OWNS = {"_mu": ("_held", "counters")}
+
+    def __init__(self, fleet_dir, worker_id: str, *, registry=None,
+                 lease_ttl_s: float = 10.0, dedup: bool = True,
+                 scheduler_kw: dict | None = None):
+        self.paths = fleet_paths(fleet_dir)
+        self.worker_id = str(worker_id)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.dedup = bool(dedup)
+        self.sched = Scheduler(
+            registry=registry,
+            ledger_path=self.paths["ledger_path"],
+            checkpoint_dir=self.paths["checkpoint_dir"],
+            journal_dir=self.paths["journal_dir"],
+            worker_id=self.worker_id,
+            **dict(scheduler_kw or {}))
+        self.journal: SubmissionJournal = self.sched.journal
+        self.leases = LeaseTable(self.paths["journal_dir"],
+                                 ttl_s=self.lease_ttl_s)
+        self.counters = {"claimed": 0, "deduped": 0, "released": 0,
+                         "adopted_checkpoints": 0, "processed": 0,
+                         "steps": 0}
+        self._held: set = set()
+        self._keys: dict = {}           # rid -> (digest, compile_key)
+        #: incremental dedup view of the shared ledger: each poll
+        #: parses only the bytes appended since the last one (the
+        #: ledger grows for the life of a campaign; re-reading it
+        #: whole every cycle made the idle poll O(file)).  Compaction
+        #: resets the reader to 0 and the setdefault accumulator
+        #: absorbs the re-parse idempotently.
+        self._ledger_tail = jsonl.TailReader(self.paths["ledger_path"],
+                                             label="ledger")
+        self._ledger_clean: dict = {}   # config_digest -> RunManifest
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._renewer: threading.Thread | None = None
+
+    # ------------------------------------------------------------- leases
+
+    def _claim(self, rid: str) -> bool:
+        ok = self.leases.claim(rid, self.worker_id)
+        if ok:
+            with self._mu:
+                self._held.add(rid)
+                self.counters["claimed"] += 1
+        return ok
+
+    def _release(self, rid: str):
+        self.leases.release(rid, self.worker_id)
+        with self._mu:
+            self._held.discard(rid)
+            self.counters["released"] += 1
+
+    def start_renewal(self):
+        """The lease heartbeat: a daemon thread re-claims every held
+        rid at ttl/3 so a HEALTHY worker's long launch (first-chunk
+        compile!) never loses its work mid-flight — only a dead
+        worker's leases expire."""
+        if self._renewer is not None:
+            return
+        period = max(0.05, self.lease_ttl_s / 3.0)
+
+        def loop():
+            while not self._stop.wait(period):
+                with self._mu:
+                    held = list(self._held)
+                for rid in held:
+                    try:
+                        self.leases.claim(rid, self.worker_id)
+                    except OSError as e:
+                        print(f"fleet[{self.worker_id}]: lease renewal "
+                              f"failed for {rid} ({e}); the lease may "
+                              "expire and be reclaimed",
+                              file=sys.stderr)
+
+        self._renewer = threading.Thread(
+            target=loop, daemon=True,
+            name=f"fleet-renew-{self.worker_id}")
+        self._renewer.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._renewer is not None:
+            self._renewer.join(timeout=2.0)
+            self._renewer = None
+
+    # -------------------------------------------------------------- steps
+
+    def _adopt_checkpoints(self, live_rids: set) -> list:
+        """Lease-gated checkpoint adoption: resume any group file —
+        this worker's own (its restart) or a dead worker's — whose
+        EVERY request is journal-live, not already running here, and
+        claimable.  A live worker's file never passes (its renewal
+        keeps the leases held), so adoption can't fork a running
+        request's identity.  Adopted foreign files are deleted: the
+        state now lives in this scheduler, which re-checkpoints under
+        its own worker-prefixed filename at the next boundary (a crash
+        before then replays from the journal — redo beats lose)."""
+        adopted_foreign: list = []
+
+        def accept(path, meta) -> bool:
+            rids = [rm["id"] for rm in meta.get("requests", ())]
+            if not rids:
+                return False
+            for rid in rids:
+                if rid not in live_rids \
+                        or self.sched.peek(rid) is not None:
+                    return False
+            got = []
+            for rid in rids:
+                if self._claim(rid):
+                    got.append(rid)
+                else:
+                    for c in got:       # all-or-nothing: a group file
+                        self._release(c)   # restores as one batch
+                    return False
+            with self._mu:
+                self.counters["adopted_checkpoints"] += 1
+            if meta.get("worker") != self.worker_id:
+                adopted_foreign.append(path)
+            return True
+
+        rids = self.sched.resume_checkpoints(accept=accept)
+        for path in adopted_foreign:
+            with contextlib.suppress(OSError):
+                os.remove(path)
+        return rids
+
+    def _entry_keys(self, e) -> tuple:
+        """``(digest, compile_key)`` of a journal entry's spec, cached
+        per rid (digesting every live entry once per poll cycle would
+        be quadratic over a campaign) — ``(None, None)`` for a spec
+        that no longer parses (adopt_journal_entry skips those
+        loudly)."""
+        rid = e.get("rid")
+        hit = self._keys.get(rid)
+        if hit is not None:
+            return hit
+        try:
+            spec = ScenarioSpec.from_json(e["spec"])
+            # the AS-SUBMITTED digest (what ledger rows' config_digest
+            # records); the compile key needs the resolved spec
+            out = (spec.digest(), spec.validate().compile_key())
+        except (KeyError, ValueError, TypeError) as ex:
+            # cached below, so this shouts once per rid, not per poll
+            print(f"fleet[{self.worker_id}]: journal entry {rid!r} "
+                  f"spec no longer parses ({type(ex).__name__}: "
+                  f"{ex!s:.120}); dedup/affinity skip it — "
+                  "adopt_journal_entry will record the refusal",
+                  file=sys.stderr)
+            out = (None, None)
+        if rid is not None:
+            self._keys[rid] = out
+            if len(self._keys) > 4096:      # drop settled entries' keys
+                live = {x.get("rid") for x in self.journal.replay()}
+                self._keys = {r: v for r, v in self._keys.items()
+                              if r in live}
+        return out
+
+    def step(self) -> dict:
+        """One poll cycle: read the journal's live entries, adopt every
+        checkpoint and entry this worker can lease (dedup'ing against
+        the shared ledger first), drain, then release settled leases.
+
+        Claim AFFINITY: entries whose compile key is already warm in
+        THIS worker's registry are claimed freely; entries needing a
+        fresh build are rationed to ONE new compile key per step (the
+        others stay unleased for the rest of the fleet this cycle).
+        Compile keys therefore specialize across a fleet — with N
+        workers and K keys each program is built ~once fleet-wide, so
+        requests-per-build tracks the single-process number instead of
+        dividing by N — while a lone worker still drains everything
+        (its budget resets every step).  Returns the cycle's
+        counters."""
+        entries = self.journal.replay()
+        live_rids = {e.get("rid") for e in entries}
+        adopted = len(self._adopt_checkpoints(live_rids))
+        entries.sort(key=lambda e: 0 if (
+            (ck := self._entry_keys(e)[1]) is not None
+            and self.sched.registry.has_key(ck)) else 1)
+        if self.dedup:
+            for raw in self._ledger_tail.poll():
+                row = _clean_row(raw)
+                if row is not None:
+                    self._ledger_clean.setdefault(row.config_digest,
+                                                  row)
+        by_digest = self._ledger_clean if self.dedup else {}
+        cold_taken: set = set()
+        for e in entries:
+            rid = e.get("rid")
+            if not rid or self.sched.peek(rid) is not None:
+                continue
+            dig, ck = self._entry_keys(e)
+            if by_digest and dig is not None and dig in by_digest:
+                # cross-worker dedup (PR-13 join): the clean row
+                # IS the result, bit-identical by determinism —
+                # settle the entry without running it.  Claim
+                # first so two workers can't race the tombstone.
+                # Dedup consumes no build, so no affinity budget.
+                if self._claim(rid):
+                    self.journal.record_settled(rid, "done")
+                    self._release(rid)
+                    with self._mu:
+                        self.counters["deduped"] += 1
+                continue
+            fresh_key = (ck is not None
+                         and not self.sched.registry.has_key(ck)
+                         and ck not in cold_taken)
+            if fresh_key and cold_taken:
+                continue        # second fresh key this step: leave it
+            if not self._claim(rid):
+                continue        # another worker's (live) lease — a
+                # REFUSED claim costs no budget, so losing the race
+                # for one cold key never starves this step's next one
+            if fresh_key:
+                cold_taken.add(ck)
+            if self.sched.adopt_journal_entry(e) is None:
+                self._release(rid)
+                continue
+            adopted += 1
+        processed = self.sched.run_pending()["processed"] if adopted \
+            or self.sched.health_stats()["queued"] else 0
+        with self._mu:
+            held = list(self._held)
+            self.counters["processed"] += processed
+            self.counters["steps"] += 1
+        for rid in held:
+            req = self.sched.peek(rid)
+            if req is None or req.status in ("done", "error"):
+                # done/quarantined entries are journal-tombstoned by
+                # _finalize; a transient group error's entry stays
+                # live, and releasing lets ANY worker (us included)
+                # retry it — the crash-only redo contract
+                self._release(rid)
+        return {"adopted": adopted, "processed": processed}
+
+    # ------------------------------------------------------------- stats
+
+    def write_stats(self) -> str:
+        """Atomically publish this worker's counters + health block
+        (write-temp + fsync + os.replace — a reader aggregating a
+        fleet's stats never sees a half-written file, even if this
+        worker is SIGKILLed mid-write)."""
+        os.makedirs(self.paths["stats_dir"], exist_ok=True)
+        path = os.path.join(self.paths["stats_dir"],
+                            f"worker-{self.worker_id}.json")
+        with self._mu:
+            body = {"worker": self.worker_id, **self.counters}
+        body["registry"] = self.sched.registry.stats()
+        body["health"] = self.sched.health_stats()
+        body["resilience"] = dict(self.sched.resilience)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(body, f, sort_keys=True, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    # --------------------------------------------------------------- run
+
+    def run(self, *, poll_s: float = 0.25, idle_exit_s=None,
+            max_wall_s=None) -> dict:
+        """The worker main loop: step until idle (journal fully
+        settled AND nothing held) for `idle_exit_s` seconds, or
+        `max_wall_s` elapses, or `stop()`.  Publishes a stats snapshot
+        every cycle so an aggregator can read a LIVE fleet."""
+        self.start_renewal()
+        t0 = time.time()
+        idle_since = None
+        try:
+            while not self._stop.is_set():
+                c = self.step()
+                self.write_stats()
+                now = time.time()
+                if max_wall_s is not None and now - t0 >= max_wall_s:
+                    break
+                worked = c["adopted"] or c["processed"]
+                if worked:
+                    idle_since = None
+                    continue
+                if self.journal.lag() > 0:
+                    # entries remain but another worker's live lease
+                    # covers them: poll (don't exit — its crash would
+                    # make them ours), but never hot-spin against the
+                    # worker actually running them
+                    idle_since = None
+                    time.sleep(poll_s)
+                    continue
+                idle_since = idle_since if idle_since is not None \
+                    else now
+                if idle_exit_s is not None \
+                        and now - idle_since >= idle_exit_s:
+                    break
+                time.sleep(poll_s)
+        finally:
+            self.stop()
+            self.write_stats()
+        with self._mu:
+            return dict(self.counters)
+
+
+# ------------------------------------------------------------ subprocess
+
+def spawn_worker(fleet_dir, worker_id: str, *, lease_ttl_s: float = 10.0,
+                 idle_exit_s: float = 3.0, max_wall_s=None,
+                 poll_s: float = 0.25, dedup: bool = True, env=None):
+    """Launch one fleet worker subprocess (the shared helper behind
+    `run_grid(workers=N)`, crash_test --workers and serve_load
+    --workers).  stdout/stderr go to ``worker-<id>.log`` in the fleet
+    dir; the returned Popen carries ``log_path``."""
+    import subprocess
+    paths = fleet_paths(fleet_dir)
+    os.makedirs(paths["dir"], exist_ok=True)
+    cmd = [sys.executable, "-m", "wittgenstein_tpu.serve.fleet",
+           "--dir", paths["dir"], "--worker-id", str(worker_id),
+           "--ttl", str(lease_ttl_s), "--idle-exit", str(idle_exit_s),
+           "--poll", str(poll_s)]
+    if max_wall_s is not None:
+        cmd += ["--max-wall", str(max_wall_s)]
+    if not dedup:
+        cmd += ["--no-dedup"]
+    log_path = os.path.join(paths["dir"], f"worker-{worker_id}.log")
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                cwd=root, env=env or os.environ.copy())
+    proc.log_path = log_path
+    return proc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m wittgenstein_tpu.serve.fleet",
+        description="Run one fleet worker over a shared fleet "
+                    "directory (module docstring).")
+    ap.add_argument("--dir", required=True, metavar="DIR",
+                    help="the shared fleet directory (fleet_paths)")
+    ap.add_argument("--worker-id", required=True, metavar="ID",
+                    help="this worker's identity ([A-Za-z0-9_]; used "
+                         "as the rid/checkpoint/lease prefix)")
+    ap.add_argument("--ttl", type=float, default=10.0, metavar="S",
+                    help="lease ttl seconds (renewal runs at ttl/3)")
+    ap.add_argument("--idle-exit", type=float, default=None,
+                    metavar="S", help="exit after this long with the "
+                    "journal fully settled (default: run forever)")
+    ap.add_argument("--max-wall", type=float, default=None,
+                    metavar="S", help="hard wall-clock bound")
+    ap.add_argument("--poll", type=float, default=0.25, metavar="S",
+                    help="idle poll interval")
+    ap.add_argument("--no-dedup", action="store_true",
+                    help="disable the ledger dedup join (every entry "
+                         "re-runs even if a clean row exists)")
+    args = ap.parse_args(argv)
+    # protocol registry fills as models import (the classpath-scan
+    # analogue — server/http.py main does the same)
+    from .. import models  # noqa: F401
+    w = FleetWorker(args.dir, args.worker_id, lease_ttl_s=args.ttl,
+                    dedup=not args.no_dedup)
+    counters = w.run(poll_s=args.poll, idle_exit_s=args.idle_exit,
+                     max_wall_s=args.max_wall)
+    print(json.dumps({"worker": args.worker_id, **counters},
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

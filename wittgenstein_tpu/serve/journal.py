@@ -56,6 +56,13 @@ SCHEMA = 1
 #: the journal file inside `journal_dir` (one per scheduler)
 FILENAME = "submissions.jsonl"
 
+#: lease claim schema (bump on field changes; `LeaseTable` keys on it)
+LEASE_SCHEMA = 1
+
+#: the fleet lease file inside a SHARED journal dir — claim tombstones
+#: that partition the journal's live entries across worker processes
+LEASE_FILENAME = "leases.jsonl"
+
 
 class SubmissionJournal:
     """One scheduler's WAL (module docstring)."""
@@ -139,6 +146,28 @@ class SubmissionJournal:
         settled)."""
         return len(self.replay())
 
+    def settled(self) -> dict:
+        """rid -> final tombstone status for every settled entry —
+        the fleet front tier's status join (done / quarantined /
+        withdrawn; compaction eventually drops these rows, at which
+        point the ledger row is the durable record)."""
+        with self._mu:
+            out = {}
+            for _, row in jsonl.iter_lines(self.path, label="journal"):
+                if row.get("kind") == "tombstone" and row.get("rid"):
+                    out[row["rid"]] = row.get("status")
+            return out
+
+    def lookup(self, rid: str) -> dict | None:
+        """The submit row for `rid` (live OR settled), or None — the
+        front tier's result join needs a settled entry's spec to find
+        its ledger row by digest."""
+        with self._mu:
+            for _, row in jsonl.iter_lines(self.path, label="journal"):
+                if row.get("kind") == "submit" and row.get("rid") == rid:
+                    return row
+        return None
+
     def compact(self) -> None:
         """Atomically rewrite the journal down to its CURRENT live
         entries — recomputed under the lock at rewrite time, so a
@@ -154,3 +183,160 @@ class SubmissionJournal:
         except OSError as e:
             print(f"journal: compaction failed ({e}); the uncompacted "
                   "journal remains valid", file=sys.stderr)
+
+
+class LeaseTable:
+    """Append-only work-claim table for a fleet of worker processes
+    sharing ONE journal directory.
+
+    The journal says what work exists; the lease table says who is
+    running it.  A claim is one fsync'd JSONL row (`kind: "claim"`,
+    worker id + absolute deadline) — never an edit, so the file has
+    the same crash story as the journal: at worst one torn tail line,
+    skipped loudly by the shared reader.  The protocol:
+
+      * A worker may append a claim only when no OTHER worker holds a
+        live (unexpired, unreleased) claim on the rid — the common
+        contention case refuses WITHOUT writing.
+      * Two workers that append before seeing each other (the genuine
+        race window on a shared file; in-process `_mu` cannot cover a
+        second process) both re-read after their fsync and the
+        lexicographically SMALLEST worker id holds — deterministic,
+        no second append, the loser simply backs off and its row ages
+        out at its deadline.
+      * Renewal is re-claiming: a holder (or a worker whose lease
+        expired un-stolen) appends a fresh row with a new deadline.
+        A worker whose expired lease was validly reclaimed by someone
+        else gets a refusal — it must NOT resurrect the lease.
+      * Expiry is the crash-recovery signal: a dead worker stops
+        renewing, its deadlines pass, and any survivor reclaims the
+        rid and runs the PR-15 replay path on it.
+      * `release` appends a `kind: "release"` row at settle time so
+        the rid frees immediately instead of waiting out the ttl.
+
+    Claim and release rows are fsync'd: a claim that is not on the
+    platter is a claim another worker may legitimately double-run
+    after a crash (wasted, but bit-identical — the ledger join dedups
+    it), and the fsync keeps that window out of the common path.
+    """
+
+    #: lock inventory (analysis rule ``host_locks``): like the
+    #: journal, `_mu` guards the FILE — no attribute is mutated after
+    #: __init__, so the owned set is empty by design.
+    _LOCK_OWNS: dict = {"_mu": ()}
+
+    def __init__(self, journal_dir, *, ttl_s: float = 10.0):
+        self.dir = str(journal_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, LEASE_FILENAME)
+        self.ttl_s = float(ttl_s)
+        self._mu = threading.Lock()
+
+    # ---------------------------------------------------------- read side
+
+    def _live_locked(self, now: float) -> dict:
+        """rid -> {worker: latest live claim row}.  A release pops the
+        worker's standing claim (a later re-claim re-adds it — row
+        order is the truth); expired deadlines filter out at the
+        end so history stays append-only."""
+        claims: dict = {}
+        for _, row in jsonl.iter_lines(self.path, label="leases"):
+            rid, w = row.get("rid"), row.get("worker")
+            if not rid or not w:
+                continue
+            kind = row.get("kind")
+            if kind == "claim" and row.get("schema") == LEASE_SCHEMA:
+                claims.setdefault(rid, {})[w] = row
+            elif kind == "release":
+                claims.get(rid, {}).pop(w, None)
+        live = {}
+        for rid, per in claims.items():
+            per = {w: r for w, r in per.items()
+                   if r.get("deadline_unix", 0) > now}
+            if per:
+                live[rid] = per
+        return live
+
+    @staticmethod
+    def _holder_of(per: dict):
+        """The deterministic winner among live claimants: the
+        lexicographically smallest worker id (module docstring)."""
+        return min(per) if per else None
+
+    def holder(self, rid: str, now=None):
+        """The worker currently holding `rid`, or None."""
+        now = time.time() if now is None else now
+        with self._mu:
+            return self._holder_of(self._live_locked(now).get(rid, {}))
+
+    def live(self, now=None) -> dict:
+        """rid -> holding worker id for every live claim — the fleet
+        health endpoint's lease table."""
+        now = time.time() if now is None else now
+        with self._mu:
+            return {rid: self._holder_of(per)
+                    for rid, per in self._live_locked(now).items()}
+
+    def workers(self, now=None) -> dict:
+        """worker -> sorted list of held rids (health aggregation)."""
+        out: dict = {}
+        for rid, w in self.live(now).items():
+            out.setdefault(w, []).append(rid)
+        return {w: sorted(rids) for w, rids in sorted(out.items())}
+
+    # --------------------------------------------------------- write side
+
+    def claim(self, rid: str, worker: str, now=None) -> bool:
+        """Try to claim (or renew) `rid` for `worker`.  Returns True
+        iff `worker` holds the lease after this call.  Refuses without
+        appending when another worker's live claim exists; otherwise
+        appends an fsync'd claim row and re-reads — the lexicographic
+        rule decides the cross-process race deterministically.  Raises
+        OSError through: a worker must not run work whose claim the
+        disk refused to hold."""
+        now = time.time() if now is None else now
+        with self._mu:
+            per = self._live_locked(now).get(rid, {})
+            if any(w != worker for w in per):
+                return False
+            jsonl.append_line(self.path, {
+                "schema": LEASE_SCHEMA, "kind": "claim", "rid": rid,
+                "worker": worker, "deadline_unix": now + self.ttl_s,
+                "ts_unix": now}, fsync=True)
+            per = self._live_locked(now).get(rid, {})
+            return self._holder_of(per) == worker
+
+    def release(self, rid: str, worker: str) -> None:
+        """Free `rid` at settle time (fsync'd release row).  Never
+        raises — a release lost to a full disk costs only the lease
+        aging out at its deadline (redo beats lose, again)."""
+        import sys
+        try:
+            with self._mu:
+                jsonl.append_line(self.path, {
+                    "schema": LEASE_SCHEMA, "kind": "release",
+                    "rid": rid, "worker": worker,
+                    "ts_unix": time.time()}, fsync=True)
+        except OSError as e:
+            print(f"leases: release append failed for {rid} ({e}); "
+                  "the lease frees at its deadline instead",
+                  file=sys.stderr)
+
+    def compact(self) -> None:
+        """Atomically rewrite the file down to the rows backing LIVE
+        claims (released/expired/superseded history drops; every
+        current holder survives — recomputed under the lock at rewrite
+        time).  A failure leaves the uncompacted, still-correct
+        file."""
+        import sys
+        try:
+            with self._mu:
+                live = self._live_locked(time.time())
+                rows = [r for per in live.values() for r in per.values()]
+                rows.sort(key=lambda r: (r.get("ts_unix", 0),
+                                         str(r.get("rid")),
+                                         str(r.get("worker"))))
+                jsonl.rewrite(self.path, rows)
+        except OSError as e:
+            print(f"leases: compaction failed ({e}); the uncompacted "
+                  "lease file remains valid", file=sys.stderr)

@@ -66,6 +66,14 @@ class CompileRegistry:
         self._programs[key] = fn
         return fn
 
+    def has_key(self, compile_key: str) -> bool:
+        """True when ANY plane's program for this compile key is warm
+        — the fleet workers' claim-affinity probe (serve/fleet.py
+        prefers journal entries it can run without a fresh build, so
+        compile keys specialize across a fleet instead of every worker
+        rebuilding every program)."""
+        return any(k[0] == compile_key for k in self._programs)
+
     # ------------------------------------------------------------ builders
 
     def _build(self, spec: ScenarioSpec, plane: str | None, proto=None):

@@ -379,8 +379,18 @@ class Scheduler:
                  quantum_chunks: int | None = None,
                  freeze: bool | None = None, journal_dir=None,
                  watchdog_factor: float | None = None,
-                 watchdog_floor_s: float = 30.0):
+                 watchdog_floor_s: float = 30.0,
+                 worker_id: str | None = None):
         self.registry = registry or CompileRegistry()
+        #: fleet identity (None = the single-process default, nothing
+        #: changes).  When set, this scheduler is ONE worker among N
+        #: sharing a journal/ledger/checkpoint directory: request ids
+        #: and checkpoint filenames are prefixed with the worker id so
+        #: two workers can never mint the same rid or clobber each
+        #: other's group checkpoint.  The id rides in checkpoint meta
+        #: so a survivor can tell whose file it is adopting.  Uses "-"
+        #: as the separator (the HTTP id route accepts [A-Za-z0-9_-]).
+        self.worker_id = str(worker_id) if worker_id else None
         self.ledger_path = ledger_path      # None = the shared default
         #: the device-program launch seam: ``launcher(fn, *args)``
         #: (default: call fn).  Tests inject flaky/width-limited
@@ -435,7 +445,7 @@ class Scheduler:
         self.resilience = {"retries": 0, "demotions": 0, "resumed": 0,
                            "preemptions": 0, "rejected": 0,
                            "quarantined": 0, "watchdog_trips": 0,
-                           "replayed": 0}
+                           "replayed": 0, "repacked": 0}
         #: scheduler birth time — the health endpoint's uptime anchor
         self._t0 = time.time()
         #: fixed-point lane freezing (memo/freeze.py); None defers to
@@ -641,14 +651,7 @@ class Scheduler:
                         "plane from the spec's obs")
         with self._mu:
             self._admit(resolved)
-            self._n += 1
-            rid = f"r{self._n:04d}"
-            while rid in self._requests:
-                # checkpoint-restored requests keep their original ids
-                # (resume_checkpoints), which may sit ahead of this
-                # scheduler's counter — never overwrite one
-                self._n += 1
-                rid = f"r{self._n:04d}"
+            rid = self._rid_locked()
             req = Request(id=rid, spec=resolved, compile_key=key,
                           requested=spec, label=label,
                           keep_carries=bool(keep_carries),
@@ -690,6 +693,20 @@ class Scheduler:
                         f"({e}); request NOT accepted — fix the "
                         f"journal_dir volume or disable journaling"
                     ) from e
+        return rid
+
+    def _rid_locked(self) -> str:
+        """Mint the next request id (caller holds the lock).  Worker-
+        prefixed under a fleet identity so N workers sharing one
+        journal can never collide; checkpoint-restored requests keep
+        their original ids, which may sit ahead of this counter — the
+        skip loop never overwrites one."""
+        prefix = f"{self.worker_id}-r" if self.worker_id else "r"
+        self._n += 1
+        rid = f"{prefix}{self._n:04d}"
+        while rid in self._requests:
+            self._n += 1
+            rid = f"{prefix}{self._n:04d}"
         return rid
 
     def request(self, rid: str) -> Request:
@@ -847,11 +864,28 @@ class Scheduler:
 
     # ----------------------------------------------------------- grouping
 
-    def _take_compatible(self, key: str) -> list:
-        """Pop every queued request with this compile key (FIFO order)."""
+    def _take_compatible(self, key: str,
+                         progress_ms: int | None = None) -> list:
+        """Pop every queued request with this compile key (FIFO
+        order).  With `progress_ms` set (the lockstep lane-repack
+        admission), only requests that can soundly join a RUNNING
+        group at that chunk boundary: equal progress AND a restored
+        state (checkpoint, preemption or fork) — a fresh request
+        enters at progress 0 and can never match a mid-run boundary,
+        while equal progress under one compile key implies equal
+        device time arrays, which is all the fused mailbox/shared-jump
+        engines require."""
         with self._mu:
-            taken = [rid for rid in self._queue
-                     if self._requests[rid].compile_key == key]
+            taken = []
+            for rid in self._queue:
+                r = self._requests[rid]
+                if r.compile_key != key:
+                    continue
+                if progress_ms is not None and (
+                        r.progress_ms != progress_ms
+                        or r.restored_state is None):
+                    continue
+                taken.append(rid)
             for rid in taken:
                 self._queue.remove(rid)
             return [self._requests[rid] for rid in taken]
@@ -1134,7 +1168,13 @@ class Scheduler:
             return None
         import os
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        return os.path.join(self.checkpoint_dir, f"group-{key[:16]}.npz")
+        # fleet workers share one checkpoint_dir: the worker prefix
+        # keeps two workers running the same compile key from
+        # clobbering each other's boundary file (single-process
+        # filenames are unchanged)
+        tag = f"{self.worker_id}-" if self.worker_id else ""
+        return os.path.join(self.checkpoint_dir,
+                            f"group-{tag}{key[:16]}.npz")
 
     def _save_checkpoint(self, key: str, lanes: list, state):
         """Write the group's chunk-boundary state + request metadata
@@ -1148,6 +1188,7 @@ class Scheduler:
 
         from ..utils import checkpoint
         meta = {"compile_key": key, "schema": CKPT_META_SCHEMA,
+                "worker": self.worker_id,
                 "requests": [
                     {"id": ln.req.id,
                      "spec": ln.req.spec.to_json(),
@@ -1188,7 +1229,7 @@ class Scheduler:
         re-runs instead)."""
         self._drop_checkpoint(key)
 
-    def resume_checkpoints(self) -> list:
+    def resume_checkpoints(self, accept=None) -> list:
         """Re-enqueue every interrupted group found in
         `checkpoint_dir`; returns the re-created request ids.  Each
         request resumes from its group's last written chunk boundary —
@@ -1197,6 +1238,13 @@ class Scheduler:
         `first_divergence`-style full-pytree comparison passes
         (tests/test_serve_resilience.py).  Run `run_pending()` (or the
         service worker) afterwards to drive them to completion.
+
+        `accept(path, meta) -> bool` filters candidate files by their
+        metadata BEFORE the leaf arrays load (the fleet seam: a
+        survivor adopting a dead worker's checkpoints must take only
+        files whose every request it holds the lease for — adopting a
+        LIVE worker's file would fork the run's identity).  None
+        accepts everything (the single-process restart).
 
         Staleness refusal (module docstring): a `StaleCheckpointError`
         — checkpoint meta from another schema, or a stored spec that
@@ -1212,7 +1260,7 @@ class Scheduler:
         for path in sorted(glob.glob(os.path.join(
                 self.checkpoint_dir, "group-*.npz"))):
             try:
-                resumed += self._resume_one(path)
+                resumed += self._resume_one(path, accept=accept)
             except StaleCheckpointError:
                 raise       # a staleness refusal, never swallowed
             except Exception as e:      # noqa: BLE001 — one bad file
@@ -1222,7 +1270,7 @@ class Scheduler:
                       f"{type(e).__name__}: {e!s:.300}", file=sys.stderr)
         return resumed
 
-    def _resume_one(self, path: str) -> list:
+    def _resume_one(self, path: str, accept=None) -> list:
         from ..utils import checkpoint
         specs_meta = checkpoint.peek_meta(path)
         for problem in checkpoint.stale_meta_problems(specs_meta):
@@ -1230,6 +1278,8 @@ class Scheduler:
                 f"serve: refusing checkpoint {path}: {problem}. "
                 "Fix: delete the stale file (the run restarts from "
                 "scratch), or resume with the tree/spec that wrote it")
+        if accept is not None and not accept(path, specs_meta):
+            return []
         reqs_meta = specs_meta["requests"]
         spec0 = ScenarioSpec.from_json(reqs_meta[0]["spec"])
         proto = spec0.build_protocol()
@@ -1243,9 +1293,8 @@ class Scheduler:
                 sl = jax.tree.map(lambda x, lo=lo, w=w: x[lo:lo + w],
                                   (net, ps))
                 lo += w
-                self._n += 1
                 rid = rm["id"] if rm["id"] not in self._requests \
-                    else f"r{self._n:04d}"
+                    else self._rid_locked()
                 req = Request(
                     id=rid, spec=spec,
                     compile_key=specs_meta["compile_key"],
@@ -1259,6 +1308,20 @@ class Scheduler:
                 self._queue.append(rid)
                 rids.append(rid)
             self.resilience["resumed"] += len(rids)
+        # adoption CONSUMES a foreign worker's file: this scheduler
+        # checkpoints the group under its OWN name from the next
+        # boundary on, so a dead worker's file left behind would go
+        # stale immediately — and a stale same-key file is exactly
+        # what a second adopter could fork the run's identity from.
+        # (Our own file keeps the PR-15 lifecycle: overwritten each
+        # boundary, dropped at group completion.)
+        import contextlib
+        import os
+        own = self._ckpt_path(specs_meta["compile_key"])
+        if own is not None and os.path.abspath(path) != \
+                os.path.abspath(own):
+            with contextlib.suppress(OSError):
+                os.remove(path)
         return rids
 
     # ------------------------------------------------------------ journal
@@ -1277,45 +1340,65 @@ class Scheduler:
         request ids."""
         if self.journal is None:
             return []
-        import sys
         entries = self.journal.replay()
         rids = []
         with self._mu:
             for e in entries:
-                rid = e.get("rid")
-                if rid in self._requests:
-                    # already live — resumed from its checkpoint, or a
-                    # double replay: refuse the duplicate (re-running
-                    # a live request would fork its identity)
-                    print(f"serve: journal entry {rid} is already "
-                          "live (checkpoint-resumed or double "
-                          "replay); refused", file=sys.stderr)
-                    continue
-                try:
-                    spec = ScenarioSpec.from_json(e["spec"])
-                    resolved = spec.validate()
-                except (KeyError, ValueError, TypeError) as err:
-                    print(f"serve: journal entry {rid} no longer "
-                          f"validates ({err!s:.200}); skipped — the "
-                          "request must be re-submitted under the "
-                          "current tree", file=sys.stderr)
-                    continue
-                extra = dict(e.get("ledger_extra") or {})
-                # a replayed request re-runs its FULL span (the fork
-                # state died with the process — unforked is
-                # bit-identical): the provenance must not claim a
-                # fork the re-run didn't take
-                extra.pop("forked_from", None)
-                req = Request(id=rid, spec=resolved,
-                              compile_key=resolved.compile_key(),
-                              requested=spec, label=e.get("label"),
-                              ledger_extra=extra or None)
-                self._requests[rid] = req
-                self._queue.append(rid)
-                rids.append(rid)
+                rid = self._adopt_entry_locked(e)
+                if rid is not None:
+                    rids.append(rid)
             self.resilience["replayed"] += len(rids)
         self.journal.compact()
         return rids
+
+    def _adopt_entry_locked(self, e: dict) -> str | None:
+        """Re-enqueue ONE journal entry under its original rid (caller
+        holds the lock).  Returns the rid, or None when refused
+        (already live — re-running a live request would fork its
+        identity) or skipped (no longer validates) — both with the
+        stderr notes the crash tests pin."""
+        import sys
+        rid = e.get("rid")
+        if rid in self._requests:
+            print(f"serve: journal entry {rid} is already "
+                  "live (checkpoint-resumed or double "
+                  "replay); refused", file=sys.stderr)
+            return None
+        try:
+            spec = ScenarioSpec.from_json(e["spec"])
+            resolved = spec.validate()
+        except (KeyError, ValueError, TypeError) as err:
+            print(f"serve: journal entry {rid} no longer "
+                  f"validates ({err!s:.200}); skipped — the "
+                  "request must be re-submitted under the "
+                  "current tree", file=sys.stderr)
+            return None
+        extra = dict(e.get("ledger_extra") or {})
+        # a replayed request re-runs its FULL span (the fork
+        # state died with the process — unforked is
+        # bit-identical): the provenance must not claim a
+        # fork the re-run didn't take
+        extra.pop("forked_from", None)
+        req = Request(id=rid, spec=resolved,
+                      compile_key=resolved.compile_key(),
+                      requested=spec, label=e.get("label"),
+                      ledger_extra=extra or None)
+        self._requests[rid] = req
+        self._queue.append(rid)
+        return rid
+
+    def adopt_journal_entry(self, entry: dict) -> str | None:
+        """Re-enqueue ONE journal entry under its original rid — the
+        fleet worker's per-lease admission path (`resume_journal` is
+        the adopt-everything restart variant; a fleet worker adopts
+        exactly the entries whose lease it holds, so it must not
+        vacuum the whole journal).  Counts into
+        ``resilience["replayed"]``; returns the rid or None."""
+        with self._mu:
+            rid = self._adopt_entry_locked(entry)
+            if rid is not None:
+                self.resilience["replayed"] += 1
+            return rid
 
     def recover(self) -> dict:
         """Crash-only restart, one call: checkpoints first (mid-run
@@ -1364,15 +1447,24 @@ class Scheduler:
 
     # --------------------------------------------------------- preemption
 
-    def _waiting_elsewhere(self, key: str, engine: str) -> list:
+    def _waiting_elsewhere(self, key: str, engine: str,
+                           progress_ms: int | None = None) -> list:
         """Queued requests that CANNOT join the running group (caller
-        holds the lock): a different compile key, or a lockstep engine
-        that closed admission at launch.  Only these justify yielding
-        — a same-key vmapped request late-joins for free."""
+        holds the lock): a different compile key, or a lockstep lane
+        the repack admission can't absorb at the group's current
+        boundary (fresh request, or a restored one at a different
+        progress).  Only these justify yielding — a same-key vmapped
+        request late-joins for free, and a same-key restored lockstep
+        request at the group's progress repacks in for free too."""
         out = []
         for rid in self._queue:
             r = self._requests[rid]
-            if r.compile_key != key or engine != "vmapped":
+            if r.compile_key != key:
+                out.append(r)
+            elif engine != "vmapped" and not (
+                    progress_ms is not None
+                    and r.progress_ms == progress_ms
+                    and r.restored_state is not None):
                 out.append(r)
         return out
 
@@ -1384,7 +1476,8 @@ class Scheduler:
         engine = lanes[0].req.spec.engine
         now = time.time()
         with self._mu:
-            others = self._waiting_elsewhere(key, engine)
+            others = self._waiting_elsewhere(
+                key, engine, progress_ms=lanes[0].req.progress_ms)
             if not others:
                 return None
             group_pri = max(ln.req.spec.priority for ln in lanes)
@@ -1455,9 +1548,11 @@ class Scheduler:
         planes = list(spec0.obs)
         primary = "metrics" if "metrics" in planes else None
         shadows = [p for p in planes if p != primary]
-        # Lockstep engines (one fused mailbox / one shared jump over the
-        # whole batch) close admission at launch; the per-lane dense
-        # engine admits late joiners at every chunk boundary.
+        # The per-lane dense engine admits ANY same-key late joiner at
+        # every chunk boundary; lockstep engines (one fused mailbox /
+        # one shared jump over the whole batch) admit only restored
+        # requests whose clock matches the group's — see the repack
+        # branch at the bottom of the loop.
         admit_inflight = spec0.engine == "vmapped"
         lanes = [_Lane(r) for r in reqs]
         proto0 = spec0.build_protocol()     # ONE construction per group
@@ -1606,15 +1701,30 @@ class Scheduler:
                     return done, chunks_run
             if admit_inflight:
                 joiners = self._take_compatible(key)
-                if joiners:
-                    now = time.time()
-                    with self._mu:
-                        for r in joiners:
-                            r.status, r.started = "running", now
-                    new = self._init_lanes(joiners, proto0)
-                    state = self._concat(
-                        ([state] if lanes else []) + new)
-                    lanes.extend(_Lane(r) for r in joiners)
+            elif lanes:
+                # lockstep lane repacking: a restored request
+                # (checkpoint, preemption or fork) whose saved boundary
+                # equals this group's clock re-enters HERE instead of
+                # stranding until the group finishes — equal progress
+                # under one compile key means equal device time arrays,
+                # so the fused mailbox / shared jump stays sound and
+                # the continuation is the same program it would have
+                # run solo (the bit-identity tests pin this)
+                joiners = self._take_compatible(
+                    key, progress_ms=lanes[0].req.progress_ms)
+            else:
+                joiners = []
+            if joiners:
+                now = time.time()
+                with self._mu:
+                    if not admit_inflight:
+                        self.resilience["repacked"] += len(joiners)
+                    for r in joiners:
+                        r.status, r.started = "running", now
+                new = self._init_lanes(joiners, proto0)
+                state = self._concat(
+                    ([state] if lanes else []) + new)
+                lanes.extend(_Lane(r) for r in joiners)
         return done, chunks_run
 
     # -------------------------------------------------------------- memo

@@ -37,7 +37,7 @@ import dataclasses
 import threading
 import time
 
-from .scheduler import Scheduler
+from .scheduler import AdmissionError, Scheduler, TenantPolicy
 from .spec import ScenarioSpec
 
 
@@ -287,3 +287,268 @@ class Service:
         out = dict(job.report)
         out["status"] = "done"
         return out
+
+
+class FleetService:
+    """Front tier over a shared fleet directory (serve/fleet.py): the
+    `Service` JSON surface for the core batch routes, backed by FILES
+    instead of an in-process scheduler.  Submits are fsync'd journal
+    appends (durable-ack — the same fsync-before-ack promise as
+    `Scheduler.submit` with a journal, minus the in-process queue),
+    status reads journal tombstones + the lease table (a leased entry
+    is "running", with the holding worker named), results are served
+    from the shared ledger's completion rows (the PR-13 digest join —
+    bit-identical to the worker's live artifacts by construction), and
+    health/registry aggregate the workers' atomically-published stats
+    snapshots.
+
+    The 429 tenancy contract is preserved front-side: a tenant's LIVE
+    (accepted-but-unsettled) journal entries count against its
+    `max_queued`, and refusals carry a retry-after derived from the
+    fleet's aggregated chunk-wall EMA — `AdmissionError` flows through
+    `server/http.py` exactly as the single-process path does.  Fairness
+    WITHIN the fleet stays with the workers' own schedulers (DRR over
+    whatever each worker has leased).
+
+    Long-poll streaming and the matrix routes need an in-process
+    scheduler and are not served by the front tier — drive those
+    against a worker, or use `matrix.run_grid(workers=N)`.
+    """
+
+    #: lock inventory (analysis rule ``host_locks``): the rid counter
+    #: and the rid->digest result-join cache are touched from every
+    #: HTTP thread.
+    _LOCK_OWNS = {"_mu": ("_n", "_digests")}
+
+    def __init__(self, fleet_dir, *, front_id: str | None = None,
+                 tenants: dict | None = None):
+        import os
+
+        from .fleet import fleet_paths
+        from .journal import LeaseTable, SubmissionJournal
+        self.paths = fleet_paths(fleet_dir)
+        self.journal = SubmissionJournal(self.paths["journal_dir"])
+        self.leases = LeaseTable(self.paths["journal_dir"])
+        #: rid prefix — pid-salted by default so a restarted front
+        #: tier can never re-mint a rid the journal already holds
+        self.front_id = str(front_id) if front_id \
+            else f"front{os.getpid()}"
+        self.tenants = {name: (pol if isinstance(pol, TenantPolicy)
+                               else TenantPolicy(**pol))
+                        for name, pol in (tenants or {}).items()}
+        self._mu = threading.Lock()
+        self._n = 0
+        self._digests: dict = {}    # rid -> as-submitted spec digest
+
+    # ---------------------------------------------------------- admission
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        pol = self.tenants.get(tenant) or self.tenants.get("*")
+        return pol or TenantPolicy()
+
+    def _admit(self, resolved: ScenarioSpec):
+        """The front-side 429: live journal entries are the fleet's
+        queue, so they are what bounds a tenant (mirrors
+        `Scheduler._admit`, which counts in-process queued requests)."""
+        pol = self.policy(resolved.tenant)
+        if not pol.max_queued:
+            return
+        mine = [e for e in self.journal.replay()
+                if (e.get("spec") or {}).get("tenant", "default")
+                == resolved.tenant]
+        if len(mine) < pol.max_queued:
+            return
+        backlog_chunks = 0
+        for e in mine:
+            s = e.get("spec") or {}
+            try:
+                backlog_chunks += (int(s.get("sim_ms", 0))
+                                   // max(1, int(s.get("chunk_ms", 1))))
+            except (TypeError, ValueError) as ex:
+                import sys
+                print(f"fleet front: journal entry {e.get('rid')!r} "
+                      f"has non-numeric sim_ms/chunk_ms ({ex}); it "
+                      "still counts against the tenant's queue but "
+                      "not the retry-after backlog", file=sys.stderr)
+        retry = max(pol.retry_after_s,
+                    backlog_chunks * self._fleet_ema())
+        raise AdmissionError(
+            f"tenant {resolved.tenant!r} fleet backlog is full "
+            f"({len(mine)}/{pol.max_queued} unsettled submissions): "
+            f"retry after ~{retry:.1f}s, raise the tenant's "
+            "max_queued, or split the submission across tenants",
+            retry_after_s=retry)
+
+    # --------------------------------------------------------- endpoints
+
+    def submit(self, body: dict) -> dict:
+        """POST /w/batch/submit — validate, admit, fsync the journal
+        row, THEN ack (the durable-ack order; an OSError from the
+        append raises through as a loud 500-equivalent, never a silent
+        ack)."""
+        spec = ScenarioSpec.from_json(body or {})
+        resolved = spec.validate()
+        self._admit(resolved)
+        with self._mu:
+            self._n += 1
+            rid = f"{self.front_id}-r{self._n:04d}"
+        self.journal.record_submit(rid, spec)
+        with self._mu:
+            self._digests[rid] = spec.digest()
+        return {"id": rid, "status": "queued",
+                "compile_key": resolved.compile_key()}
+
+    def status(self, rid: str) -> dict:
+        """GET /w/batch/status/{id} — journal tombstone beats lease
+        beats queue; unknown rids raise KeyError (the 400 path, like
+        `Scheduler.request`)."""
+        settled = self.journal.settled()
+        if rid in settled:
+            return {"id": rid, "status": settled[rid]}
+        if any(e.get("rid") == rid for e in self.journal.replay()):
+            w = self.leases.holder(rid)
+            if w is not None:
+                return {"id": rid, "status": "running", "worker": w}
+            return {"id": rid, "status": "queued"}
+        raise KeyError(f"unknown request {rid!r}")
+
+    def _digest_of(self, rid: str):
+        with self._mu:
+            dig = self._digests.get(rid)
+        if dig is not None:
+            return dig
+        # a restarted front tier recovers the digest from the journal's
+        # submit row (still present until a quiescent compaction)
+        row = self.journal.lookup(rid)
+        if row is not None:
+            try:
+                return ScenarioSpec.from_json(row["spec"]).digest()
+            except (KeyError, ValueError, TypeError) as e:
+                import sys
+                print(f"fleet front: journal row for {rid!r} has no "
+                      f"parseable spec ({type(e).__name__}: "
+                      f"{e!s:.120}); result() falls back to the "
+                      "status snapshot", file=sys.stderr)
+                return None
+        return None
+
+    def result(self, rid: str) -> dict:
+        """GET /w/batch/result/{id} — the ledger row's durable
+        completion facts when done (summary, audit verdict,
+        time_to_done), else the status snapshot (poll-friendly)."""
+        out = self.status(rid)
+        if out["status"] != "done":
+            return out
+        from ..matrix.driver import _row_artifacts
+        from .fleet import clean_rows_by_digest
+        dig = self._digest_of(rid)
+        row = clean_rows_by_digest(
+            self.paths["ledger_path"]).get(dig) if dig else None
+        if row is None:
+            out["note"] = ("completed (journal tombstone) but no clean "
+                           "ledger row found — ledger compacted or "
+                           "spec digest unrecoverable")
+            return out
+        return {**out, "artifacts": _row_artifacts(row)}
+
+    def run_pending(self) -> dict:
+        """POST /w/batch/run — the workers drain; the front tier has
+        nothing to run (kept so manual-mode callers get an honest
+        answer instead of a 404)."""
+        return {"processed": 0, "fleet": True,
+                "journal_lag": self.journal.lag()}
+
+    # ------------------------------------------------------- aggregation
+
+    def worker_stats(self) -> dict:
+        """worker id -> its last atomically-published stats snapshot
+        (serve/fleet.py `FleetWorker.write_stats`); unreadable files
+        are skipped with a stderr note (a worker mid-first-write)."""
+        import glob
+        import json
+        import os
+        import sys
+        out: dict = {}
+        for path in sorted(glob.glob(os.path.join(
+                self.paths["stats_dir"], "worker-*.json"))):
+            try:
+                with open(path) as f:
+                    row = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"fleet front: unreadable worker stats {path} "
+                      f"({e}); skipped", file=sys.stderr)
+                continue
+            out[str(row.get("worker")
+                    or os.path.basename(path))] = row
+        return out
+
+    def _fleet_ema(self) -> float:
+        """Mean chunk-wall EMA across workers that have one — the
+        front tier's retry-after unit cost (1.0 s while cold)."""
+        emas = [w.get("health", {}).get("chunk_wall_ema_s") or 0.0
+                for w in self.worker_stats().values()]
+        emas = [e for e in emas if e > 0]
+        return sum(emas) / len(emas) if emas else 1.0
+
+    def health(self) -> dict:
+        """GET /w/batch/health — the fleet aggregation: journal lag,
+        the lease table (who runs what), queue depths derived from
+        live-but-unleased entries, and each worker's own health
+        block."""
+        live = self.journal.replay()
+        leased = self.leases.live()
+        queued_by_tenant: dict = {}
+        for e in live:
+            if e.get("rid") in leased:
+                continue
+            t = (e.get("spec") or {}).get("tenant", "default")
+            queued_by_tenant[t] = queued_by_tenant.get(t, 0) + 1
+        workers = self.worker_stats()
+        return {"fleet": True,
+                "queued": sum(queued_by_tenant.values()),
+                "queued_by_tenant": queued_by_tenant,
+                "running": len(leased),
+                "journal": True,
+                "journal_lag": len(live),
+                "leases": self.leases.workers(),
+                "chunk_wall_ema_s": round(self._fleet_ema(), 4),
+                "workers": {wid: w.get("health", {})
+                            for wid, w in workers.items()},
+                "worker_counters": {
+                    wid: {k: w[k] for k in
+                          ("claimed", "deduped", "released",
+                           "adopted_checkpoints", "processed")
+                          if k in w}
+                    for wid, w in workers.items()}}
+
+    def registry_stats(self) -> dict:
+        """GET /w/batch/registry — numeric fields summed across the
+        workers' registry blocks (requests-per-build across the fleet
+        needs the SUM of builds, not any one worker's)."""
+        agg: dict = {}
+        per: dict = {}
+        for wid, w in self.worker_stats().items():
+            reg = w.get("registry") or {}
+            per[wid] = reg
+            for k, v in reg.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        return {"fleet": True, "aggregate": agg, "workers": per}
+
+    def tenancy_stats(self) -> dict:
+        """GET /w/batch/tenancy — front-side queue depths + policies
+        (per-worker DRR counters live in each worker's own stats)."""
+        h = self.health()
+        out = {"tenants": {}, "fleet": True,
+               "chunk_wall_ema_s": h["chunk_wall_ema_s"]}
+        for t in set(h["queued_by_tenant"]) | set(
+                k for k in self.tenants if k != "*"):
+            pol = self.policy(t)
+            out["tenants"][t] = {
+                "queued": h["queued_by_tenant"].get(t, 0),
+                "weight": pol.weight, "max_queued": pol.max_queued}
+        return out
+
+    def close(self):
+        """Symmetry with `Service.close` (nothing to stop: the front
+        tier owns no threads)."""

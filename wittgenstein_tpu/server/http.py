@@ -283,31 +283,47 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(port: int = 0, batch_auto: bool = True,
-                scheduler=None) -> ThreadingHTTPServer:
+                scheduler=None, batch_service=None) -> ThreadingHTTPServer:
     """`batch_auto=False` gives a manual-drain batch service (POST
     /w/batch/run runs the queue) — deterministic for tests; the default
     drains on a background worker so submits return immediately.
     `scheduler` lets an operator serve a pre-configured
     `serve.Scheduler` (tenancy policies, checkpoint_dir, ledger path)
-    behind the same routes."""
+    behind the same routes.  `batch_service` replaces the whole batch
+    backend — the fleet front tier (`serve.FleetService`) serves the
+    same routes over a shared fleet directory this way."""
     from ..serve import Service
 
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     httpd.sim_server = core.Server()
     httpd.sim_lock = threading.Lock()
-    httpd.batch_service = Service(scheduler=scheduler, auto=batch_auto)
+    httpd.batch_service = batch_service if batch_service is not None \
+        else Service(scheduler=scheduler, auto=batch_auto)
     return httpd
 
 
-def main(port: int = 8078):
+def main(port: int = 8078, fleet_dir: str | None = None):
     # Protocol registry fills as models import (the classpath-scan analogue)
     from .. import models  # noqa: F401
-    httpd = make_server(port)
+    svc = None
+    if fleet_dir is not None:
+        from ..serve.service import FleetService
+        svc = FleetService(fleet_dir)
+    httpd = make_server(port, batch_service=svc)
+    backend = f"fleet dir {fleet_dir}" if fleet_dir else "in-process"
     print(f"wittgenstein-tpu server on http://127.0.0.1:"
-          f"{httpd.server_address[1]}/w")
+          f"{httpd.server_address[1]}/w ({backend})")
     httpd.serve_forever()
 
 
 if __name__ == "__main__":
-    import sys
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8078)
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="wittgenstein-tpu HTTP server")
+    ap.add_argument("port", nargs="?", type=int, default=8078)
+    ap.add_argument("--fleet-dir", default=None,
+                    help="serve the batch routes from a shared fleet "
+                         "directory (serve.FleetService) instead of an "
+                         "in-process scheduler")
+    a = ap.parse_args()
+    main(a.port, fleet_dir=a.fleet_dir)

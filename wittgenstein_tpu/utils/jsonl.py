@@ -90,6 +90,66 @@ def read_lines(path, label: str = "jsonl") -> list:
     return [row for _, row in iter_lines(path, label=label)]
 
 
+class TailReader:
+    """Incremental reader for a GROWING append-only log: each `poll()`
+    parses only the bytes appended since the last poll, so a fleet
+    worker re-scanning a shared ledger/journal every cycle pays
+    O(new rows), not O(file).
+
+    Two append-only-log realities are handled explicitly:
+
+      * a torn tail (crash mid-append) is NOT consumed — the partial
+        line stays buffered until more bytes arrive, and if the line
+        never completes it is reported once via `iter_lines` semantics
+        on the next full re-read;
+      * a file that SHRANK (compaction's atomic `os.replace`) resets
+        the reader to offset 0 — compacted history re-parses once,
+        which is correct because compaction only ever rewrites a
+        subset of rows the reader may already have seen (callers keep
+        idempotent accumulators, e.g. dict-by-digest).
+
+    Rows are returned parsed; malformed COMPLETE interior lines are
+    skipped with the same stderr note as `iter_lines`."""
+
+    def __init__(self, path, label: str = "jsonl"):
+        self.path = str(path)
+        self.label = label
+        self._offset = 0
+
+    def poll(self) -> list:
+        """Parse and return the rows appended since the last poll."""
+        p = pathlib.Path(self.path)
+        try:
+            size = p.stat().st_size
+        except OSError:
+            self._offset = 0
+            return []
+        if size < self._offset:        # compaction replaced the file
+            self._offset = 0
+        if size == self._offset:
+            return []
+        with open(p, "rb") as f:        # binary: offsets are bytes
+            f.seek(self._offset)
+            chunk = f.read(size - self._offset)
+        # only consume COMPLETE lines; a torn tail stays unconsumed so
+        # the in-flight append (or the crash report) happens later
+        keep = chunk.rfind(b"\n") + 1
+        if keep == 0:
+            return []
+        self._offset += keep
+        rows = []
+        for raw in chunk[:keep].splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rows.append(json.loads(raw.decode()))
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                print(f"{self.label}: skipping malformed row of "
+                      f"{p}: {e}", file=sys.stderr)
+        return rows
+
+
 def rewrite(path, rows) -> str:
     """Atomically replace `path` with exactly `rows` (write-temp +
     `os.replace`, so a crash mid-rewrite leaves the previous file
