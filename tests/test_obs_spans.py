@@ -1,0 +1,258 @@
+"""Host-plane flight recorder (PR 18) — spans, metrics, Perfetto merge.
+
+Acceptance pins:
+  * span determinism: the SAME emit sequence under an injected fake
+    clock yields BYTE-identical JSONL logs (the recorder's only time
+    source is the injected clock);
+  * spans-OFF zero overhead: an uninstrumented scheduler completes a
+    full request lifecycle without ever touching the recorder or the
+    registry (their write paths are rigged to explode), and leaves no
+    instrumentation residue on the request record;
+  * a SIGKILL-torn span log (half a trailing line) still parses to
+    every complete row;
+  * `spans_to_perfetto` merges host spans with device Perfetto lanes
+    and survives a JSON round trip (one process per worker, one track
+    per request, metadata + slices + instants all present);
+  * the metrics exposition parses and every counter/histogram series
+    is monotone across scrapes;
+  * an instrumented scheduler emits the full ordered lifecycle span
+    set and surfaces span-derived phase quantiles in health_stats.
+"""
+
+import json
+import os
+
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+from wittgenstein_tpu.obs.export import (SPAN_PID_BASE,
+                                         spans_to_perfetto)
+from wittgenstein_tpu.obs.metrics import (MetricsRegistry,
+                                          parse_exposition)
+from wittgenstein_tpu.obs.spans import SpanRecorder, read_spans
+from wittgenstein_tpu.serve import ScenarioSpec, Scheduler
+from wittgenstein_tpu.serve.instrument import (HEALTH_PHASES,
+                                               LIFECYCLE,
+                                               Instrumentation)
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0,), sim_ms=80, chunk_ms=40, obs=("metrics",))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+class FakeClock:
+    """A deterministic monotonic clock: each call advances 1 ms."""
+
+    def __init__(self, t=100.0, step=0.001):
+        self.t, self.step = t, step
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+
+def _emit_sequence(rec):
+    t0 = rec.now()
+    rec.emit("serve.submit", t0, rid="r1", key="k", tenant="t")
+    rec.mark("serve.retry", attempt=1, error="ValueError")
+    with rec.span("serve.chunk", key="k", lanes=2):
+        rec.now()
+    rec.emit("serve.settle", rec.now(), rid="r1", wall_s=0.25)
+
+
+# ------------------------------------------------------- determinism
+
+def test_fake_clock_byte_identical_jsonl(tmp_path):
+    paths = []
+    for run in ("a", "b"):
+        p = tmp_path / f"spans-{run}.jsonl"
+        rec = SpanRecorder(path=p, clock=FakeClock(), worker="w0")
+        _emit_sequence(rec)
+        paths.append(p)
+    a, b = (p.read_bytes() for p in paths)
+    assert a == b
+    assert a.count(b"\n") == 4
+    rows = read_spans(paths[0])
+    assert [r["name"] for r in rows] == [
+        "serve.submit", "serve.retry", "serve.chunk", "serve.settle"]
+    # injected clock governs every timestamp: values are exact
+    assert rows[0]["t0"] == pytest.approx(100.001)
+    assert rows[1]["dur"] == 0.0
+    assert all(r["worker"] == "w0" for r in rows)
+
+
+def test_ring_bounded_and_stats():
+    rec = SpanRecorder(capacity=4, clock=FakeClock())
+    for i in range(10):
+        rec.mark("m", i=i)
+    st = rec.stats()
+    assert st["emitted"] == 10 and st["in_ring"] == 4
+    assert [r["i"] for r in rec.snapshot()] == [6, 7, 8, 9]
+    q = rec.phase_quantiles()
+    assert q["m"]["count"] == 4 and q["m"]["p50_ms"] == 0.0
+
+
+# ------------------------------------------------- spans-OFF overhead
+
+def test_spans_off_zero_overhead(monkeypatch):
+    """The uninstrumented hot path must never touch the recorder or
+    the registry: rig both write paths to explode, then run a full
+    lifecycle with the default instrument=None."""
+    def boom(*a, **k):
+        raise AssertionError("instrumentation touched with spans OFF")
+    monkeypatch.setattr(SpanRecorder, "emit", boom)
+    monkeypatch.setattr(MetricsRegistry, "observe", boom)
+    monkeypatch.setattr(MetricsRegistry, "inc", boom)
+    sch = Scheduler()
+    assert sch._ins is None
+    rid = sch.submit(_spec())
+    req = sch.peek(rid)
+    assert req.enq_mono is None     # no queue-wait clock read either
+    sch.run_pending()
+    req = sch.request(rid)
+    assert req.status == "done", req.error
+    assert req.enq_mono is None
+    assert "phases" not in sch.health_stats()
+
+
+# ----------------------------------------------------------- torn tail
+
+def test_torn_tail_log_still_parses(tmp_path):
+    p = tmp_path / "spans-dead.jsonl"
+    rec = SpanRecorder(path=p, clock=FakeClock(), worker="w1")
+    _emit_sequence(rec)
+    with open(p, "ab") as f:        # the SIGKILL mid-append shape
+        f.write(b'{"schema": 1, "name": "serve.chu')
+    rows = read_spans(p)
+    assert len(rows) == 4
+    assert rows[-1]["name"] == "serve.settle"
+
+
+def test_non_span_rows_skipped(tmp_path, capsys):
+    p = tmp_path / "spans-x.jsonl"
+    rec = SpanRecorder(path=p, clock=FakeClock())
+    rec.mark("ok")
+    with open(p, "a") as f:
+        f.write(json.dumps({"not": "a span"}) + "\n")
+    rows = read_spans(p)
+    assert [r["name"] for r in rows] == ["ok"]
+    assert "not a span" in capsys.readouterr().err
+
+
+# ------------------------------------------------------ Perfetto merge
+
+def test_perfetto_merge_round_trip(tmp_path):
+    recs = {w: SpanRecorder(clock=FakeClock(), worker=w)
+            for w in ("w0", "w1")}
+    for w, rec in recs.items():
+        t0 = rec.now()
+        rec.emit("serve.queue_wait", t0, rid=f"{w}-r0")
+        rec.emit("serve.chunk", rec.now(), key="k")
+        rec.mark("serve.retry", attempt=1)
+    rows = [r for rec in recs.values() for r in rec.snapshot()]
+    device = {"traceEvents": [
+        {"ph": "M", "pid": 90210, "name": "process_name",
+         "args": {"name": "wtpu engine"}},
+        {"ph": "X", "pid": 90210, "tid": 0, "ts": 0, "dur": 1000,
+         "name": "interval"}]}
+    out = tmp_path / "timeline.json"
+    trace = spans_to_perfetto(rows, device=device, path=out)
+    loaded = json.loads(out.read_text())
+    assert loaded == json.loads(json.dumps(trace))
+    ev = loaded["traceEvents"]
+    assert loaded["displayTimeUnit"] == "ms"
+    pids = {e["pid"] for e in ev}
+    assert {SPAN_PID_BASE, SPAN_PID_BASE + 1, 90210} <= pids
+    meta = [e for e in ev if e["ph"] == "M"]
+    names = {(e["pid"], e["name"], e["args"]["name"]) for e in meta}
+    assert any(n[2].endswith("worker w0 (wall time)") for n in names)
+    assert any(n[2] == "request w1-r0" for n in names)
+    # durations became X slices, marks became instants, device events
+    # passed through untouched
+    assert any(e["ph"] == "X" and e["pid"] >= SPAN_PID_BASE
+               for e in ev)
+    assert any(e["ph"] == "i" and e.get("s") == "t" for e in ev)
+    assert any(e["pid"] == 90210 and e["ph"] == "X" for e in ev)
+    # span attrs ride along as args, minus the layout fields
+    qw = next(e for e in ev if e["name"] == "serve.queue_wait")
+    assert qw["args"]["rid"].endswith("-r0")
+    assert "t0" not in qw["args"] and "worker" not in qw["args"]
+
+
+# ------------------------------------------------------------- metrics
+
+def test_metrics_exposition_parses_and_is_monotone():
+    m = MetricsRegistry()
+    m.inc("wtpu_x_total", 2, help="a counter")
+    m.set_gauge("wtpu_depth", 3)
+    m.observe("wtpu_lat_seconds", 0.05, buckets=(0.01, 0.1, 1.0))
+    s0 = parse_exposition(m.exposition())
+    assert s0["wtpu_x_total"] == 2.0
+    assert s0['wtpu_lat_seconds_bucket{le="0.1"}'] == 1.0
+    assert s0['wtpu_lat_seconds_bucket{le="+Inf"}'] == 1.0
+    assert s0["wtpu_lat_seconds_count"] == 1.0
+    m.inc("wtpu_x_total")
+    m.observe("wtpu_lat_seconds", 5.0)
+    m.set_gauge("wtpu_depth", 1)    # gauges may regress; counters not
+    s1 = parse_exposition(m.exposition())
+    for k in s0:
+        if k == "wtpu_depth":
+            continue
+        assert s1[k] >= s0[k], k
+    assert s1['wtpu_lat_seconds_bucket{le="+Inf"}'] == 2.0
+
+
+def test_metrics_counter_discipline():
+    m = MetricsRegistry()
+    with pytest.raises(ValueError):
+        m.inc("wtpu_x_total", -1)
+    m.set_counter("wtpu_x_total", 5)
+    m.set_counter("wtpu_x_total", 3)    # stale projection: keeps max
+    assert m.snapshot()["counters"]["wtpu_x_total"] == 5
+    # exposition is deterministic: same state, same bytes
+    assert m.exposition() == m.exposition()
+
+
+# ------------------------------------------- instrumented end to end
+
+def test_instrumented_lifecycle_and_health(tmp_path):
+    ins = Instrumentation(
+        span_path=os.path.join(tmp_path, "spans-serve.jsonl"),
+        worker="serve")
+    sch = Scheduler(instrument=ins)
+    rid = sch.submit(_spec())
+    sch.run_pending()
+    req = sch.request(rid)
+    assert req.status == "done", req.error
+    rows = ins.spans.snapshot()
+    first = {}
+    for r in rows:
+        first.setdefault(r["name"], r["t0"])
+    assert not [n for n in LIFECYCLE if n not in first]
+    order = [first[n] for n in LIFECYCLE]
+    assert order == sorted(order)
+    settle = next(r for r in rows if r["name"] == "serve.settle")
+    assert settle["rid"] == rid and settle["worker"] == "serve"
+    # the durable log agrees with the ring
+    disk = read_spans(os.path.join(tmp_path, "spans-serve.jsonl"))
+    assert [r["name"] for r in disk] == [r["name"] for r in rows]
+    # health carries the span-derived phase quantiles
+    phases = sch.health_stats()["phases"]
+    assert set(phases) <= set(HEALTH_PHASES)
+    assert phases["serve.queue_wait"]["count"] >= 1
+    # ... and the phase histograms were fed at emit time
+    hists = ins.metrics.snapshot()["histograms"]
+    assert hists["wtpu_serve_queue_wait_seconds"]["count"] >= 1
+    assert hists["wtpu_serve_chunk_seconds"]["count"] >= 2
+
+
+def test_scheduler_exposition_uninstrumented():
+    from wittgenstein_tpu.serve.instrument import scheduler_exposition
+    sch = Scheduler()
+    text = scheduler_exposition(sch)
+    parsed = parse_exposition(text)
+    assert parsed["wtpu_serve_submits_total"] == 0.0
+    assert parsed["wtpu_serve_queue_depth"] == 0.0

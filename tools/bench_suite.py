@@ -14,9 +14,13 @@ memo_smoke (PR 14 — snapshot-fork prefix sharing bit-identical to the
 unmemoized run, prefix_chunks_saved == the fork plan's prediction) and
 crash_smoke (PR 15 — one real SIGKILL of a subprocess campaign,
 journal+checkpoint resume, report bit-identity asserted, plus the
-/w/batch/health round trip over real HTTP) and analysis_smoke (PR 16
+/w/batch/health round trip over real HTTP), analysis_smoke (PR 16
 — the full `--source` static-analysis pass as a subprocess, budgets
-enforced, wall time under 60 s).
+enforced, wall time under 60 s) and spans_smoke (PR 18 — one
+instrumented request with the host flight recorder ON: the lifecycle
+span set asserted complete and ordered, the /w/batch/metrics
+Prometheus endpoint round-tripped over real HTTP with monotone
+counters across scrapes).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
@@ -833,6 +837,86 @@ def bench_fleet_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_spans_smoke():
+    """Host-plane observability smoke stage (PR 18): one instrumented
+    request through the serve scheduler with the flight recorder ON,
+    asserting the whole lifecycle span set (submit -> queue_wait ->
+    compile -> launch -> chunk -> settle) is present and ordered, and
+    the `/w/batch/metrics` Prometheus endpoint round-trips over REAL
+    HTTP — two scrapes bracket the run, both parse, and every counter
+    and histogram series is monotone across them."""
+    import threading
+    import time
+    import urllib.request
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.obs.metrics import parse_exposition
+    from wittgenstein_tpu.serve import ScenarioSpec, Scheduler
+    from wittgenstein_tpu.serve.instrument import (LIFECYCLE,
+                                                   Instrumentation)
+    from wittgenstein_tpu.server.http import make_server
+
+    ins = Instrumentation(worker="smoke")
+    sch = Scheduler(quantum_chunks=2, instrument=ins)
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": 64},
+                        seeds=(0,), sim_ms=120, chunk_ms=40,
+                        obs=("metrics",))
+    httpd = make_server(port=0, batch_auto=False, scheduler=sch)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def scrape():
+        with urllib.request.urlopen(f"{base}/w/batch/metrics",
+                                    timeout=10) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            assert ctype.startswith("text/plain"), ctype
+            return parse_exposition(resp.read().decode())
+
+    t0 = time.perf_counter()
+    try:
+        m0 = scrape()
+        rid = sch.submit(spec)
+        sch.run_pending()
+        req = sch.request(rid)
+        assert req.status == "done", req.error
+        m1 = scrape()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    wall = time.perf_counter() - t0
+    rows = ins.spans.snapshot()
+    first = {}
+    for r in rows:
+        first.setdefault(r["name"], r["t0"])
+    missing = [n for n in LIFECYCLE if n not in first]
+    assert not missing, f"lifecycle spans missing: {missing}"
+    order = [first[n] for n in LIFECYCLE]
+    assert order == sorted(order), \
+        f"lifecycle spans out of order: {list(zip(LIFECYCLE, order))}"
+    assert any(r["name"] == "serve.settle" and r.get("rid") == rid
+               for r in rows), "settle span lost its request id"
+    # scrape monotonicity: every counter sample and histogram series
+    # (bucket/sum/count) must be >= across the run; gauges may move
+    # either way and are exempt
+    mono = [k for k in m0 if k.endswith("_total")
+            or "_bucket{" in k or k.endswith("_sum")
+            or k.endswith("_count")]
+    regressed = {k: (m0[k], m1.get(k)) for k in mono
+                 if m1.get(k, 0) < m0[k]}
+    assert not regressed, f"metrics regressed across scrapes: {regressed}"
+    assert m1["wtpu_serve_submits_total"] \
+        == m0["wtpu_serve_submits_total"] + 1, (m0, m1)
+    phases = sch.health_stats().get("phases", {})
+    assert "serve.queue_wait" in phases, phases
+    return {"metric": "spans_smoke_spans", "value": len(rows),
+            "unit": "spans", "wall_s": round(wall, 2),
+            "lifecycle": list(LIFECYCLE),
+            "metrics_series": len(m1),
+            "phases": phases,
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -847,6 +931,7 @@ CONFIGS = {
     "memo_smoke": bench_memo_smoke,
     "crash_smoke": bench_crash_smoke,
     "fleet_smoke": bench_fleet_smoke,
+    "spans_smoke": bench_spans_smoke,
     "analysis_smoke": bench_analysis_smoke,
 }
 
@@ -862,6 +947,7 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "memo_smoke": "memo_smoke_prefix_chunks_saved",
                 "crash_smoke": "crash_smoke_bit_identical",
                 "fleet_smoke": "fleet_smoke_requests",
+                "spans_smoke": "spans_smoke_spans",
                 "analysis_smoke": "analysis_smoke_wall_s"}
 
 

@@ -84,20 +84,31 @@ def normalize_report(rep: dict) -> dict:
 # ------------------------------------------------------------------ child
 
 
-def child_main(d: str, resume: bool) -> int:
+def child_main(d: str, resume: bool, timeline=None) -> int:
     """One campaign attempt inside the kill zone: run (or resume) the
     grid with journal + checkpoints + ledger under `d`, then write the
     full report to ``d/report.json`` via `MatrixReport.save` (atomic:
     write-temp + fsync + os.replace — a kill mid-write must not leave
-    a torn report for the parent to misread)."""
+    a torn report for the parent to misread).  `timeline` turns the
+    host flight recorder ON — one span log per ATTEMPT (pid-named:
+    a SIGKILL tears only a file's tail, never its middle)."""
     import wittgenstein_tpu.models  # noqa: F401 — fills the registry
     from wittgenstein_tpu.matrix import SweepGrid, run_grid
     from wittgenstein_tpu.serve import Scheduler
 
+    ins = None
+    if timeline is not None:
+        from wittgenstein_tpu.serve.instrument import Instrumentation
+        os.makedirs(timeline, exist_ok=True)
+        wid = f"attempt-{os.getpid()}"
+        ins = Instrumentation(
+            span_path=os.path.join(timeline, f"spans-{wid}.jsonl"),
+            worker=wid)
     grid = SweepGrid.from_json(CRASH_GRID)
     sch = Scheduler(ledger_path=os.path.join(d, "ledger.jsonl"),
                     checkpoint_dir=os.path.join(d, "ck"),
-                    journal_dir=os.path.join(d, "journal"))
+                    journal_dir=os.path.join(d, "journal"),
+                    instrument=ins)
     run = run_grid(grid, sch, max_wave=2, keep_states=(),
                    resume=resume)
     # MatrixReport.save is the atomic (write-temp + fsync +
@@ -110,19 +121,21 @@ def child_main(d: str, resume: bool) -> int:
 # ----------------------------------------------------------------- parent
 
 
-def _spawn(d: str, resume: bool) -> subprocess.Popen:
+def _spawn(d: str, resume: bool, timeline=None) -> subprocess.Popen:
     os.makedirs(d, exist_ok=True)
     log = open(os.path.join(d, "child.log"), "a")
     args = [sys.executable, str(pathlib.Path(__file__).resolve()),
             "--child", "--dir", d]
     if resume:
         args.append("--resume")
+    if timeline is not None:
+        args += ["--timeline", str(timeline)]
     return subprocess.Popen(args, stdout=log, stderr=log,
                             cwd=str(REPO))
 
 
-def _run_to_completion(d: str, resume: bool) -> dict:
-    p = _spawn(d, resume)
+def _run_to_completion(d: str, resume: bool, timeline=None) -> dict:
+    p = _spawn(d, resume, timeline)
     p.wait()
     report = os.path.join(d, "report.json")
     if p.returncode != 0 or not os.path.exists(report):
@@ -135,12 +148,16 @@ def _run_to_completion(d: str, resume: bool) -> dict:
 
 def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
                    min_delay: float = 1.0,
-                   max_delay: float | None = None) -> dict:
+                   max_delay: float | None = None,
+                   timeline=None) -> dict:
     """The whole harness (module docstring): reference run, N
     SIGKILLs at seeded-random offsets with resume after each, final
     resume to completion, normalized-report comparison.  Returns the
     result block (``ok`` is the bit-identity verdict); raises
-    RuntimeError when a child fails outright."""
+    RuntimeError when a child fails outright.  `timeline` records one
+    host span log per campaign ATTEMPT (killed attempts leave torn
+    tails the reader tolerates) and renders the merged Perfetto file
+    at the end."""
     out = pathlib.Path(out_dir)
     ref_dir, camp_dir = str(out / "ref"), str(out / "campaign")
     t0 = time.time()
@@ -158,7 +175,7 @@ def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
     rng = random.Random(seed)
     landed, early_done = 0, 0
     for i in range(kills):
-        p = _spawn(camp_dir, resume=i > 0)
+        p = _spawn(camp_dir, resume=i > 0, timeline=timeline)
         delay = rng.uniform(min_delay, hi)
         t_spawn = time.time()
         while time.time() - t_spawn < delay and p.poll() is None:
@@ -181,20 +198,36 @@ def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
                   f"finished at +{wall:.2f}s < +{delay:.2f}s); "
                   f"ceiling -> {hi:.2f}s", flush=True)
         p.wait()
-    final = _run_to_completion(camp_dir, resume=True)
+    final = _run_to_completion(camp_dir, resume=True,
+                               timeline=timeline)
     ok = normalize_report(final) == normalize_report(ref)
-    return {"ok": ok, "kills_requested": kills, "kills_landed": landed,
-            "kills_missed": early_done, "seed": seed,
-            "ref_wall_s": round(ref_wall, 2),
-            "cells": final.get("cells_total"),
-            "resume": final.get("resume"),
-            "grid_digest": final.get("grid_digest")}
+    res = {"ok": ok, "kills_requested": kills, "kills_landed": landed,
+           "kills_missed": early_done, "seed": seed,
+           "ref_wall_s": round(ref_wall, 2),
+           "cells": final.get("cells_total"),
+           "resume": final.get("resume"),
+           "grid_digest": final.get("grid_digest")}
+    if timeline is not None:
+        import glob
+
+        from wittgenstein_tpu.obs.export import spans_to_perfetto
+        from wittgenstein_tpu.obs.spans import read_spans
+        rows, logs = [], sorted(glob.glob(
+            os.path.join(str(timeline), "spans*.jsonl")))
+        for f in logs:
+            rows.extend(read_spans(f))
+        tpath = os.path.join(str(timeline), "timeline.json")
+        spans_to_perfetto(rows, path=tpath)
+        res["timeline"] = {"path": tpath, "span_logs": len(logs),
+                           "spans": len(rows)}
+    return res
 
 
 def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
                          seed: int = 0, min_delay: float = 1.0,
                          max_delay: float | None = None,
-                         lease_ttl_s: float = 3.0) -> dict:
+                         lease_ttl_s: float = 3.0,
+                         timeline=None) -> dict:
     """The fleet variant (--workers N): run the SAME campaign as a
     lease-based worker fleet (matrix/driver.py run_grid(workers=N)),
     SIGKILL a seeded-random WORKER — not the whole campaign — at
@@ -204,7 +237,13 @@ def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
     so survivors always exist to reclaim the dead workers' expired
     leases (short ttl keeps the reclaim window inside the test's
     wall); recovery is checkpoint adoption or journal replay — the
-    same PR-15 paths the single-process harness pins."""
+    same PR-15 paths the single-process harness pins.
+
+    `timeline` (a directory) turns every worker's host flight
+    recorder ON: span JSONL per worker — a SIGKILLed worker's log
+    survives as a torn tail the reader tolerates — plus one merged
+    Perfetto ``timeline.json`` at the end, where the survivors'
+    adoption spans reference the dead workers' request ids."""
     import threading
 
     import wittgenstein_tpu.models  # noqa: F401 — fills the registry
@@ -250,25 +289,54 @@ def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
                          name="fleet-killer").start()
 
     t1 = time.time()
+    fleet_opts = {"lease_ttl_s": lease_ttl_s, "timeout_s": 600.0,
+                  "on_spawned": on_spawned}
+    if timeline is not None:
+        os.makedirs(timeline, exist_ok=True)
+        fleet_opts["timeline"] = str(timeline)
     final = run_grid(grid, workers=workers,
                      fleet_dir=str(out / "fleet"), keep_states=(),
-                     fleet_opts={"lease_ttl_s": lease_ttl_s,
-                                 "timeout_s": 600.0,
-                                 "on_spawned": on_spawned})
+                     fleet_opts=fleet_opts)
     wall = time.time() - t1
     final.report.save(str(out / "report.json"))
+    timeline_block = None
+    if timeline is not None:
+        # render every worker's span log — the SIGKILLed workers'
+        # torn tails included — onto one merged Perfetto timeline
+        import glob
+
+        from wittgenstein_tpu.obs.export import spans_to_perfetto
+        from wittgenstein_tpu.obs.spans import read_spans
+        rows = []
+        logs = sorted(glob.glob(os.path.join(str(timeline), "**",
+                                             "spans*.jsonl"),
+                                recursive=True))
+        for f in logs:
+            rows.extend(read_spans(f))
+        tpath = os.path.join(str(timeline), "timeline.json")
+        spans_to_perfetto(rows, path=tpath)
+        dead = {k["worker"] for k in kill_log if k["landed"]}
+        adoptions = [r for r in rows
+                     if r["name"].startswith("fleet.adopt")
+                     and r.get("worker") not in dead]
+        timeline_block = {"path": tpath, "span_logs": len(logs),
+                          "spans": len(rows),
+                          "survivor_adoptions": len(adoptions)}
     ok = normalize_report(final.report.to_json()) \
         == normalize_report(ref.report.to_json())
     fl = final.report.data.get("resume", {})
-    return {"ok": ok, "workers": workers, "kills": kill_log,
-            "kills_landed": sum(1 for k in kill_log if k["landed"]),
-            "seed": seed, "ref_wall_s": round(ref_wall, 2),
-            "wall_s": round(wall, 2),
-            "cells": final.report.data.get("cells_total"),
-            "adopted_checkpoints": fl.get("adopted_checkpoints"),
-            "entries_claimed": fl.get("journal_replayed"),
-            "worker_deduped": fl.get("worker_deduped"),
-            "grid_digest": final.report.data.get("grid_digest")}
+    res = {"ok": ok, "workers": workers, "kills": kill_log,
+           "kills_landed": sum(1 for k in kill_log if k["landed"]),
+           "seed": seed, "ref_wall_s": round(ref_wall, 2),
+           "wall_s": round(wall, 2),
+           "cells": final.report.data.get("cells_total"),
+           "adopted_checkpoints": fl.get("adopted_checkpoints"),
+           "entries_claimed": fl.get("journal_replayed"),
+           "worker_deduped": fl.get("worker_deduped"),
+           "grid_digest": final.report.data.get("grid_digest")}
+    if timeline_block is not None:
+        res["timeline"] = timeline_block
+    return res
 
 
 def _print_divergence(ref: dict, final: dict):
@@ -323,6 +391,13 @@ def main(argv=None) -> int:
                          "reference run's wall)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write the JSON result line here")
+    ap.add_argument("--timeline", default=None, metavar="DIR",
+                    help="turn the host-plane flight recorder ON: "
+                         "one span JSONL per campaign attempt (or per "
+                         "fleet worker with --workers; SIGKILLed "
+                         "processes leave torn tails the reader "
+                         "tolerates) plus one merged Perfetto "
+                         "timeline.json under DIR")
     ap.add_argument("--child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--resume", action="store_true",
@@ -334,7 +409,8 @@ def main(argv=None) -> int:
             print("config error: --child needs --dir", file=sys.stderr)
             return 2
         os.makedirs(args.dir, exist_ok=True)
-        return child_main(args.dir, resume=args.resume)
+        return child_main(args.dir, resume=args.resume,
+                          timeline=args.timeline)
 
     if args.kills < 1:
         print("config error: --kills must be >= 1", file=sys.stderr)
@@ -351,7 +427,8 @@ def main(argv=None) -> int:
             res = run_fleet_crash_test(
                 work, workers=args.workers, kills=args.kills,
                 seed=args.seed, min_delay=args.min_delay,
-                max_delay=args.max_delay, lease_ttl_s=args.lease_ttl)
+                max_delay=args.max_delay, lease_ttl_s=args.lease_ttl,
+                timeline=args.timeline)
         except RuntimeError as e:
             print(f"config error: {e}", file=sys.stderr)
             return 2
@@ -372,7 +449,8 @@ def main(argv=None) -> int:
     try:
         res = run_crash_test(work, kills=args.kills, seed=args.seed,
                              min_delay=args.min_delay,
-                             max_delay=args.max_delay)
+                             max_delay=args.max_delay,
+                             timeline=args.timeline)
     except RuntimeError as e:
         print(f"config error: {e}", file=sys.stderr)
         return 2

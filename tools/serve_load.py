@@ -25,7 +25,7 @@ Tenant mix (weights/budgets exercise every tenancy mechanism):
   batch       — weight 2, unbounded, mixed spans.
 
 Usage: python tools/serve_load.py [--requests N] [--out PATH]
-       [--stream] [--kill-after S]
+       [--stream] [--kill-after S] [--timeline DIR]
        (default 120 requests; --out writes the JSON line to a file
        as well as stdout; --stream adds the long-poll partial-metrics
        smoke check: one spec streamed boundary by boundary over
@@ -34,7 +34,11 @@ Usage: python tools/serve_load.py [--requests N] [--out PATH]
        seconds and reports the `/w/batch/health` snapshot taken at
        the kill — the crash-safety observability block under real
        load: uptime, queue depths, journal lag, quarantine count,
-       watchdog trips, chunk-wall EMA)
+       watchdog trips, chunk-wall EMA; --timeline DIR turns the host
+       flight recorder ON — span JSONL per process under DIR plus one
+       merged Perfetto timeline.json where the request-lifecycle host
+       spans and one probe request's device trace-ring/metrics lanes
+       render together)
 """
 
 from __future__ import annotations
@@ -166,9 +170,68 @@ def fleet_tenants() -> dict:
             "batch": {"weight": 2}}
 
 
+def timeline_probe(sch, timeline_dir) -> dict:
+    """The device-merge exercise behind --timeline: run ONE probe
+    request with the trace ring and metrics plane compiled in
+    (`keep_carries=True` keeps the raw per-chunk carries on the
+    finished record), rebuild its device Perfetto lanes, and merge
+    them with every span log under `timeline_dir` into one
+    ``timeline.json`` — host queue->compile->launch->chunks->settle
+    over wall time next to the engine's simulated-time lanes."""
+    import glob
+    import os
+
+    from wittgenstein_tpu.obs.decode import TraceFrame
+    from wittgenstein_tpu.obs.export import (MetricsFrame,
+                                             spans_to_perfetto,
+                                             to_perfetto,
+                                             trace_to_perfetto)
+    from wittgenstein_tpu.obs.spans import read_spans
+    from wittgenstein_tpu.obs.spec import MetricsSpec
+    from wittgenstein_tpu.obs.trace import TraceSpec
+
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": 64},
+                        seeds=(7,), sim_ms=120, chunk_ms=40,
+                        obs=("metrics", "trace"), tenant="batch")
+    rid = sch.submit(spec, keep_carries=True, label="timeline-probe")
+    deadline = time.time() + 120.0
+    while time.time() < deadline:
+        sch.run_pending()
+        req = sch.peek(rid)
+        if req is not None and req.status in ("done", "error"):
+            break
+        time.sleep(0.02)
+    device = []
+    req = sch.peek(rid)
+    if req is not None and req.status == "done" and req.final_carries:
+        carries = req.final_carries
+        if "metrics" in carries:
+            mf = MetricsFrame.from_carries(
+                MetricsSpec(stat_each_ms=spec.stat_each_ms),
+                carries["metrics"])
+            device.append(to_perfetto(mf))
+        if "trace" in carries:
+            tf = TraceFrame.from_carries(
+                TraceSpec(capacity=spec.trace_capacity),
+                carries["trace"])
+            device.append(trace_to_perfetto(tf))
+    rows = []
+    files = sorted(glob.glob(os.path.join(timeline_dir, "**",
+                                          "spans*.jsonl"),
+                             recursive=True))
+    for f in files:
+        rows.extend(read_spans(f))
+    out = os.path.join(timeline_dir, "timeline.json")
+    trace = spans_to_perfetto(rows, device=device, path=out)
+    return {"path": out, "span_logs": len(files), "spans": len(rows),
+            "device_lanes": len(device), "probe_rid": rid,
+            "events": len(trace["traceEvents"])}
+
+
 def fleet_load_once(workers: int, per: int, *, base_dir,
                     lease_ttl_s: float = 10.0,
-                    ready_timeout_s: float = 300.0) -> dict:
+                    ready_timeout_s: float = 300.0,
+                    timeline=None) -> dict:
     """One fleet measurement: spawn `workers` worker processes over a
     fresh fleet directory, wait until every worker has published a
     stats snapshot (measuring steady-state submit->result throughput,
@@ -184,8 +247,16 @@ def fleet_load_once(workers: int, per: int, *, base_dir,
 
     d = os.path.join(base_dir, f"fleet-{workers}w")
     svc = FleetService(d, tenants=fleet_tenants())
+    tdir = None
+    if timeline is not None:
+        # one span-log dir per worker count: the same worker ids recur
+        # across the sweep, and two counts appending into one file
+        # would interleave unrelated runs on one timeline
+        tdir = os.path.join(timeline, f"{workers}w")
+        os.makedirs(tdir, exist_ok=True)
     procs = [spawn_worker(d, f"w{i}", lease_ttl_s=lease_ttl_s,
-                          idle_exit_s=4.0, max_wall_s=900.0)
+                          idle_exit_s=4.0, max_wall_s=900.0,
+                          timeline=tdir)
              for i in range(workers)]
     stats_glob = os.path.join(fleet_paths(d)["stats_dir"],
                               "worker-*.json")
@@ -255,7 +326,8 @@ def fleet_load_once(workers: int, per: int, *, base_dir,
     }
 
 
-def fleet_load(worker_counts, requests: int, *, base_dir=None) -> dict:
+def fleet_load(worker_counts, requests: int, *, base_dir=None,
+               timeline=None) -> dict:
     """The --workers sweep: the same request mix at each worker count
     (fresh fleet directory each — no cross-run dedup), with the
     scaling ratios the ISSUE pins (submit->result throughput at N
@@ -268,7 +340,8 @@ def fleet_load(worker_counts, requests: int, *, base_dir=None) -> dict:
     for w in worker_counts:
         print(f"fleet-load: measuring {w} worker(s)...", flush=True,
               file=sys.stderr)
-        by[str(w)] = fleet_load_once(w, per, base_dir=base)
+        by[str(w)] = fleet_load_once(w, per, base_dir=base,
+                                     timeline=timeline)
     block = {"schema": 1, "requests": 3 * per, "by_workers": by,
              "dir": base}
     if "1" in by:
@@ -326,6 +399,13 @@ def main(argv=None) -> int:
                          "safety observability exercise; completion "
                          "checks are skipped — a killed run cannot "
                          "promise completion)")
+    ap.add_argument("--timeline", default=None, metavar="DIR",
+                    help="turn the host-plane flight recorder ON: "
+                         "span JSONL per process under DIR, plus one "
+                         "merged Perfetto timeline.json (host "
+                         "lifecycle spans + one probe request's "
+                         "device metrics/trace lanes; with --workers, "
+                         "a span log per worker process per count)")
     args = ap.parse_args(argv)
 
     if args.workers is not None:
@@ -338,8 +418,29 @@ def main(argv=None) -> int:
                   f"positive ints, got {args.workers!r}",
                   file=sys.stderr)
             return 2
+        if args.timeline is not None:
+            import os
+            os.makedirs(args.timeline, exist_ok=True)
         block = fleet_load(counts, args.requests,
-                           base_dir=args.fleet_dir)
+                           base_dir=args.fleet_dir,
+                           timeline=args.timeline)
+        if args.timeline is not None:
+            # render the workers' span logs (all counts) onto one
+            # merged Perfetto timeline; the per-count subdirs keep
+            # distinct pids per worker per count
+            import glob
+            import os
+
+            from wittgenstein_tpu.obs.export import spans_to_perfetto
+            from wittgenstein_tpu.obs.spans import read_spans
+            rows = []
+            for f in sorted(glob.glob(os.path.join(
+                    args.timeline, "**", "spans*.jsonl"),
+                    recursive=True)):
+                rows.extend(read_spans(f))
+            tpath = os.path.join(args.timeline, "timeline.json")
+            spans_to_perfetto(rows, path=tpath)
+            block["timeline"] = {"path": tpath, "spans": len(rows)}
         worst_p99 = max((b["p99_ms"] or 0)
                         for b in block["by_workers"].values())
         line = json.dumps({"metric": "serve_fleet_p99_ms",
@@ -358,9 +459,19 @@ def main(argv=None) -> int:
         return 0
 
     per = max(1, args.requests // 3)
+    ins = None
+    if args.timeline is not None:
+        import os
+
+        from wittgenstein_tpu.serve.instrument import Instrumentation
+        os.makedirs(args.timeline, exist_ok=True)
+        ins = Instrumentation(
+            span_path=os.path.join(args.timeline, "spans-serve.jsonl"),
+            worker="serve")
     sch = Scheduler(
         tenants=fleet_tenants(),
-        quantum_chunks=2)
+        quantum_chunks=2,
+        instrument=ins)
     svc = Service(scheduler=sch, auto=True)
     recs = {name: {"submitted": per, "done": 0, "errors": 0,
                    "rejected": 0, "gave_up": 0, "lat_ms": []}
@@ -387,6 +498,8 @@ def main(argv=None) -> int:
         t.join()
     wall = time.perf_counter() - t0
     stream_block = stream_smoke(svc) if args.stream else None
+    timeline_block = (timeline_probe(sch, args.timeline)
+                      if args.timeline is not None else None)
     svc.close()
 
     ten = svc.tenancy_stats()
@@ -431,6 +544,8 @@ def main(argv=None) -> int:
         out["health_at_kill"] = health_at_kill
     if stream_block is not None:
         out["stream"] = stream_block
+    if timeline_block is not None:
+        out["timeline"] = timeline_block
     line = json.dumps(out)
     print(line)
     if args.out:

@@ -12,7 +12,8 @@ window all of PR 15's kill-anywhere testing exists to close.  This
 rule makes that a static error:
 
   * **strict zone** — wittgenstein_tpu/serve/, matrix/, memo/ and
-    obs/ledger.py ARE the durable core: every raw write sink there
+    obs/ledger.py + obs/spans.py (the flight recorder's durable JSONL
+    writer, PR 18) ARE the durable core: every raw write sink there
     (``open`` with a write mode, ``json.dump``, ``write_text``/
     ``write_bytes``, ``np.save*``, ``gzip.open``-for-write,
     ``checkpoint.save``) must sit in a function that fsyncs or
@@ -56,7 +57,8 @@ from .host_common import (HOST_DIRS, Aliases, iter_source_files,
 
 STRICT_PREFIXES = ("wittgenstein_tpu/serve/", "wittgenstein_tpu/matrix/",
                    "wittgenstein_tpu/memo/")
-STRICT_FILES = ("wittgenstein_tpu/obs/ledger.py",)
+STRICT_FILES = ("wittgenstein_tpu/obs/ledger.py",
+                "wittgenstein_tpu/obs/spans.py")
 EXEMPT_FILES = ("wittgenstein_tpu/utils/jsonl.py",)
 
 DURABLE_PAT = re.compile(

@@ -50,6 +50,8 @@ def _drain(sch: Scheduler, rids: list, poll_s: float = 0.05):
     is single-drainer (a concurrent service worker may own the drain);
     polling statuses instead of trusting our own processed count keeps
     the driver correct in both in-process and service-threaded use."""
+    ins = getattr(sch, "_ins", None)
+    t0 = 0.0 if ins is None else ins.now()
     while True:
         sch.run_pending()
         statuses = []
@@ -58,6 +60,9 @@ def _drain(sch: Scheduler, rids: list, poll_s: float = 0.05):
             # evicted == already-done (keep_done retention bound)
             statuses.append("done" if req is None else req.status)
         if all(s in ("done", "error") for s in statuses):
+            if ins is not None:
+                from ..serve.instrument import GRID_DRAIN
+                ins.end(GRID_DRAIN, t0, n=len(rids))
             return
         time.sleep(poll_s)
 
@@ -68,6 +73,8 @@ def _harvest(sch: Scheduler, pairs, results, artifacts, states,
     (IMMEDIATELY after each drain: the scheduler's keep_done eviction
     may drop finished records once later waves pile up).  Returns the
     number of cells done."""
+    ins = getattr(sch, "_ins", None)
+    t0 = 0.0 if ins is None else ins.now()
     done = 0
     for cell, rid in pairs:
         req = sch.peek(rid)
@@ -87,6 +94,9 @@ def _harvest(sch: Scheduler, pairs, results, artifacts, states,
         else:
             results[cell.id] = {"status": "error",
                                 "error": req.error or req.status}
+    if ins is not None:
+        from ..serve.instrument import GRID_HARVEST
+        ins.end(GRID_HARVEST, t0, n=len(pairs), done=done)
     return done
 
 
@@ -465,10 +475,12 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                               max_wave)
         if table is not None:
             memo_stats["table"] = table.stats()
+    ins = getattr(sch, "_ins", None)
     for gi, group in enumerate(groups):
         cells = list(group.cells)
         for lo in range(0, len(cells), max_wave):
             wave = cells[lo:lo + max_wave]
+            t_sub = 0.0 if ins is None else ins.now()
             rids = []
             for cell in wave:
                 try:
@@ -489,6 +501,10 @@ def run_grid(grid: SweepGrid, scheduler: Scheduler | None = None,
                     continue
                 requests[cell.id] = rid
                 rids.append((cell, rid))
+            if ins is not None:
+                from ..serve.instrument import GRID_SUBMIT
+                ins.end(GRID_SUBMIT, t_sub, key=group.compile_key,
+                        n=len(rids))
             _drain(sch, [rid for _, rid in rids])
             done_cells += _harvest(sch, rids, results, artifacts,
                                    states, keep_all, keep)
@@ -680,7 +696,7 @@ def run_grid_fleet(grid: SweepGrid, plan_: MatrixPlan | None = None, *,
                    lease_ttl_s: float = 10.0, idle_exit_s: float = 2.0,
                    poll_s: float = 0.5, timeout_s: float = 900.0,
                    progress=None, on_spawned=None,
-                   spawn: bool = True) -> MatrixRun:
+                   spawn: bool = True, timeline=None) -> MatrixRun:
     """`run_grid(workers=N)`'s engine, decomposed (enqueue / spawn /
     wait / report) so tools/crash_test.py can SIGKILL workers between
     the pieces.  Enqueues the grid into the shared fleet journal,
@@ -695,7 +711,10 @@ def run_grid_fleet(grid: SweepGrid, plan_: MatrixPlan | None = None, *,
     `on_spawned(procs)` fires after the workers launch (the crash
     harness's kill hook); `spawn=False` skips launching (the caller
     runs its own workers).  A dead worker needs no respawn: its leases
-    expire and survivors adopt its work (serve/fleet.py)."""
+    expire and survivors adopt its work (serve/fleet.py).  `timeline`
+    (a directory) turns each worker's host-plane flight recorder ON —
+    one ``spans-<worker>.jsonl`` per worker under it, a dead worker's
+    torn tail included (tools/timeline.py renders them)."""
     from ..serve.fleet import aggregate_worker_stats, spawn_worker
 
     plan_ = plan_ or plan(grid)
@@ -706,7 +725,8 @@ def run_grid_fleet(grid: SweepGrid, plan_: MatrixPlan | None = None, *,
         procs = [spawn_worker(fleet_dir, f"w{i}",
                               lease_ttl_s=lease_ttl_s,
                               idle_exit_s=idle_exit_s,
-                              max_wall_s=timeout_s)
+                              max_wall_s=timeout_s,
+                              timeline=timeline)
                  for i in range(int(workers))]
     if on_spawned is not None:
         on_spawned(procs)

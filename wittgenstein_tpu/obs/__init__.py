@@ -53,6 +53,15 @@ contract (audit-ON bit-identical, tests/test_audit.py; audit-OFF zero
 residue, analysis `audit_zero_cost`).  `obs/ledger.py` appends a
 `RunManifest` provenance row per bench run under ``reports/ledger/``,
 and `tools/audit.py` is the one-command clean/violated CLI.
+
+The HOST plane (`spans`, `metrics` — PR 18) covers the half the
+device planes cannot see: admission, queueing, compile, launch /
+retry / degrade, preemption, lease claims, crash replay.
+`SpanRecorder` is the wall-clock flight recorder (bounded ring +
+optional durable JSONL), `MetricsRegistry` the scrapeable Prometheus
+mirror behind ``GET /w/batch/metrics``, and
+`export.spans_to_perfetto` merges host spans with the device lanes
+onto one Perfetto timeline (`tools/timeline.py`).
 """
 
 from .audit import (AuditCarry, AuditSpec, INVARIANTS,  # noqa: F401
@@ -65,8 +74,11 @@ from .engine import (fast_forward_chunk_batched_metrics,  # noqa: F401
                      fast_forward_chunk_metrics, scan_chunk_batched_metrics,
                      scan_chunk_metrics, step_ms_metrics)
 from .export import (MetricsFrame, engine_metrics_block,  # noqa: F401
-                     to_perfetto, to_progress_csv, trace_to_perfetto)
+                     spans_to_perfetto, to_perfetto, to_progress_csv,
+                     trace_to_perfetto)
+from .metrics import MetricsRegistry, parse_exposition  # noqa: F401
 from .plane import MetricsCarry, counter_values, init_metrics  # noqa: F401
+from .spans import SpanRecorder, read_spans  # noqa: F401
 from .spec import COUNTERS, MetricsSpec  # noqa: F401
 from .trace import (EVENTS, TraceCarry, TraceSpec,  # noqa: F401
                     fast_forward_chunk_trace, init_trace,

@@ -272,6 +272,82 @@ def trace_to_perfetto(frame, path: str | None = None,
     return trace
 
 
+#: base pid of the HOST span lanes — one pid per worker, numbered up
+#: from here, next to the device lanes (ENGINE_PID / TRACE_PID) on a
+#: merged Perfetto session.
+SPAN_PID_BASE = 90300
+
+
+def spans_to_perfetto(rows, device=None, path: str | None = None,
+                      name: str = "wtpu host") -> dict:
+    """Chrome-trace JSON merging HOST lifecycle spans (obs/spans.py
+    rows) with an optional DEVICE trace (the dict returned by
+    `to_perfetto` / `trace_to_perfetto`, or a list of such dicts).
+
+    Track model: one Perfetto process per worker (pid counts up from
+    SPAN_PID_BASE, workers sorted; spans without a worker attr group
+    under ``host``), one thread per request id inside it (tid counts
+    up from 1, rids sorted; spans with no rid — compile, grid phases,
+    lease renewals — land on tid 0, the worker's scheduler track).
+
+    Clock: host spans are wall SECONDS on a monotonic clock; they are
+    re-zeroed at the earliest span start and scaled to trace-us, so
+    the host timeline starts at 0 exactly like the device lanes'
+    sim-ms clock (1 sim-ms -> 1000 trace-us, preserved untouched in
+    the merged events).  Zero-duration marks become instant events.
+    `path` (optional) writes the JSON; a ``.gz`` suffix gzips it.
+    """
+    rows = list(rows)
+    t_min = min((float(r["t0"]) for r in rows), default=0.0)
+    by_worker: dict = {}
+    for r in rows:
+        by_worker.setdefault(r.get("worker") or "host", []).append(r)
+    events = []
+    for i, w in enumerate(sorted(by_worker)):
+        pid = SPAN_PID_BASE + i
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": f"{name} worker {w} "
+                                        "(wall time)"}})
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "thread_name",
+                       "args": {"name": "scheduler"}})
+        rids = sorted({str(r["rid"]) for r in by_worker[w]
+                       if r.get("rid") is not None})
+        tid_of = {rid: j + 1 for j, rid in enumerate(rids)}
+        for rid, tid in tid_of.items():
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"request {rid}"}})
+        for r in by_worker[w]:
+            rid = r.get("rid")
+            tid = tid_of[str(rid)] if rid is not None else 0
+            ts = int(round((float(r["t0"]) - t_min) * 1e6))
+            dur = int(round(float(r.get("dur", 0.0)) * 1e6))
+            args = {k: v for k, v in r.items()
+                    if k not in ("schema", "name", "t0", "dur",
+                                 "worker")}
+            ev = {"pid": pid, "tid": tid, "ts": ts, "name": r["name"],
+                  "args": args}
+            if dur > 0:
+                ev.update(ph="X", dur=dur)
+            else:
+                ev.update(ph="i", s="t")
+            events.append(ev)
+    if device is not None:
+        for dev in (device if isinstance(device, (list, tuple))
+                    else (device,)):
+            events.extend(dev.get("traceEvents", []))
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path:
+        if str(path).endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                json.dump(trace, f)
+        else:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+    return trace
+
+
 #: series longer than this are summarized (totals only) in the bench
 #: JSON line — one JSON line must stay one line.
 _MAX_SERIES_ROWS = 64

@@ -48,9 +48,18 @@ coalesced into few compiled device programs.
                 work, and the PR-13 ledger join dedups across workers.
                 `FleetService` (service.py) is the thin front tier
                 behind the same `/w/batch/*` routes.
+  `instrument`— `Instrumentation` (PR 18): the host-plane flight
+                recorder + metrics handle — `Scheduler(instrument=)` /
+                `FleetWorker(instrument=)` thread request-lifecycle
+                wall-clock spans (obs/spans.py) and the scrapeable
+                Prometheus registry (obs/metrics.py, served at
+                ``GET /w/batch/metrics``) through the whole serve
+                plane; OFF (the default None) costs a single is-None
+                branch per site.
 """
 
 from .fleet import FleetWorker, fleet_paths, spawn_worker  # noqa: F401
+from .instrument import Instrumentation  # noqa: F401
 from .journal import LeaseTable, SubmissionJournal  # noqa: F401
 from .registry import CompileRegistry  # noqa: F401
 from .scheduler import (AdmissionError, ForkState, Request,  # noqa: F401

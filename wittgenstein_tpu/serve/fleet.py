@@ -145,22 +145,28 @@ class FleetWorker:
 
     def __init__(self, fleet_dir, worker_id: str, *, registry=None,
                  lease_ttl_s: float = 10.0, dedup: bool = True,
-                 scheduler_kw: dict | None = None):
+                 scheduler_kw: dict | None = None, instrument=None):
         self.paths = fleet_paths(fleet_dir)
         self.worker_id = str(worker_id)
         self.lease_ttl_s = float(lease_ttl_s)
         self.dedup = bool(dedup)
+        #: host flight recorder + metrics (serve/instrument; None =
+        #: OFF) — shared with the scheduler, so one span log carries
+        #: the whole worker: lease traffic AND request lifecycle
+        self._ins = instrument
         self.sched = Scheduler(
             registry=registry,
             ledger_path=self.paths["ledger_path"],
             checkpoint_dir=self.paths["checkpoint_dir"],
             journal_dir=self.paths["journal_dir"],
             worker_id=self.worker_id,
+            instrument=instrument,
             **dict(scheduler_kw or {}))
         self.journal: SubmissionJournal = self.sched.journal
         self.leases = LeaseTable(self.paths["journal_dir"],
                                  ttl_s=self.lease_ttl_s)
         self.counters = {"claimed": 0, "deduped": 0, "released": 0,
+                         "renewed": 0,
                          "adopted_checkpoints": 0, "processed": 0,
                          "steps": 0}
         self._held: set = set()
@@ -181,11 +187,16 @@ class FleetWorker:
     # ------------------------------------------------------------- leases
 
     def _claim(self, rid: str) -> bool:
+        ins = self._ins
+        t0 = 0.0 if ins is None else ins.now()
         ok = self.leases.claim(rid, self.worker_id)
         if ok:
             with self._mu:
                 self._held.add(rid)
                 self.counters["claimed"] += 1
+            if ins is not None:
+                from .instrument import FLEET_CLAIM
+                ins.end(FLEET_CLAIM, t0, rid=rid)
         return ok
 
     def _release(self, rid: str):
@@ -204,17 +215,27 @@ class FleetWorker:
         period = max(0.05, self.lease_ttl_s / 3.0)
 
         def loop():
+            ins = self._ins
             while not self._stop.wait(period):
                 with self._mu:
                     held = list(self._held)
+                t0 = 0.0 if ins is None else ins.now()
+                renewed = 0
                 for rid in held:
                     try:
                         self.leases.claim(rid, self.worker_id)
+                        renewed += 1
                     except OSError as e:
                         print(f"fleet[{self.worker_id}]: lease renewal "
                               f"failed for {rid} ({e}); the lease may "
                               "expire and be reclaimed",
                               file=sys.stderr)
+                if renewed:
+                    with self._mu:
+                        self.counters["renewed"] += renewed
+                    if ins is not None:
+                        from .instrument import FLEET_RENEW
+                        ins.end(FLEET_RENEW, t0, renewed=renewed)
 
         self._renewer = threading.Thread(
             target=loop, daemon=True,
@@ -240,6 +261,7 @@ class FleetWorker:
         its own worker-prefixed filename at the next boundary (a crash
         before then replays from the journal — redo beats lose)."""
         adopted_foreign: list = []
+        adoptions: list = []            # (from_worker, [rids])
 
         def accept(path, meta) -> bool:
             rids = [rm["id"] for rm in meta.get("requests", ())]
@@ -259,11 +281,24 @@ class FleetWorker:
                     return False
             with self._mu:
                 self.counters["adopted_checkpoints"] += 1
+            adoptions.append((meta.get("worker"), rids))
             if meta.get("worker") != self.worker_id:
                 adopted_foreign.append(path)
             return True
 
         rids = self.sched.resume_checkpoints(accept=accept)
+        if self._ins is not None and adoptions:
+            # the survivor's side of a reclaim: one mark per adopted
+            # request, naming the worker whose lease lapsed — a crash
+            # postmortem joins these to the dead worker's span log by
+            # rid
+            from .instrument import FLEET_ADOPT_CKPT
+            for fw, group in adoptions:
+                for rid in group:
+                    attrs = {"rid": rid}
+                    if fw is not None:
+                        attrs["from_worker"] = fw
+                    self._ins.mark(FLEET_ADOPT_CKPT, **attrs)
         for path in adopted_foreign:
             with contextlib.suppress(OSError):
                 os.remove(path)
@@ -360,6 +395,9 @@ class FleetWorker:
             if self.sched.adopt_journal_entry(e) is None:
                 self._release(rid)
                 continue
+            if self._ins is not None:
+                from .instrument import FLEET_ADOPT_JOURNAL
+                self._ins.mark(FLEET_ADOPT_JOURNAL, rid=rid)
             adopted += 1
         processed = self.sched.run_pending()["processed"] if adopted \
             or self.sched.health_stats()["queued"] else 0
@@ -391,7 +429,15 @@ class FleetWorker:
             body = {"worker": self.worker_id, **self.counters}
         body["registry"] = self.sched.registry.stats()
         body["health"] = self.sched.health_stats()
-        body["resilience"] = dict(self.sched.resilience)
+        with self.sched._mu:
+            body["resilience"] = dict(self.sched.resilience)
+        if self._ins is not None:
+            from .instrument import (refresh_fleet_counters,
+                                     refresh_scheduler_metrics)
+            refresh_scheduler_metrics(self._ins.metrics, self.sched)
+            refresh_fleet_counters(self._ins.metrics, body)
+            body["host_metrics"] = self._ins.metrics.snapshot()
+            body["spans"] = self._ins.spans.stats()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(body, f, sort_keys=True, default=str)
@@ -447,11 +493,15 @@ class FleetWorker:
 
 def spawn_worker(fleet_dir, worker_id: str, *, lease_ttl_s: float = 10.0,
                  idle_exit_s: float = 3.0, max_wall_s=None,
-                 poll_s: float = 0.25, dedup: bool = True, env=None):
+                 poll_s: float = 0.25, dedup: bool = True, env=None,
+                 timeline=None):
     """Launch one fleet worker subprocess (the shared helper behind
     `run_grid(workers=N)`, crash_test --workers and serve_load
     --workers).  stdout/stderr go to ``worker-<id>.log`` in the fleet
-    dir; the returned Popen carries ``log_path``."""
+    dir; the returned Popen carries ``log_path``.  `timeline` (a
+    directory) turns span recording ON in the child — it appends
+    ``spans-<worker>.jsonl`` there, durable line-by-line, so a
+    SIGKILLed worker still leaves its timeline behind."""
     import subprocess
     paths = fleet_paths(fleet_dir)
     os.makedirs(paths["dir"], exist_ok=True)
@@ -463,6 +513,8 @@ def spawn_worker(fleet_dir, worker_id: str, *, lease_ttl_s: float = 10.0,
         cmd += ["--max-wall", str(max_wall_s)]
     if not dedup:
         cmd += ["--no-dedup"]
+    if timeline is not None:
+        cmd += ["--timeline", str(timeline)]
     log_path = os.path.join(paths["dir"], f"worker-{worker_id}.log")
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -495,12 +547,24 @@ def main(argv=None) -> int:
     ap.add_argument("--no-dedup", action="store_true",
                     help="disable the ledger dedup join (every entry "
                          "re-runs even if a clean row exists)")
+    ap.add_argument("--timeline", default=None, metavar="DIR",
+                    help="record host lifecycle spans to "
+                         "DIR/spans-<worker>.jsonl (durable per line; "
+                         "render with tools/timeline.py)")
     args = ap.parse_args(argv)
     # protocol registry fills as models import (the classpath-scan
     # analogue — server/http.py main does the same)
     from .. import models  # noqa: F401
+    ins = None
+    if args.timeline:
+        from .instrument import Instrumentation
+        os.makedirs(args.timeline, exist_ok=True)
+        ins = Instrumentation(
+            span_path=os.path.join(args.timeline,
+                                   f"spans-{args.worker_id}.jsonl"),
+            worker=args.worker_id)
     w = FleetWorker(args.dir, args.worker_id, lease_ttl_s=args.ttl,
-                    dedup=not args.no_dedup)
+                    dedup=not args.no_dedup, instrument=ins)
     counters = w.run(poll_s=args.poll, idle_exit_s=args.idle_exit,
                      max_wall_s=args.max_wall)
     print(json.dumps({"worker": args.worker_id, **counters},

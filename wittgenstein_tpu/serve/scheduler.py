@@ -302,6 +302,10 @@ class Request:
     #: per-chunk-boundary primary-pass totals + deltas (the streaming
     #: endpoint's backing store; evicted with the request)
     chunk_totals: list = dataclasses.field(default_factory=list)
+    #: monotonic enqueue timestamp on the INSTRUMENT's clock (set only
+    #: when the scheduler is instrumented; reset on preempt/resume so
+    #: the queue-wait span covers the current wait, not the lifetime)
+    enq_mono: float | None = None
 
     @property
     def tenant(self) -> str:
@@ -380,8 +384,17 @@ class Scheduler:
                  freeze: bool | None = None, journal_dir=None,
                  watchdog_factor: float | None = None,
                  watchdog_floor_s: float = 30.0,
-                 worker_id: str | None = None):
+                 worker_id: str | None = None,
+                 instrument=None):
         self.registry = registry or CompileRegistry()
+        #: host flight recorder + metrics bundle
+        #: (serve/instrument.Instrumentation; None = OFF, the default).
+        #: Every instrumented site guards on ``self._ins is not None``
+        #: — one attribute load, zero allocations when off.
+        self._ins = instrument
+        if instrument is not None and worker_id \
+                and instrument.spans.worker is None:
+            instrument.spans.worker = str(worker_id)
         #: fleet identity (None = the single-process default, nothing
         #: changes).  When set, this scheduler is ONE worker among N
         #: sharing a journal/ledger/checkpoint directory: request ids
@@ -612,6 +625,8 @@ class Scheduler:
         with the prefix's obs carries (module docstring: the memo
         snapshot-fork seam); `keep_carries` stashes the raw per-chunk
         carries on the finished request (the prefix handoff)."""
+        ins = self._ins
+        t_sub = 0.0 if ins is None else ins.now()
         resolved = spec.validate()
         key = resolved.compile_key()
         if fork is not None:
@@ -657,6 +672,10 @@ class Scheduler:
                           keep_carries=bool(keep_carries),
                           ledger_extra=dict(ledger_extra)
                           if ledger_extra else None)
+            if ins is not None:
+                # set under the lock so a concurrent drain marking the
+                # request running always sees the enqueue time
+                req.enq_mono = t_sub
             if fork is not None:
                 req.restored_state = fork.state
                 req.saved_carries = {p: list(cs) for p, cs
@@ -693,6 +712,10 @@ class Scheduler:
                         f"({e}); request NOT accepted — fix the "
                         f"journal_dir volume or disable journaling"
                     ) from e
+        if ins is not None:
+            from .instrument import SPAN_SUBMIT
+            ins.end(SPAN_SUBMIT, t_sub, rid=rid, key=key,
+                    tenant=resolved.tenant)
         return rid
 
     def _rid_locked(self) -> str:
@@ -1008,6 +1031,10 @@ class Scheduler:
             with self._mu:      # drain thread holds no lock here
                 self.resilience["watchdog_trips"] += 1
                 ema = self.chunk_wall_ema_s
+            if self._ins is not None:
+                from .instrument import MARK_WATCHDOG
+                self._ins.mark(MARK_WATCHDOG,
+                               deadline_s=round(deadline, 3))
             raise WatchdogTimeout(
                 f"launch exceeded its {deadline:.2f}s wall deadline "
                 f"(chunk-wall EMA {ema:.3f}s x "
@@ -1029,17 +1056,30 @@ class Scheduler:
         identically — re-retrying each one would multiply the total
         stall by (max_retries+1) for no information."""
         call = self.launcher or (lambda f, *a: f(*a))
+        ins = self._ins
         last = None
         for attempt in range(self.max_retries + 1):
+            t0 = 0.0 if ins is None else ins.now()
             try:
-                return self._call_bounded(call, fn, entry)
+                out = self._call_bounded(call, fn, entry)
+                if ins is not None:
+                    from .instrument import SPAN_LAUNCH
+                    ins.end(SPAN_LAUNCH, t0, attempt=attempt)
+                return out
             except Exception as e:      # noqa: BLE001 — retry any launch
                 last = e
+                if ins is not None:
+                    from .instrument import MARK_RETRY, SPAN_LAUNCH
+                    ins.end(SPAN_LAUNCH, t0, attempt=attempt,
+                            error=type(e).__name__)
                 if isinstance(e, WatchdogTimeout) and not retry_timeouts:
                     break
                 if attempt < self.max_retries:
                     with self._mu:
                         self.resilience["retries"] += 1
+                    if ins is not None:
+                        ins.mark(MARK_RETRY, attempt=attempt,
+                                 error=type(e).__name__)
                     if self.retry_backoff_s:
                         time.sleep(self.retry_backoff_s * (2 ** attempt))
         raise last
@@ -1081,6 +1121,10 @@ class Scheduler:
             # halves sequentially instead of dropping the requests
             with self._mu:
                 self.resilience["demotions"] += 1
+            if self._ins is not None:
+                from .instrument import MARK_DEGRADE
+                self._ins.mark(MARK_DEGRADE, lanes=len(widths),
+                               error=type(e).__name__)
             mid = len(widths) // 2
             w_left = int(sum(widths[:mid]))
             left, right = self._split_state(entry, w_left)
@@ -1136,6 +1180,11 @@ class Scheduler:
             self._boundary.notify_all()
         if self.journal is not None:
             self.journal.record_settled(req.id, "quarantined")
+        if self._ins is not None:
+            from .instrument import MARK_QUARANTINE
+            self._ins.mark(MARK_QUARANTINE, rid=req.id,
+                           key=req.compile_key, tenant=spec.tenant,
+                           at_ms=req.progress_ms)
         import sys
         print(f"serve: QUARANTINED request {req.id} "
               f"({spec.tenant}/{req.label or 'serve'}): {msg}",
@@ -1280,6 +1329,8 @@ class Scheduler:
                 "scratch), or resume with the tree/spec that wrote it")
         if accept is not None and not accept(path, specs_meta):
             return []
+        ins = self._ins
+        t0 = 0.0 if ins is None else ins.now()
         reqs_meta = specs_meta["requests"]
         spec0 = ScenarioSpec.from_json(reqs_meta[0]["spec"])
         proto = spec0.build_protocol()
@@ -1308,6 +1359,21 @@ class Scheduler:
                 self._queue.append(rid)
                 rids.append(rid)
             self.resilience["resumed"] += len(rids)
+        if ins is not None:
+            from .instrument import SPAN_RESUME
+            t1 = ins.now()
+            resumed_at = {}
+            with self._mu:
+                for rid in rids:
+                    r = self._requests[rid]
+                    r.enq_mono = t1
+                    resumed_at[rid] = r.resumed_from_ms
+            attrs = {"key": specs_meta["compile_key"]}
+            if specs_meta.get("worker") is not None:
+                attrs["from_worker"] = specs_meta["worker"]
+            for rid in rids:
+                ins.end(SPAN_RESUME, t0, t1, rid=rid,
+                        from_ms=resumed_at[rid], **attrs)
         # adoption CONSUMES a foreign worker's file: this scheduler
         # checkpoints the group under its OWN name from the next
         # boundary on, so a dead worker's file left behind would go
@@ -1348,6 +1414,10 @@ class Scheduler:
                 if rid is not None:
                     rids.append(rid)
             self.resilience["replayed"] += len(rids)
+        if self._ins is not None and rids:
+            from .instrument import SPAN_REPLAY
+            for rid in rids:
+                self._ins.mark(SPAN_REPLAY, rid=rid)
         self.journal.compact()
         return rids
 
@@ -1383,6 +1453,8 @@ class Scheduler:
                       compile_key=resolved.compile_key(),
                       requested=spec, label=e.get("label"),
                       ledger_extra=extra or None)
+        if self._ins is not None:
+            req.enq_mono = self._ins.now()
         self._requests[rid] = req
         self._queue.append(rid)
         return rid
@@ -1398,7 +1470,10 @@ class Scheduler:
             rid = self._adopt_entry_locked(entry)
             if rid is not None:
                 self.resilience["replayed"] += 1
-            return rid
+        if rid is not None and self._ins is not None:
+            from .instrument import SPAN_REPLAY
+            self._ins.mark(SPAN_REPLAY, rid=rid)
+        return rid
 
     def recover(self) -> dict:
         """Crash-only restart, one call: checkpoints first (mid-run
@@ -1430,20 +1505,28 @@ class Scheduler:
                                                        0) + 1
                 elif r.status == "running":
                     running += 1
-            return {"uptime_s": round(time.time() - self._t0, 3),
-                    "queued": sum(queued.values()),
-                    "queued_by_tenant": queued,
-                    "running": running,
-                    "journal": self.journal is not None,
-                    "journal_lag": lag,
-                    "quarantined": self.resilience["quarantined"],
-                    "watchdog_trips": self.resilience["watchdog_trips"],
-                    "watchdog_deadline_s": (round(deadline, 3)
-                                            if deadline is not None
-                                            else None),
-                    "chunk_wall_ema_s": round(self.chunk_wall_ema_s, 4),
-                    "resilience": dict(self.resilience),
-                    "draining": self._draining}
+            out = {"uptime_s": round(time.time() - self._t0, 3),
+                   "queued": sum(queued.values()),
+                   "queued_by_tenant": queued,
+                   "running": running,
+                   "submitted": self._n,
+                   "journal": self.journal is not None,
+                   "journal_lag": lag,
+                   "quarantined": self.resilience["quarantined"],
+                   "watchdog_trips": self.resilience["watchdog_trips"],
+                   "watchdog_deadline_s": (round(deadline, 3)
+                                           if deadline is not None
+                                           else None),
+                   "chunk_wall_ema_s": round(self.chunk_wall_ema_s, 4),
+                   "resilience": dict(self.resilience),
+                   "draining": self._draining}
+        if self._ins is not None:
+            # span-derived phase p50/p99 (queue-wait/compile/launch) —
+            # the EMA says how long a chunk takes, this says where a
+            # request's wall actually went (outside the lock: reads
+            # the recorder's own ring under its own lock)
+            out["phases"] = self._ins.health_phases()
+        return out
 
     # --------------------------------------------------------- preemption
 
@@ -1507,6 +1590,8 @@ class Scheduler:
         slices = [jax.tree.map(
             lambda x, lo=int(lo), w=ln.width: x[lo:lo + w], state)
             for ln, lo in zip(lanes, offsets)]
+        ins = self._ins
+        t_pre = 0.0 if ins is None else ins.now()
         with self._mu:
             self.resilience["preemptions"] += 1
             for ln, sl in zip(lanes, slices):
@@ -1520,8 +1605,17 @@ class Scheduler:
                                     for k in acc}
                 req.preempted += 1
                 req.status = "queued"
+                if ins is not None:
+                    # queue-wait restarts at the re-enqueue boundary
+                    req.enq_mono = t_pre
                 self._queue.append(req.id)
                 self._tstat(req.spec.tenant)["preemptions"] += 1
+        if ins is not None:
+            from .instrument import MARK_PREEMPT
+            for ln in lanes:
+                ins.mark(MARK_PREEMPT, rid=ln.req.id, key=key,
+                         reason=reason,
+                         at_ms=ln.req.progress_ms)
 
     # ------------------------------------------------------------ the run
 
@@ -1561,15 +1655,29 @@ class Scheduler:
         with self._mu:
             for r in reqs:
                 r.status, r.started = "running", now
+        ins = self._ins
+        if ins is not None:
+            # queue-wait ends where the group marks its requests
+            # running (enq_mono is drain-private once dequeued)
+            from .instrument import SPAN_COMPILE, SPAN_QUEUE_WAIT
+            t_run = ins.now()
+            for r in reqs:
+                if r.enq_mono is not None:
+                    ins.end(SPAN_QUEUE_WAIT, r.enq_mono, t_run,
+                            rid=r.id, key=key, tenant=r.spec.tenant)
+                    r.enq_mono = None
         ff_stats = {"skipped_ms": 0, "jump_count": 0}
         done = 0
         chunks_run = 0
         # One registry lookup per plane per GROUP (the programs are
         # constant across chunks) — hit/miss counters then reflect
         # warm/cold submits, not chunk counts.
+        t_cmp = 0.0 if ins is None else ins.now()
         fn = self.registry.chunk_fn(spec0, primary, proto=proto0)
         shadow_fns = [(p, self.registry.chunk_fn(spec0, p, proto=proto0))
                       for p in shadows]
+        if ins is not None:
+            ins.end(SPAN_COMPILE, t_cmp, key=key, lanes=len(reqs))
         freeze_probe = None
         if self.freeze:
             from ..memo import build_probe, freeze_supported
@@ -1579,6 +1687,7 @@ class Scheduler:
             entry = state
             widths = [ln.width for ln in lanes]
             t_chunk = time.time()
+            tc0 = 0.0 if ins is None else ins.now()
             out, lane_errs = self._launch(fn, entry, widths,
                                           spec0.engine,
                                           primary is not None)
@@ -1689,6 +1798,9 @@ class Scheduler:
                 ema = self.chunk_wall_ema_s
                 self.chunk_wall_ema_s = (dt if not ema
                                          else 0.8 * ema + 0.2 * dt)
+            if ins is not None:
+                from .instrument import SPAN_CHUNK
+                ins.end(SPAN_CHUNK, tc0, key=key, lanes=len(widths))
             if self.on_boundary is not None:
                 self.on_boundary()
             if lanes:
@@ -1721,6 +1833,15 @@ class Scheduler:
                         self.resilience["repacked"] += len(joiners)
                     for r in joiners:
                         r.status, r.started = "running", now
+                if ins is not None:
+                    from .instrument import SPAN_QUEUE_WAIT
+                    t_run = ins.now()
+                    for r in joiners:
+                        if r.enq_mono is not None:
+                            ins.end(SPAN_QUEUE_WAIT, r.enq_mono,
+                                    t_run, rid=r.id, key=key,
+                                    tenant=r.spec.tenant)
+                            r.enq_mono = None
                 new = self._init_lanes(joiners, proto0)
                 state = self._concat(
                     ([state] if lanes else []) + new)
@@ -1814,6 +1935,8 @@ class Scheduler:
         return snap
 
     def _finalize(self, ln: _Lane, final_state, ff_stats):
+        ins = self._ins
+        t_set = 0.0 if ins is None else ins.now()
         req, spec = ln.req, ln.req.spec
         proto_cfg = req.cfg
         requested = req.requested or spec
@@ -1911,6 +2034,12 @@ class Scheduler:
             durable["violations"] = {
                 k: v for k, v in art["audit"]["violations"].items() if v}
         req.ledger_extra = {**(req.ledger_extra or {}), **durable}
+        if ins is not None:
+            # the scrapeable registry's state at settle time rides the
+            # ledger row — a campaign postmortem reads the metric
+            # trajectory from the rows alone, no scraper needed
+            from .instrument import ledger_metrics_block
+            line["host_metrics"] = ledger_metrics_block(self)
         path = self._append_ledger(req, line)
         art["wall_s"] = round(wall, 3)
         art["registry"] = self.registry.stats()
@@ -1929,6 +2058,11 @@ class Scheduler:
             self._boundary.notify_all()     # wake stream long-polls
         if self.journal is not None:
             self.journal.record_settled(req.id, "done")
+        if ins is not None:
+            from .instrument import SPAN_SETTLE
+            ins.end(SPAN_SETTLE, t_set, rid=req.id,
+                    key=req.compile_key, tenant=spec.tenant,
+                    wall_s=round(wall, 3))
 
     def _evict_old_done(self):
         """Drop the oldest finished records past `keep_done` (caller

@@ -166,6 +166,15 @@ class Service:
         the last-chunk wall EMA (Scheduler.health_stats)."""
         return self.scheduler.health_stats()
 
+    def metrics(self) -> str:
+        """GET /w/batch/metrics — Prometheus text exposition
+        (deterministic ordering, monotone counters).  Works with or
+        without an `Instrumentation` on the scheduler: the counters
+        project from the scheduler's own monotone state either way;
+        phase histograms appear once spans are on."""
+        from .instrument import scheduler_exposition
+        return scheduler_exposition(self.scheduler)
+
     def recover(self) -> dict:
         """Crash-only restart seam: replay group checkpoints, then the
         submission journal (`Scheduler.recover`), and — in auto mode —
@@ -520,6 +529,41 @@ class FleetService:
                            "adopted_checkpoints", "processed")
                           if k in w}
                     for wid, w in workers.items()}}
+
+    def metrics(self) -> str:
+        """GET /w/batch/metrics — the fleet exposition: each worker's
+        monotone counters (published atomically via write_stats)
+        summed fleet-wide, front-tier admission counts, and the
+        queue/lag gauges.  Sums of per-worker monotone series stay
+        monotone, so repeated scrapes never read backwards."""
+        from ..obs.metrics import MetricsRegistry
+        from .instrument import FLEET_COUNTERS, RESILIENCE_COUNTERS
+        reg = MetricsRegistry()
+        sums: dict = {}
+        for w in self.worker_stats().values():
+            for k, v in w.items():
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    sums[k] = sums.get(k, 0) + v
+            for k, v in (w.get("resilience") or {}).items():
+                if isinstance(v, (int, float)):
+                    sums["res_" + k] = sums.get("res_" + k, 0) + v
+        for k, name in FLEET_COUNTERS.items():
+            reg.set_counter(name, sums.get(k, 0))
+        for k, name in RESILIENCE_COUNTERS.items():
+            reg.set_counter(name, sums.get("res_" + k, 0))
+        with self._mu:
+            front_n = self._n
+        reg.set_counter("wtpu_serve_submits_total",
+                        front_n + sums.get("res_rejected", 0))
+        h = self.health()
+        reg.set_gauge("wtpu_serve_queue_depth", h["queued"])
+        reg.set_gauge("wtpu_serve_running", h["running"])
+        reg.set_gauge("wtpu_serve_journal_lag", h["journal_lag"])
+        ema = h.get("chunk_wall_ema_s")
+        if ema:
+            reg.set_gauge("wtpu_serve_chunk_wall_ema_seconds", ema)
+        return reg.exposition()
 
     def registry_stats(self) -> dict:
         """GET /w/batch/registry — numeric fields summed across the

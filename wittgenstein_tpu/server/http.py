@@ -39,7 +39,16 @@ service"; spec schema in serve/spec.py):
     GET  /w/batch/health                   crash-safety health: uptime,
                                            queue depths, journal lag,
                                            quarantine count, watchdog
-                                           trips, chunk-wall EMA
+                                           trips, chunk-wall EMA (+
+                                           span-derived phase p50/p99
+                                           when instrumented)
+    GET  /w/batch/metrics                  Prometheus text exposition:
+                                           submits/429s/retries/
+                                           degradations/preemptions/
+                                           quarantines/watchdog trips/
+                                           lease traffic counters,
+                                           queue+lag gauges, phase
+                                           histograms
     GET  /w/batch/stream/{id}              long-poll: blocks until the
                                            next chunk boundary, returns
                                            per-chunk totals + deltas
@@ -151,6 +160,11 @@ class _Handler(BaseHTTPRequestHandler):
         # lag, quarantine count, watchdog trips (Service.health)
         ("GET", r"^/w/batch/health$",
          lambda s, m, b: s.batch.health()),
+        # Prometheus text exposition (serve/instrument.py) — the one
+        # route that replies text/plain, not JSON (_reply branches on
+        # the str return)
+        ("GET", r"^/w/batch/metrics$",
+         lambda s, m, b: s.batch.metrics()),
         # long-poll partial-metrics stream (?after=MS&timeout=S) —
         # lock-free like every batch route, and REQUIRED to be: the
         # poll blocks for seconds by design
@@ -182,6 +196,7 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/tenancy$",
         r"^/w/batch/memo$",
         r"^/w/batch/health$",
+        r"^/w/batch/metrics$",
         r"^/w/batch/stream/([A-Za-z0-9_-]+)(?:\?(.*))?$",
         r"^/w/matrix/submit$",
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
@@ -260,9 +275,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(404, {"error": f"no route {method} {self.path}"})
 
     def _reply(self, status, payload, headers=None):
-        data = json.dumps(payload).encode()
+        if isinstance(payload, str):
+            # the metrics route returns pre-rendered Prometheus text;
+            # every other endpoint returns a JSON-serializable object
+            data = payload.encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            data = json.dumps(payload).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
