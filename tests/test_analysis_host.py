@@ -271,8 +271,9 @@ def test_digest_hash_id_banned(tmp_path):
 
 def test_digest_real_tree_walk_is_nonvacuous():
     v, (n_entry, n_reach, n_files) = rules_host_digest.scan_tree()
-    # the five named entry points + MemoTable.key must all be found
-    assert n_entry >= 5
+    # the named entry points + the EXTRA_ENTRIES (MemoTable.key,
+    # SearchSpec.digest) must all be found
+    assert n_entry >= 7
     assert n_reach > n_entry        # the walk actually follows calls
     allow = framework.parse_allow(
         framework.load_budgets().get("host_digest", {}))

@@ -917,6 +917,93 @@ def bench_spans_smoke():
             "platform": jax.default_backend()}
 
 
+#: the search_smoke stage's boundary question — module-level like
+#: MEMO_SMOKE_GRID (a consumer of its digest can never drift from the
+#: stage): a single-slice 6-step loss ladder whose done_frac >= 0.99
+#: verdict flips at p060 — coarse endpoints + 2 bisection probes
+#: answer it in 4 of 6 cells, every probe forked off ONE shared
+#: honest-prefix chunk
+SEARCH_SMOKE_SPEC = {
+    "name": "search_smoke",
+    "grid": {
+        "name": "search_smoke_grid",
+        "base": {"protocol": "PingPong", "params": {"node_count": 32},
+                 "seeds": [0], "sim_ms": 160, "chunk_ms": 40,
+                 "obs": ["metrics", "audit"],
+                 "latency_model": "NetworkFixedLatency(50)"},
+        "axes": [
+            {"name": "loss", "field": "fault_schedule",
+             "values": [{"loss": [[40, 160, p, 0, 32, 0, 32]]}
+                        for p in range(0, 120, 20)],
+             "labels": ["p%03d" % p for p in range(0, 120, 20)]},
+        ],
+    },
+    "axis": "loss",
+    "predicate": {"field": "summary.done_frac", "op": ">=",
+                  "value": 0.99},
+    "coarse": 2,
+}
+
+
+def bench_search_smoke():
+    """Adaptive-search smoke stage (PR 19): the module-level boundary
+    question through `run_search` with memoized probes — asserting the
+    whole seam in seconds: a boundary found with FEWER cells probed
+    than the lattice holds, `prefix_chunks_saved` > 0 (probes forked
+    off the shared honest prefix), the `SearchReport` JSON
+    round-tripping bit-for-bit, and every probe's ledger row labelled
+    ``search:<cell>`` with the search digest in its extra block."""
+    import os
+    import tempfile
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import SearchReport, SearchSpec, \
+        run_search
+    from wittgenstein_tpu.obs import ledger
+    from wittgenstein_tpu.serve import Scheduler
+
+    spec = SearchSpec.from_json(SEARCH_SMOKE_SPEC)
+    with tempfile.TemporaryDirectory() as tmp:
+        led = os.path.join(tmp, "ledger.jsonl")
+        run = run_search(spec, Scheduler(ledger_path=led))
+        rep = run.report
+        d = rep.data
+        assert rep.clean, d["slices"]
+        [sl] = d["slices"]
+        assert sl["boundary_label"] == "p060", sl
+        assert d["cells_probed"] < d["cells_exhaustive"], d
+        assert d["chunks_simulated"] < d["chunks_exhaustive"], d
+        memo = d["accounting"]["memo"]
+        assert memo["prefix_chunks_saved"] > 0, memo
+        assert memo["fork_vetoed"] == 0, memo
+        # report round trip (schema-pinned load, atomic save path)
+        again = SearchReport.from_json(
+            json.dumps(rep.to_json(), sort_keys=True))
+        assert again.to_json() == rep.to_json()
+        # every probe left a ledger row labelled search:<cell> that
+        # carries the search digest — the cross-campaign dedup join key
+        rows = ledger.read_all(led)
+        probe_rows = {r.run: r for r in rows
+                      if r.run.startswith("search:")}
+        assert set(probe_rows) == {
+            f"search:{p['cell']}" for p in d["probes"]}, \
+            sorted(probe_rows)
+        assert all((r.extra or {}).get("search_digest")
+                   == d["search_digest"] for r in probe_rows.values())
+        assert any(r.run.startswith("memo:prefix:") for r in rows)
+    return {"metric": "search_smoke_cells_probed",
+            "value": d["cells_probed"], "unit": "cells",
+            "cells_exhaustive": d["cells_exhaustive"],
+            "chunks_simulated": d["chunks_simulated"],
+            "chunks_exhaustive": d["chunks_exhaustive"],
+            "probe_savings_ratio": d["probe_savings_ratio"],
+            "prefix_chunks_saved": memo["prefix_chunks_saved"],
+            "boundary": sl["boundary_label"],
+            "search_digest": d["search_digest"],
+            "grid_digest": d["grid_digest"],
+            "platform": jax.default_backend()}
+
+
 CONFIGS = {
     "pingpong_1000n": bench_pingpong,
     "gsf_4096n": bench_gsf,
@@ -933,6 +1020,7 @@ CONFIGS = {
     "fleet_smoke": bench_fleet_smoke,
     "spans_smoke": bench_spans_smoke,
     "analysis_smoke": bench_analysis_smoke,
+    "search_smoke": bench_search_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -948,7 +1036,8 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "crash_smoke": "crash_smoke_bit_identical",
                 "fleet_smoke": "fleet_smoke_requests",
                 "spans_smoke": "spans_smoke_spans",
-                "analysis_smoke": "analysis_smoke_wall_s"}
+                "analysis_smoke": "analysis_smoke_wall_s",
+                "search_smoke": "search_smoke_cells_probed"}
 
 
 def _stage_spec(name):
@@ -1039,6 +1128,14 @@ def _stage_spec(name):
         "fleet_smoke": dict(
             protocol="PingPong", params={"node_count": 64}, seeds=(0,),
             sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
+            superstep=1),
+        # the stage answers a whole boundary question; the digested
+        # config is the search grid's BASE cell (the memo_smoke
+        # convention — the search digest itself rides the result line)
+        "search_smoke": dict(
+            protocol="PingPong", params={"node_count": 32}, seeds=(0,),
+            latency_model="NetworkFixedLatency(50)",
+            sim_ms=160, chunk_ms=40, obs=("metrics", "audit"),
             superstep=1),
     }
     cfg = table.get(name)

@@ -70,6 +70,41 @@ CRASH_GRID = {
 VOLATILE_KEYS = ("wall_s", "program_builds", "registry", "resilience",
                  "resume", "memo")
 
+#: the search campaign under test (--search): the checked-in boundary
+#: question's single-seed half — a 16-step loss ladder the coarse
+#: bracket + bisection answers in ~6 probes, several chunks each, so
+#: kills land mid-prefix, mid-probe and between bisection rounds
+SEARCH_SPEC = {
+    "name": "crash_search",
+    "grid": {
+        "name": "crash_search_grid",
+        "base": {"protocol": "PingPong", "params": {"node_count": 32},
+                 "seeds": [0], "sim_ms": 160, "chunk_ms": 40,
+                 "obs": ["metrics", "audit"],
+                 "latency_model": "NetworkFixedLatency(50)"},
+        "axes": [
+            {"name": "loss", "field": "fault_schedule",
+             "values": [{"loss": [[40, 160, p, 0, 32, 0, 32]]}
+                        for p in range(0, 160, 10)],
+             "labels": ["p%03d" % p for p in range(0, 160, 10)]},
+        ],
+    },
+    "axis": "loss",
+    "predicate": {"field": "summary.done_frac", "op": ">=",
+                  "value": 0.99},
+    "coarse": 4,
+}
+
+#: `SearchReport` keys that HONESTLY differ between an uninterrupted
+#: search and a kill+resume one: wall clock, the accounting block
+#: (memo/table/resume counters are attempt-local), and the simulated-
+#: chunk tally (a resumed probe only re-simulates its remainder, and a
+#: ledger-served probe simulates nothing) — which drags the derived
+#: savings ratio along.  Probe SEQUENCE, verdicts, brackets and
+#: boundaries are the bit-identity target.
+SEARCH_VOLATILE_KEYS = ("wall_s", "accounting", "chunks_simulated",
+                        "probe_savings_ratio")
+
 
 def normalize_report(rep: dict) -> dict:
     """A report's crash-invariant projection (VOLATILE_KEYS note)."""
@@ -78,6 +113,20 @@ def normalize_report(rep: dict) -> dict:
         d.pop(k, None)
     for row in d.get("cells", ()):
         row.pop("resumed_from_ms", None)
+    return d
+
+
+def normalize_search_report(rep: dict) -> dict:
+    """A `SearchReport`'s crash-invariant projection
+    (SEARCH_VOLATILE_KEYS note).  Per-cell provenance — which prefix a
+    probe forked from, where a resume restarted it — is run-local the
+    same way `resumed_from_ms` is for matrix rows."""
+    d = copy.deepcopy(rep)
+    for k in SEARCH_VOLATILE_KEYS:
+        d.pop(k, None)
+    for row in d.get("cells", ()):
+        row.pop("resumed_from_ms", None)
+        row.pop("forked_from", None)
     return d
 
 
@@ -118,14 +167,39 @@ def child_main(d: str, resume: bool, timeline=None) -> int:
     return 0 if run.report.clean else 1
 
 
+def search_child_main(d: str, resume: bool) -> int:
+    """One SEARCH attempt inside the kill zone (--search): run (or
+    resume) `SEARCH_SPEC` with journal + checkpoints + ledger + a
+    cross-run memo table under `d`, then atomically write the
+    `SearchReport` to ``d/report.json``.  The probe sequence re-derives
+    purely from the spec digest, so every resumed attempt walks the
+    SAME sequence and serves already-settled probes from their ledger
+    rows."""
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.matrix import SearchSpec, run_search
+    from wittgenstein_tpu.serve import Scheduler
+
+    spec = SearchSpec.from_json(SEARCH_SPEC)
+    sch = Scheduler(ledger_path=os.path.join(d, "ledger.jsonl"),
+                    checkpoint_dir=os.path.join(d, "ck"),
+                    journal_dir=os.path.join(d, "journal"))
+    run = run_search(spec, sch, max_wave=4, resume=resume,
+                     memo={"table": os.path.join(d, "memo_table")})
+    run.report.save(os.path.join(d, "report.json"))
+    return 0 if run.report.clean else 1
+
+
 # ----------------------------------------------------------------- parent
 
 
-def _spawn(d: str, resume: bool, timeline=None) -> subprocess.Popen:
+def _spawn(d: str, resume: bool, timeline=None,
+           search: bool = False) -> subprocess.Popen:
     os.makedirs(d, exist_ok=True)
     log = open(os.path.join(d, "child.log"), "a")
     args = [sys.executable, str(pathlib.Path(__file__).resolve()),
             "--child", "--dir", d]
+    if search:
+        args.append("--search")
     if resume:
         args.append("--resume")
     if timeline is not None:
@@ -134,8 +208,9 @@ def _spawn(d: str, resume: bool, timeline=None) -> subprocess.Popen:
                             cwd=str(REPO))
 
 
-def _run_to_completion(d: str, resume: bool, timeline=None) -> dict:
-    p = _spawn(d, resume, timeline)
+def _run_to_completion(d: str, resume: bool, timeline=None,
+                       search: bool = False) -> dict:
+    p = _spawn(d, resume, timeline, search=search)
     p.wait()
     report = os.path.join(d, "report.json")
     if p.returncode != 0 or not os.path.exists(report):
@@ -221,6 +296,61 @@ def run_crash_test(out_dir, kills: int = 5, seed: int = 0,
         res["timeline"] = {"path": tpath, "span_logs": len(logs),
                            "spans": len(rows)}
     return res
+
+
+def run_search_crash_test(out_dir, kills: int = 3, seed: int = 0,
+                          min_delay: float = 1.0,
+                          max_delay: float | None = None) -> dict:
+    """The adaptive-search variant (--search): SIGKILL a `SEARCH_SPEC`
+    campaign at seeded-random offsets — mid-prefix, mid-probe, between
+    bisection rounds — resume after every kill, drive the final
+    attempt to completion, and assert the resulting `SearchReport` is
+    bit-identical to an uninterrupted run's outside
+    `SEARCH_VOLATILE_KEYS`.  The probe SEQUENCE is the heart of the
+    pin: it derives purely from (grid digest, search digest), so a
+    resumed search must re-walk the identical coarse ladder +
+    bisection path, serving settled probes from their ledger rows and
+    re-entering mid-flight ones through checkpoints + the journal."""
+    out = pathlib.Path(out_dir)
+    ref_dir, camp_dir = str(out / "ref"), str(out / "campaign")
+    t0 = time.time()
+    ref = _run_to_completion(ref_dir, resume=False, search=True)
+    ref_wall = time.time() - t0
+    # kill-offset ceiling: same adaptive logic as run_crash_test — an
+    # attempt that outlives its offset completes, after which later
+    # kills only exercise the all-served resume path
+    hi = max_delay if max_delay is not None else max(2.0,
+                                                     0.45 * ref_wall)
+    rng = random.Random(seed)
+    landed, early_done = 0, 0
+    for i in range(kills):
+        p = _spawn(camp_dir, resume=i > 0, search=True)
+        delay = rng.uniform(min_delay, hi)
+        t_spawn = time.time()
+        while time.time() - t_spawn < delay and p.poll() is None:
+            time.sleep(0.05)
+        if p.poll() is None:
+            os.kill(p.pid, signal.SIGKILL)
+            landed += 1
+            print(f"crash_test: search kill {i + 1}/{kills} landed "
+                  f"at +{delay:.2f}s", flush=True)
+        else:
+            early_done += 1
+            wall = time.time() - t_spawn
+            hi = max(min_delay + 0.5, 0.9 * wall)
+            print(f"crash_test: search kill {i + 1}/{kills} missed "
+                  f"(child finished at +{wall:.2f}s < +{delay:.2f}s); "
+                  f"ceiling -> {hi:.2f}s", flush=True)
+        p.wait()
+    final = _run_to_completion(camp_dir, resume=True, search=True)
+    ok = normalize_search_report(final) == normalize_search_report(ref)
+    return {"ok": ok, "kills_requested": kills, "kills_landed": landed,
+            "kills_missed": early_done, "seed": seed,
+            "ref_wall_s": round(ref_wall, 2),
+            "cells_probed": final.get("cells_probed"),
+            "boundaries_found": final.get("boundaries_found"),
+            "search_digest": final.get("search_digest"),
+            "grid_digest": final.get("grid_digest")}
 
 
 def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
@@ -339,8 +469,8 @@ def run_fleet_crash_test(out_dir, workers: int = 3, kills: int = 1,
     return res
 
 
-def _print_divergence(ref: dict, final: dict):
-    a, b = normalize_report(ref), normalize_report(final)
+def _print_divergence(ref: dict, final: dict, norm=normalize_report):
+    a, b = norm(ref), norm(final)
     for key in sorted(set(a) | set(b)):
         if a.get(key) != b.get(key):
             print(f"  DIVERGENCE in {key!r}:", file=sys.stderr)
@@ -398,6 +528,13 @@ def main(argv=None) -> int:
                          "processes leave torn tails the reader "
                          "tolerates) plus one merged Perfetto "
                          "timeline.json under DIR")
+    ap.add_argument("--search", action="store_true",
+                    help="adaptive-search variant: SIGKILL a "
+                         "SEARCH_SPEC boundary-search campaign "
+                         "mid-probe/mid-prefix/between bisection "
+                         "rounds and assert the resumed SearchReport "
+                         "is bit-identical (normalized) to an "
+                         "uninterrupted run's")
     ap.add_argument("--child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--resume", action="store_true",
@@ -409,6 +546,8 @@ def main(argv=None) -> int:
             print("config error: --child needs --dir", file=sys.stderr)
             return 2
         os.makedirs(args.dir, exist_ok=True)
+        if args.search:
+            return search_child_main(args.dir, resume=args.resume)
         return child_main(args.dir, resume=args.resume,
                           timeline=args.timeline)
 
@@ -417,6 +556,35 @@ def main(argv=None) -> int:
         return 2
     import tempfile
     work = args.dir or tempfile.mkdtemp(prefix="wtpu-crash-")
+    if args.search:
+        if args.workers is not None:
+            print("config error: --search is the single-process "
+                  "kill+resume harness; fleet bit-identity is pinned "
+                  "separately (run_search(workers=N) in "
+                  "tests/test_search.py)", file=sys.stderr)
+            return 2
+        try:
+            res = run_search_crash_test(
+                work, kills=args.kills, seed=args.seed,
+                min_delay=args.min_delay, max_delay=args.max_delay)
+        except RuntimeError as e:
+            print(f"config error: {e}", file=sys.stderr)
+            return 2
+        line = json.dumps({"metric": "search_crash_bit_identical",
+                           "value": int(res["ok"]), "unit": "bool",
+                           **res})
+        print(line)
+        if args.out:
+            pathlib.Path(args.out).write_text(line + "\n")
+        if not res["ok"]:
+            with open(os.path.join(work, "ref", "report.json")) as f:
+                ref = json.load(f)
+            with open(os.path.join(work, "campaign",
+                                   "report.json")) as f:
+                final = json.load(f)
+            _print_divergence(ref, final, norm=normalize_search_report)
+            return 1
+        return 0
     if args.workers is not None:
         if args.workers < 2:
             print("config error: --workers needs N >= 2 (a 1-worker "

@@ -49,7 +49,9 @@ SCAN_DIRS = ("wittgenstein_tpu/serve", "wittgenstein_tpu/matrix",
 
 #: entry points: name pattern + explicit extras
 ENTRY_NAME = re.compile(r"digest|compile_key")
-EXTRA_ENTRIES = (("wittgenstein_tpu/memo/table.py", "MemoTable.key"),)
+EXTRA_ENTRIES = (("wittgenstein_tpu/memo/table.py", "MemoTable.key"),
+                 ("wittgenstein_tpu/matrix/search.py",
+                  "SearchSpec.digest"))
 
 #: method names followed through ``obj.m()`` calls on unresolvable
 #: receivers — the serializer/canonicalizer vocabulary of this tree

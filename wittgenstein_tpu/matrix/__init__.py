@@ -18,18 +18,28 @@ scenario cells, compile-key-minimal scheduling, one comparable report.
              runs;
   report   — `MatrixReport`: per-cell metrics + audit verdicts +
              impact deltas vs each cell's fault-free twin, aggregated
-             per axis, as ONE JSON artifact.
+             per axis, as ONE JSON artifact;
+  search   — `run_search()`: adaptive boundary search over the grid —
+             a `SearchSpec` (axis + predicate) compiles to a
+             deterministic coarse-bracket + bisection probe plan where
+             every probe rides the memo prefix/fork seam and the
+             ledger dedup join, answering threshold questions with a
+             fraction of the exhaustive grid's simulated chunks; the
+             `SearchReport` rides ``reports/`` like `MatrixReport`.
 
-Surfaces: `tools/matrix.py` (CLI, exit 0 clean / 1 violations-or-
-divergence / 2 config error) and the `/w/matrix/*` endpoints
-(server/http.py).
+Surfaces: `tools/matrix.py` / `tools/search.py` (CLIs, exit 0 clean /
+1 violations-or-divergence / 2 config error) and the `/w/matrix/*`
+endpoints (server/http.py).
 """
 
 from .driver import MatrixRun, pick_spot_cells, run_grid, verify_cell  # noqa: F401
 from .grid import Axis, Cell, SweepGrid  # noqa: F401
 from .planner import MatrixPlan, plan  # noqa: F401
 from .report import MatrixReport  # noqa: F401
+from .search import (SearchPlan, SearchReport, SearchRun,  # noqa: F401
+                     SearchSpec, compile_search, run_search)
 
 __all__ = ["SweepGrid", "Axis", "Cell", "MatrixPlan", "plan",
            "MatrixRun", "run_grid", "verify_cell", "pick_spot_cells",
-           "MatrixReport"]
+           "MatrixReport", "SearchSpec", "SearchPlan", "SearchReport",
+           "SearchRun", "compile_search", "run_search"]

@@ -145,11 +145,23 @@ class FleetWorker:
 
     def __init__(self, fleet_dir, worker_id: str, *, registry=None,
                  lease_ttl_s: float = 10.0, dedup: bool = True,
-                 scheduler_kw: dict | None = None, instrument=None):
+                 scheduler_kw: dict | None = None, instrument=None,
+                 memo_table=None):
         self.paths = fleet_paths(fleet_dir)
         self.worker_id = str(worker_id)
         self.lease_ttl_s = float(lease_ttl_s)
         self.dedup = bool(dedup)
+        #: cross-run memo table (ROADMAP item 3c): when set, this
+        #: worker PUBLISHES finished ``memo_prefix`` entries' states
+        #: into the shared on-disk table and RESOLVES probe entries'
+        #: ``memo_fork`` instructions against it — concurrent probes
+        #: on different workers reuse each other's completed prefixes.
+        self.table = None
+        if memo_table is not None:
+            from ..memo.table import MemoTable
+            self.table = memo_table if isinstance(memo_table,
+                                                  MemoTable) \
+                else MemoTable(memo_table)
         #: host flight recorder + metrics (serve/instrument; None =
         #: OFF) — shared with the scheduler, so one span log carries
         #: the whole worker: lease traffic AND request lifecycle
@@ -168,7 +180,10 @@ class FleetWorker:
         self.counters = {"claimed": 0, "deduped": 0, "released": 0,
                          "renewed": 0,
                          "adopted_checkpoints": 0, "processed": 0,
-                         "steps": 0}
+                         "steps": 0,
+                         "memo_table_hits": 0, "memo_table_misses": 0,
+                         "prefix_chunks_saved": 0,
+                         "search_probes_total": 0}
         self._held: set = set()
         self._keys: dict = {}           # rid -> (digest, compile_key)
         #: incremental dedup view of the shared ledger: each poll
@@ -335,6 +350,59 @@ class FleetWorker:
                               if r in live}
         return out
 
+    def _entry_fork(self, e):
+        """Resolve a probe entry's ``memo_fork`` instruction (written
+        by the search driver, matrix/search.py) against the shared
+        memo table: a HIT returns a `ForkState` so the adopted request
+        skips the prefix chunks another worker (or the driver) already
+        simulated; a MISS — or any defect in the instruction — returns
+        None and the probe runs its full span, bit-identical by the
+        memo contract.  Counter writes go through `_mu` (renewal /
+        stats threads read them)."""
+        ex = e.get("ledger_extra") or {}
+        mf = ex.get("memo_fork")
+        if mf is None or self.table is None:
+            return None
+        try:
+            pspec = ScenarioSpec.from_json(mf["prefix_spec"])
+            fork_ms = int(mf["fork_ms"])
+        except (KeyError, ValueError, TypeError) as err:
+            print(f"fleet[{self.worker_id}]: entry {e.get('rid')!r} "
+                  f"memo_fork instruction unusable "
+                  f"({type(err).__name__}: {err!s:.120}); running the "
+                  "full span unforked", file=sys.stderr)
+            return None
+        hit = self.table.get(pspec)
+        if hit is None:
+            with self._mu:
+                self.counters["memo_table_misses"] += 1
+            return None
+        state, carries = hit
+        try:
+            rspec = ScenarioSpec.from_json(e["spec"]).validate()
+        except (KeyError, ValueError, TypeError) as err:
+            print(f"fleet[{self.worker_id}]: entry {e.get('rid')!r} "
+                  f"spec unparseable at fork time ({err!s:.120}); "
+                  "adopt_journal_entry will record the refusal",
+                  file=sys.stderr)
+            return None
+        # belt and braces: the driver veto-checked the same state bits
+        # before writing the instruction, but the chaos gate is cheap
+        # and a veto here only costs re-simulation, never correctness
+        from ..memo import chaos_noop_before_fork
+        if not chaos_noop_before_fork(rspec, state, fork_ms):
+            return None
+        with self._mu:
+            self.counters["memo_table_hits"] += 1
+            self.counters["prefix_chunks_saved"] += \
+                int(fork_ms) // rspec.chunk_ms
+        from .scheduler import ForkState
+        return ForkState(state=state,
+                         carries={p: list(cs)
+                                  for p, cs in carries.items()},
+                         at_ms=int(fork_ms),
+                         prefix_digest=mf.get("prefix_digest"))
+
     def step(self) -> dict:
         """One poll cycle: read the journal's live entries, adopt every
         checkpoint and entry this worker can lease (dedup'ing against
@@ -392,9 +460,22 @@ class FleetWorker:
                 # for one cold key never starves this step's next one
             if fresh_key:
                 cold_taken.add(ck)
-            if self.sched.adopt_journal_entry(e) is None:
+            # the search-driver handoff (matrix/search.py): a
+            # ``memo_prefix`` entry keeps its carries so its final
+            # state is table-publishable on settle; a ``memo_fork``
+            # instruction resolves against the shared table so the
+            # probe enters mid-run when another worker already ran
+            # its prefix
+            ex = e.get("ledger_extra") or {}
+            keep = bool(ex.get("memo_prefix")) and self.table is not None
+            fork = self._entry_fork(e)
+            if self.sched.adopt_journal_entry(e, fork=fork,
+                                              keep_carries=keep) is None:
                 self._release(rid)
                 continue
+            if (e.get("label") or "").startswith("search:"):
+                with self._mu:
+                    self.counters["search_probes_total"] += 1
             if self._ins is not None:
                 from .instrument import FLEET_ADOPT_JOURNAL
                 self._ins.mark(FLEET_ADOPT_JOURNAL, rid=rid)
@@ -412,6 +493,19 @@ class FleetWorker:
                 # _finalize; a transient group error's entry stays
                 # live, and releasing lets ANY worker (us included)
                 # retry it — the crash-only redo contract
+                if (req is not None and req.status == "done"
+                        and self.table is not None
+                        and (req.ledger_extra or {}).get("memo_prefix")
+                        and req.final_state is not None):
+                    # publish the finished prefix BEFORE releasing the
+                    # lease: once the lease drops, other workers' probe
+                    # adoptions may look the prefix up at any moment
+                    # key on the AS-SUBMITTED spec — MemoTable.key
+                    # digests it, and the search driver looks prefixes
+                    # up by the spec it journaled, not the resolved one
+                    self.table.put(req.requested or req.spec,
+                                   req.final_state,
+                                   req.final_carries or {})
                 self._release(rid)
         return {"adopted": adopted, "processed": processed}
 
@@ -433,9 +527,11 @@ class FleetWorker:
             body["resilience"] = dict(self.sched.resilience)
         if self._ins is not None:
             from .instrument import (refresh_fleet_counters,
-                                     refresh_scheduler_metrics)
+                                     refresh_scheduler_metrics,
+                                     refresh_search_counters)
             refresh_scheduler_metrics(self._ins.metrics, self.sched)
             refresh_fleet_counters(self._ins.metrics, body)
+            refresh_search_counters(self._ins.metrics, body)
             body["host_metrics"] = self._ins.metrics.snapshot()
             body["spans"] = self._ins.spans.stats()
         tmp = path + ".tmp"
@@ -492,29 +588,36 @@ class FleetWorker:
 # ------------------------------------------------------------ subprocess
 
 def spawn_worker(fleet_dir, worker_id: str, *, lease_ttl_s: float = 10.0,
-                 idle_exit_s: float = 3.0, max_wall_s=None,
+                 idle_exit_s: float | None = 3.0, max_wall_s=None,
                  poll_s: float = 0.25, dedup: bool = True, env=None,
-                 timeline=None):
+                 timeline=None, memo_table=None):
     """Launch one fleet worker subprocess (the shared helper behind
     `run_grid(workers=N)`, crash_test --workers and serve_load
     --workers).  stdout/stderr go to ``worker-<id>.log`` in the fleet
     dir; the returned Popen carries ``log_path``.  `timeline` (a
     directory) turns span recording ON in the child — it appends
     ``spans-<worker>.jsonl`` there, durable line-by-line, so a
-    SIGKILLed worker still leaves its timeline behind."""
+    SIGKILLed worker still leaves its timeline behind.  `memo_table`
+    (a directory) opens the shared cross-run memo table in the child;
+    `idle_exit_s=None` runs the worker until max-wall or signal (the
+    search driver's mode — probes arrive in rounds with idle gaps
+    between them)."""
     import subprocess
     paths = fleet_paths(fleet_dir)
     os.makedirs(paths["dir"], exist_ok=True)
     cmd = [sys.executable, "-m", "wittgenstein_tpu.serve.fleet",
            "--dir", paths["dir"], "--worker-id", str(worker_id),
-           "--ttl", str(lease_ttl_s), "--idle-exit", str(idle_exit_s),
-           "--poll", str(poll_s)]
+           "--ttl", str(lease_ttl_s), "--poll", str(poll_s)]
+    if idle_exit_s is not None:
+        cmd += ["--idle-exit", str(idle_exit_s)]
     if max_wall_s is not None:
         cmd += ["--max-wall", str(max_wall_s)]
     if not dedup:
         cmd += ["--no-dedup"]
     if timeline is not None:
         cmd += ["--timeline", str(timeline)]
+    if memo_table is not None:
+        cmd += ["--memo-table", str(memo_table)]
     log_path = os.path.join(paths["dir"], f"worker-{worker_id}.log")
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -551,6 +654,11 @@ def main(argv=None) -> int:
                     help="record host lifecycle spans to "
                          "DIR/spans-<worker>.jsonl (durable per line; "
                          "render with tools/timeline.py)")
+    ap.add_argument("--memo-table", default=None, metavar="DIR",
+                    help="shared cross-run memo table directory: "
+                         "publish finished memo prefixes there and "
+                         "resolve search probes' memo_fork "
+                         "instructions against it")
     args = ap.parse_args(argv)
     # protocol registry fills as models import (the classpath-scan
     # analogue — server/http.py main does the same)
@@ -564,7 +672,8 @@ def main(argv=None) -> int:
                                    f"spans-{args.worker_id}.jsonl"),
             worker=args.worker_id)
     w = FleetWorker(args.dir, args.worker_id, lease_ttl_s=args.ttl,
-                    dedup=not args.no_dedup, instrument=ins)
+                    dedup=not args.no_dedup, instrument=ins,
+                    memo_table=args.memo_table)
     counters = w.run(poll_s=args.poll, idle_exit_s=args.idle_exit,
                      max_wall_s=args.max_wall)
     print(json.dumps({"worker": args.worker_id, **counters},

@@ -93,6 +93,18 @@ FLEET_COUNTERS = {
     "adopted_checkpoints": "wtpu_fleet_lease_reclaims_total",
 }
 
+#: memo/search counter -> exposed counter name.  The sources are the
+#: fleet worker's counters dict and the search driver's memo-stats
+#: block (matrix/search.py) — both monotone over a process lifetime,
+#: so max-keeping `set_counter` projection preserves monotonicity
+#: across scrapes (the PR-18 convention; module docstring).
+SEARCH_COUNTERS = {
+    "memo_table_hits": "wtpu_memo_table_hits_total",
+    "memo_table_misses": "wtpu_memo_table_misses_total",
+    "prefix_chunks_saved": "wtpu_memo_prefix_chunks_saved_total",
+    "search_probes_total": "wtpu_search_probes_total",
+}
+
 
 class Instrumentation:
     """One handle bundling the span recorder and the metrics registry.
@@ -158,6 +170,14 @@ def refresh_scheduler_metrics(metrics, sch) -> None:
 def refresh_fleet_counters(metrics, counters) -> None:
     """Project a `FleetWorker.counters` dict into `metrics`."""
     for key, name in FLEET_COUNTERS.items():
+        if key in counters:
+            metrics.set_counter(name, counters[key])
+
+
+def refresh_search_counters(metrics, counters) -> None:
+    """Project memo/search counters (fleet worker counters dict or a
+    search driver's accounting block) into `metrics`."""
+    for key, name in SEARCH_COUNTERS.items():
         if key in counters:
             metrics.set_counter(name, counters[key])
 

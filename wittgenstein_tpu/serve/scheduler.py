@@ -630,40 +630,7 @@ class Scheduler:
         resolved = spec.validate()
         key = resolved.compile_key()
         if fork is not None:
-            at = int(fork.at_ms)
-            if at < resolved.chunk_ms or at % resolved.chunk_ms or \
-                    at >= resolved.sim_ms:
-                raise ValueError(
-                    f"fork.at_ms={at} must be a positive multiple of "
-                    f"chunk_ms={resolved.chunk_ms} inside the span "
-                    f"[chunk_ms, sim_ms={resolved.sim_ms}): requests "
-                    "enter and leave groups only on chunk boundaries")
-            import jax
-            width = jax.tree.leaves(fork.state)[0].shape[0]
-            if width != len(resolved.seeds):
-                raise ValueError(
-                    f"fork state carries {width} lane(s) but the spec "
-                    f"has {len(resolved.seeds)} seed(s): the prefix "
-                    "must have been run with exactly the cell's seeds")
-            # the stitched-artifact contract: every captured plane must
-            # arrive with one carry per prefix CHUNK, or the finished
-            # artifacts would silently claim a full span they don't
-            # cover (same refuse-with-remedy discipline as above)
-            want_chunks = at // resolved.chunk_ms
-            carries = fork.carries or {}
-            for plane in resolved.obs:
-                got = len(carries.get(plane, ()))
-                if got != want_chunks:
-                    raise ValueError(
-                        f"fork carries cover {got} chunk(s) of the "
-                        f"{plane!r} plane but the prefix spans "
-                        f"{want_chunks} chunk(s) ([0, {at}) at "
-                        f"chunk_ms={resolved.chunk_ms}): the forked "
-                        "request could not stitch a full-span "
-                        "artifact. Fix: hand over the prefix run's "
-                        "complete per-chunk carries (submit the "
-                        "prefix with keep_carries=True), or drop the "
-                        "plane from the spec's obs")
+            self._check_fork(resolved, fork)
         with self._mu:
             self._admit(resolved)
             rid = self._rid_locked()
@@ -717,6 +684,48 @@ class Scheduler:
             ins.end(SPAN_SUBMIT, t_sub, rid=rid, key=key,
                     tenant=resolved.tenant)
         return rid
+
+    @staticmethod
+    def _check_fork(resolved: ScenarioSpec, fork: ForkState) -> None:
+        """Refuse (ValueError with remedy text) a `ForkState` that
+        cannot soundly enter `resolved` mid-run: off-boundary fork
+        point, wrong lane width, or carries that don't cover the
+        prefix span (shared by `submit` and the journal-adoption
+        path)."""
+        at = int(fork.at_ms)
+        if at < resolved.chunk_ms or at % resolved.chunk_ms or \
+                at >= resolved.sim_ms:
+            raise ValueError(
+                f"fork.at_ms={at} must be a positive multiple of "
+                f"chunk_ms={resolved.chunk_ms} inside the span "
+                f"[chunk_ms, sim_ms={resolved.sim_ms}): requests "
+                "enter and leave groups only on chunk boundaries")
+        import jax
+        width = jax.tree.leaves(fork.state)[0].shape[0]
+        if width != len(resolved.seeds):
+            raise ValueError(
+                f"fork state carries {width} lane(s) but the spec "
+                f"has {len(resolved.seeds)} seed(s): the prefix "
+                "must have been run with exactly the cell's seeds")
+        # the stitched-artifact contract: every captured plane must
+        # arrive with one carry per prefix CHUNK, or the finished
+        # artifacts would silently claim a full span they don't
+        # cover (same refuse-with-remedy discipline as above)
+        want_chunks = at // resolved.chunk_ms
+        carries = fork.carries or {}
+        for plane in resolved.obs:
+            got = len(carries.get(plane, ()))
+            if got != want_chunks:
+                raise ValueError(
+                    f"fork carries cover {got} chunk(s) of the "
+                    f"{plane!r} plane but the prefix spans "
+                    f"{want_chunks} chunk(s) ([0, {at}) at "
+                    f"chunk_ms={resolved.chunk_ms}): the forked "
+                    "request could not stitch a full-span "
+                    "artifact. Fix: hand over the prefix run's "
+                    "complete per-chunk carries (submit the "
+                    "prefix with keep_carries=True), or drop the "
+                    "plane from the spec's obs")
 
     def _rid_locked(self) -> str:
         """Mint the next request id (caller holds the lock).  Worker-
@@ -1421,12 +1430,17 @@ class Scheduler:
         self.journal.compact()
         return rids
 
-    def _adopt_entry_locked(self, e: dict) -> str | None:
+    def _adopt_entry_locked(self, e: dict,
+                            fork: ForkState | None = None,
+                            keep_carries: bool = False) -> str | None:
         """Re-enqueue ONE journal entry under its original rid (caller
         holds the lock).  Returns the rid, or None when refused
         (already live — re-running a live request would fork its
         identity) or skipped (no longer validates) — both with the
-        stderr notes the crash tests pin."""
+        stderr notes the crash tests pin.  `fork` enters the adopted
+        request mid-run from a memo-table prefix (the fleet search
+        path); a fork that no longer validates degrades LOUDLY to an
+        unforked full-span re-run, which is bit-identical."""
         import sys
         rid = e.get("rid")
         if rid in self._requests:
@@ -1443,31 +1457,57 @@ class Scheduler:
                   "request must be re-submitted under the "
                   "current tree", file=sys.stderr)
             return None
+        if fork is not None:
+            try:
+                self._check_fork(resolved, fork)
+            except ValueError as err:
+                print(f"serve: journal entry {rid} fork rejected "
+                      f"({err!s:.200}); adopting unforked — the "
+                      "full-span re-run is bit-identical",
+                      file=sys.stderr)
+                fork = None
         extra = dict(e.get("ledger_extra") or {})
-        # a replayed request re-runs its FULL span (the fork
-        # state died with the process — unforked is
-        # bit-identical): the provenance must not claim a
-        # fork the re-run didn't take
+        # an UNFORKED replay re-runs its full span (the fork state
+        # died with the process): the provenance must not claim a
+        # fork the re-run didn't take.  A memo-table fork below
+        # re-stamps it.
         extra.pop("forked_from", None)
         req = Request(id=rid, spec=resolved,
                       compile_key=resolved.compile_key(),
                       requested=spec, label=e.get("label"),
+                      keep_carries=bool(keep_carries),
                       ledger_extra=extra or None)
         if self._ins is not None:
             req.enq_mono = self._ins.now()
+        if fork is not None:
+            req.restored_state = fork.state
+            req.saved_carries = {p: list(cs) for p, cs
+                                 in (fork.carries or {}).items()}
+            req.progress_ms = int(fork.at_ms)
+            req.forked_from = {"prefix_digest": fork.prefix_digest,
+                               "fork_ms": int(fork.at_ms)}
+            req.ledger_extra = {**(req.ledger_extra or {}),
+                                "forked_from": dict(req.forked_from)}
+            self.memo["forked"] += 1
         self._requests[rid] = req
         self._queue.append(rid)
         return rid
 
-    def adopt_journal_entry(self, entry: dict) -> str | None:
+    def adopt_journal_entry(self, entry: dict,
+                            fork: ForkState | None = None,
+                            keep_carries: bool = False) -> str | None:
         """Re-enqueue ONE journal entry under its original rid — the
         fleet worker's per-lease admission path (`resume_journal` is
         the adopt-everything restart variant; a fleet worker adopts
         exactly the entries whose lease it holds, so it must not
-        vacuum the whole journal).  Counts into
-        ``resilience["replayed"]``; returns the rid or None."""
+        vacuum the whole journal).  `fork` / `keep_carries` mirror
+        `submit` — the fleet memo-table seam: a worker that finds the
+        entry's honest prefix in the shared table enters it mid-run.
+        Counts into ``resilience["replayed"]``; returns the rid or
+        None."""
         with self._mu:
-            rid = self._adopt_entry_locked(entry)
+            rid = self._adopt_entry_locked(entry, fork=fork,
+                                           keep_carries=keep_carries)
             if rid is not None:
                 self.resilience["replayed"] += 1
         if rid is not None and self._ins is not None:

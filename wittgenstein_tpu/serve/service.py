@@ -67,13 +67,43 @@ class _MatrixJob:
         return out
 
 
+@dataclasses.dataclass
+class _SearchJob:
+    """One submitted boundary search (service-internal mutable
+    record — the `_MatrixJob` shape with search identities)."""
+
+    id: str
+    spec: object                    # matrix.SearchSpec
+    plan: object                    # matrix.SearchPlan
+    status: str = "planned"         # planned | running | done | error
+    progress: dict = dataclasses.field(default_factory=dict)
+    report: dict | None = None
+    error: str | None = None
+    submitted: float = dataclasses.field(default_factory=time.time)
+    finished: float | None = None
+
+    def status_json(self) -> dict:
+        out = {"id": self.id, "status": self.status,
+               "search_digest": self.plan.search_digest,
+               "grid_digest": self.plan.grid_digest,
+               "slices": len(self.plan.slices),
+               "cells_exhaustive": len(self.plan.mplan.cells)}
+        if self.progress:
+            out["progress"] = dict(self.progress)
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
 class Service:
-    #: lock inventory (analysis rule ``host_locks``): the matrix-job
-    #: table is shared between the caller's thread (submit/status) and
-    #: the per-job driver threads; `_wake`/`_stop` are intentionally
-    #: unowned (Event is self-synchronizing; `_stop` is a monotonic
-    #: close flag read by the drain loop).
-    _LOCK_OWNS = {"_matrix_mu": ("_matrix", "_matrix_n")}
+    #: lock inventory (analysis rule ``host_locks``): the matrix- and
+    #: search-job tables are shared between the caller's thread
+    #: (submit/status) and the per-job driver threads; `_wake`/`_stop`
+    #: are intentionally unowned (Event is self-synchronizing; `_stop`
+    #: is a monotonic close flag read by the drain loop).
+    _LOCK_OWNS = {"_matrix_mu": ("_matrix", "_matrix_n",
+                                 "_search", "_search_n",
+                                 "_search_counters")}
 
     def __init__(self, scheduler: Scheduler | None = None,
                  auto: bool = True):
@@ -84,6 +114,12 @@ class Service:
         self._worker = None
         self._matrix: dict = {}
         self._matrix_n = 0
+        self._search: dict = {}
+        self._search_n = 0
+        #: monotone lifetime sums over finished searches' accounting
+        #: (memo table hits/misses, prefix chunks saved, probes) —
+        #: what `metrics()` projects via the max-keeping counters
+        self._search_counters: dict = {}
         self._matrix_mu = threading.Lock()
 
     # ------------------------------------------------------------ worker
@@ -172,8 +208,16 @@ class Service:
         without an `Instrumentation` on the scheduler: the counters
         project from the scheduler's own monotone state either way;
         phase histograms appear once spans are on."""
-        from .instrument import scheduler_exposition
-        return scheduler_exposition(self.scheduler)
+        from ..obs.metrics import MetricsRegistry
+        from .instrument import (refresh_scheduler_metrics,
+                                 refresh_search_counters)
+        ins = getattr(self.scheduler, "_ins", None)
+        metrics = ins.metrics if ins is not None else MetricsRegistry()
+        refresh_scheduler_metrics(metrics, self.scheduler)
+        with self._matrix_mu:
+            sc = dict(self._search_counters)
+        refresh_search_counters(metrics, sc)
+        return metrics.exposition()
 
     def recover(self) -> dict:
         """Crash-only restart seam: replay group checkpoints, then the
@@ -297,6 +341,118 @@ class Service:
         out["status"] = "done"
         return out
 
+    # ------------------------------------------ search (boundary scans)
+
+    def search_submit(self, body: dict) -> dict:
+        """POST /w/matrix/search/submit — body is a `SearchSpec` JSON
+        object (grid + axis + predicate).  Compiles EAGERLY (every
+        grid cell validated, the probe plan derived — a malformed spec
+        raises ValueError with remedy text, the HTTP layer's 400) and,
+        in auto mode, starts the campaign on a worker thread; manual
+        mode drives it with `search_run(id)`."""
+        from ..matrix import SearchSpec, compile_search
+
+        spec = SearchSpec.from_json(body or {})
+        splan = compile_search(spec)
+        with self._matrix_mu:
+            self._search_n += 1
+            sid = f"s{self._search_n:04d}"
+            job = _SearchJob(id=sid, spec=spec, plan=splan)
+            self._search[sid] = job
+        if self._auto:
+            threading.Thread(target=self._search_drive, args=(job,),
+                             daemon=True,
+                             name=f"wtpu-search-{sid}").start()
+        return {"id": sid, "status": job.status,
+                "search_digest": splan.search_digest,
+                "grid_digest": splan.grid_digest,
+                "slices": len(splan.slices),
+                "cells_exhaustive": len(splan.mplan.cells)}
+
+    def _search_job(self, sid: str) -> _SearchJob:
+        with self._matrix_mu:
+            if sid not in self._search:
+                raise KeyError(f"unknown search job {sid!r}")
+            return self._search[sid]
+
+    def _search_drive(self, job: _SearchJob):
+        """Run one compiled search on the shared scheduler.  Probes
+        ride the same memo fork seam as `run_grid(memo=True)`; the
+        finished report's accounting folds into the service's monotone
+        search counters (the metrics projection source)."""
+        from ..matrix import run_search
+
+        with self._matrix_mu:
+            if job.status != "planned":
+                return                  # single driver per job
+            job.status = "running"
+        try:
+            run = run_search(job.spec, self.scheduler, splan=job.plan,
+                             progress=lambda p: job.progress.update(p))
+            job.report = run.report.to_json()
+            job.status = "done"
+            acct = job.report.get("accounting") or {}
+            memo = acct.get("memo") or {}
+            table = memo.get("table") or {}
+            with self._matrix_mu:
+                sc = self._search_counters
+                sc["search_probes_total"] = \
+                    sc.get("search_probes_total", 0) \
+                    + job.report.get("cells_probed", 0)
+                sc["prefix_chunks_saved"] = \
+                    sc.get("prefix_chunks_saved", 0) \
+                    + memo.get("prefix_chunks_saved", 0)
+                sc["memo_table_hits"] = sc.get("memo_table_hits", 0) \
+                    + table.get("hits", 0)
+                sc["memo_table_misses"] = \
+                    sc.get("memo_table_misses", 0) \
+                    + table.get("misses", 0)
+        except Exception as e:          # noqa: BLE001 — a broken
+            # search must not take the service thread down silently
+            job.status, job.error = "error", f"{type(e).__name__}: " \
+                                            f"{e!s:.500}"
+        finally:
+            job.finished = time.time()
+            self._evict_search()
+
+    #: finished search jobs retained for report polling
+    keep_done_search = 64
+
+    def _evict_search(self):
+        """Drop the oldest finished search jobs past
+        `keep_done_search` (the matrix eviction convention)."""
+        with self._matrix_mu:
+            done = sorted((j for j in self._search.values()
+                           if j.status in ("done", "error")),
+                          key=lambda j: j.finished or 0.0)
+            for j in done[:max(0, len(done) - self.keep_done_search)]:
+                del self._search[j.id]
+
+    def search_run(self, sid: str) -> dict:
+        """POST /w/matrix/search/run/{id} — synchronous drive (manual
+        mode / ops; a no-op returning status when already running or
+        done)."""
+        job = self._search_job(sid)
+        if job.status == "planned":
+            self._search_drive(job)
+        return job.status_json()
+
+    def search_status(self, sid: str) -> dict:
+        """GET /w/matrix/search/status/{id} — lifecycle + live
+        progress (round / probes / chunks simulated / wall)."""
+        return self._search_job(sid).status_json()
+
+    def search_report(self, sid: str) -> dict:
+        """GET /w/matrix/search/report/{id} — the `SearchReport`
+        artifact when done, else the status snapshot
+        (poll-friendly)."""
+        job = self._search_job(sid)
+        if job.status != "done":
+            return job.status_json()
+        out = dict(job.report)
+        out["status"] = "done"
+        return out
+
 
 class FleetService:
     """Front tier over a shared fleet directory (serve/fleet.py): the
@@ -324,10 +480,11 @@ class FleetService:
     against a worker, or use `matrix.run_grid(workers=N)`.
     """
 
-    #: lock inventory (analysis rule ``host_locks``): the rid counter
-    #: and the rid->digest result-join cache are touched from every
-    #: HTTP thread.
-    _LOCK_OWNS = {"_mu": ("_n", "_digests")}
+    #: lock inventory (analysis rule ``host_locks``): the rid counter,
+    #: the rid->digest result-join cache and the search-job table are
+    #: touched from every HTTP thread (plus the search driver
+    #: threads).
+    _LOCK_OWNS = {"_mu": ("_n", "_digests", "_search", "_search_n")}
 
     def __init__(self, fleet_dir, *, front_id: str | None = None,
                  tenants: dict | None = None):
@@ -348,6 +505,8 @@ class FleetService:
         self._mu = threading.Lock()
         self._n = 0
         self._digests: dict = {}    # rid -> as-submitted spec digest
+        self._search: dict = {}     # sid -> _SearchJob
+        self._search_n = 0
 
     # ---------------------------------------------------------- admission
 
@@ -537,7 +696,8 @@ class FleetService:
         queue/lag gauges.  Sums of per-worker monotone series stay
         monotone, so repeated scrapes never read backwards."""
         from ..obs.metrics import MetricsRegistry
-        from .instrument import FLEET_COUNTERS, RESILIENCE_COUNTERS
+        from .instrument import (FLEET_COUNTERS, RESILIENCE_COUNTERS,
+                                 SEARCH_COUNTERS)
         reg = MetricsRegistry()
         sums: dict = {}
         for w in self.worker_stats().values():
@@ -552,6 +712,8 @@ class FleetService:
             reg.set_counter(name, sums.get(k, 0))
         for k, name in RESILIENCE_COUNTERS.items():
             reg.set_counter(name, sums.get("res_" + k, 0))
+        for k, name in SEARCH_COUNTERS.items():
+            reg.set_counter(name, sums.get(k, 0))
         with self._mu:
             front_n = self._n
         reg.set_counter("wtpu_serve_submits_total",
@@ -591,6 +753,89 @@ class FleetService:
             out["tenants"][t] = {
                 "queued": h["queued_by_tenant"].get(t, 0),
                 "weight": pol.weight, "max_queued": pol.max_queued}
+        return out
+
+    # ------------------------------------------ search (boundary scans)
+
+    def search_submit(self, body: dict) -> dict:
+        """POST /w/matrix/search/submit — the fleet front tier's
+        search entry: compile eagerly, then drive the fleet round loop
+        on a front-side thread.  Probes become durable journal entries
+        the EXISTING workers complete (spawn=False — a FleetService
+        deployment already runs its workers; point them at
+        ``--memo-table`` for cross-worker prefix reuse)."""
+        from ..matrix import SearchSpec, compile_search
+
+        spec = SearchSpec.from_json(body or {})
+        splan = compile_search(spec)
+        with self._mu:
+            self._search_n += 1
+            sid = f"{self.front_id}-s{self._search_n:04d}"
+            job = _SearchJob(id=sid, spec=spec, plan=splan)
+            self._search[sid] = job
+        threading.Thread(target=self._search_drive, args=(job,),
+                         daemon=True,
+                         name=f"wtpu-fleet-search-{sid}").start()
+        return {"id": sid, "status": job.status,
+                "search_digest": splan.search_digest,
+                "grid_digest": splan.grid_digest,
+                "slices": len(splan.slices),
+                "cells_exhaustive": len(splan.mplan.cells)}
+
+    def _search_job(self, sid: str) -> _SearchJob:
+        with self._mu:
+            if sid not in self._search:
+                raise KeyError(f"unknown search job {sid!r}")
+            return self._search[sid]
+
+    def _search_drive(self, job: _SearchJob):
+        from ..matrix.search import _run_search_fleet
+
+        with self._mu:
+            if job.status != "planned":
+                return                  # single driver per job
+            job.status = "running"
+        try:
+            run = _run_search_fleet(
+                job.spec, job.plan, fleet_dir=self.paths["dir"],
+                workers=0, spawn=False,
+                progress=lambda p: job.progress.update(p))
+            job.report = run.report.to_json()
+            job.status = "done"
+        except Exception as e:          # noqa: BLE001 — a broken
+            # search must not take the front-tier thread down silently
+            job.status, job.error = "error", f"{type(e).__name__}: " \
+                                            f"{e!s:.500}"
+        finally:
+            job.finished = time.time()
+            with self._mu:
+                done = sorted((j for j in self._search.values()
+                               if j.status in ("done", "error")),
+                              key=lambda j: j.finished or 0.0)
+                for j in done[:max(0, len(done)
+                                   - Service.keep_done_search)]:
+                    del self._search[j.id]
+
+    def search_run(self, sid: str) -> dict:
+        """POST /w/matrix/search/run/{id} — synchronous drive (manual
+        mode; a no-op returning status when already running/done)."""
+        job = self._search_job(sid)
+        if job.status == "planned":
+            self._search_drive(job)
+        return job.status_json()
+
+    def search_status(self, sid: str) -> dict:
+        """GET /w/matrix/search/status/{id}."""
+        return self._search_job(sid).status_json()
+
+    def search_report(self, sid: str) -> dict:
+        """GET /w/matrix/search/report/{id} — the `SearchReport` when
+        done, else the status snapshot (poll-friendly)."""
+        job = self._search_job(sid)
+        if job.status != "done":
+            return job.status_json()
+        out = dict(job.report)
+        out["status"] = "done"
         return out
 
     def close(self):

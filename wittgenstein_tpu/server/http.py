@@ -65,6 +65,17 @@ grid schema in matrix/grid.py):
     GET  /w/matrix/report/{id}             the MatrixReport artifact
     POST /w/matrix/run/{id}                manual synchronous drive
 
+Adaptive boundary search (wittgenstein_tpu/matrix/search.py — README
+"Adaptive campaigns"; spec schema in SearchSpec):
+
+    POST /w/matrix/search/submit           body: SearchSpec JSON ->
+                                           {"id", "search_digest",
+                                            "slices", "cells_exhaustive"}
+    GET  /w/matrix/search/status/{id}      lifecycle + round / probes /
+                                           chunks simulated
+    GET  /w/matrix/search/report/{id}      the SearchReport artifact
+    POST /w/matrix/search/run/{id}         manual synchronous drive
+
 Run: python -m wittgenstein_tpu.server.http [port]
 """
 
@@ -182,6 +193,18 @@ class _Handler(BaseHTTPRequestHandler):
          lambda s, m, b: s.batch.matrix_report(m.group(1))),
         ("POST", r"^/w/matrix/run/([A-Za-z0-9_-]+)$",
          lambda s, m, b: s.batch.matrix_run(m.group(1))),
+        # ---- adaptive boundary search (matrix/search.py): a
+        # SearchSpec compiles to a deterministic probe plan at submit
+        # (400 on a malformed spec/grid) and the campaign drives the
+        # same batch scheduler / fleet journal the matrix plane uses.
+        ("POST", r"^/w/matrix/search/submit$",
+         lambda s, m, b: s.batch.search_submit(b or {})),
+        ("GET", r"^/w/matrix/search/status/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.search_status(m.group(1))),
+        ("GET", r"^/w/matrix/search/report/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.search_report(m.group(1))),
+        ("POST", r"^/w/matrix/search/run/([A-Za-z0-9_-]+)$",
+         lambda s, m, b: s.batch.search_run(m.group(1))),
     ]
 
     # Routes that must NOT take the sim lock (keyed by the ROUTES pattern,
@@ -202,6 +225,10 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
         r"^/w/matrix/report/([A-Za-z0-9_-]+)$",
         r"^/w/matrix/run/([A-Za-z0-9_-]+)$",
+        r"^/w/matrix/search/submit$",
+        r"^/w/matrix/search/status/([A-Za-z0-9_-]+)$",
+        r"^/w/matrix/search/report/([A-Za-z0-9_-]+)$",
+        r"^/w/matrix/search/run/([A-Za-z0-9_-]+)$",
     })
 
     @property
