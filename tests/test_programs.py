@@ -1,0 +1,273 @@
+"""Program observatory (PR 20) — catalog durability + serve wiring.
+
+Acceptance pins:
+  * catalog OFF costs nothing: a `catalog=None` scheduler never
+    imports `obs.programs` (subprocess sys.modules check) and never
+    touches a catalog write path (rigged to explode in-process —
+    the spans-OFF convention);
+  * one cold build round-trips ONE durable, fully-populated catalog
+    row (compile key, backend, build/lower/compile walls,
+    memory_analysis bytes, cost_analysis flops, build-time cost-model
+    predictions), idempotent across launches;
+  * catalog-ON artifacts are bit-identical to catalog-OFF outside the
+    honest wall clock (the capture serves launches FROM the compiled
+    executable — it IS the program);
+  * a SIGKILL mid-append leaves at most one torn row, and reload
+    parses every complete row (the jsonl torn-tail contract);
+  * the registry hit/miss gauges and the cost-model drift gauges land
+    in the metrics exposition; `/w/batch/programs` serves the report;
+  * tools/programs.py renders a catalog file or run directory and
+    exits 2 on no rows.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+from wittgenstein_tpu.obs.metrics import parse_exposition
+from wittgenstein_tpu.obs.programs import (CatalogProgram,
+                                           ProgramCatalog,
+                                           read_catalog,
+                                           summarize_programs)
+from wittgenstein_tpu.serve import ScenarioSpec, Scheduler, Service
+from wittgenstein_tpu.serve.instrument import Instrumentation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(**kw):
+    base = dict(protocol="PingPong", params={"node_count": 64},
+                seeds=(0,), sim_ms=80, chunk_ms=40, obs=("metrics",))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def _run(sch, spec=None):
+    rid = sch.submit(spec or _spec())
+    sch.run_pending()
+    req = sch.request(rid)
+    assert req.status == "done", req.error
+    return req
+
+
+# ------------------------------------------------------- catalog is OFF
+
+def test_catalog_off_imports_nothing():
+    """The is-None branch is the whole OFF story: a plain scheduler
+    run must never even IMPORT the observatory module."""
+    code = (
+        "import sys\n"
+        "import wittgenstein_tpu.models\n"
+        "from wittgenstein_tpu.serve import ScenarioSpec, Scheduler\n"
+        "sch = Scheduler()\n"
+        "rid = sch.submit(ScenarioSpec(protocol='PingPong',"
+        " params={'node_count': 64}, seeds=(0,), sim_ms=80,"
+        " chunk_ms=40, obs=('metrics',)))\n"
+        "sch.run_pending()\n"
+        "assert sch.request(rid).status == 'done'\n"
+        "assert 'wittgenstein_tpu.obs.programs' not in sys.modules, "
+        "'catalog=None imported the observatory'\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, capture_output=True,
+        text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_catalog_off_write_paths_never_touched(monkeypatch):
+    """Rig every catalog write path to explode, then run a full
+    lifecycle with catalog=None (the spans-OFF convention)."""
+    def boom(*a, **k):
+        raise AssertionError("catalog touched with catalog OFF")
+    monkeypatch.setattr(CatalogProgram, "__init__", boom)
+    monkeypatch.setattr(ProgramCatalog, "record_build", boom)
+    monkeypatch.setattr(ProgramCatalog, "record_program", boom)
+    monkeypatch.setattr(ProgramCatalog, "observe_chunk", boom)
+    sch = Scheduler()
+    assert sch.catalog is None and sch.registry.catalog is None
+    _run(sch)
+
+
+# ------------------------------------------------------ row round trip
+
+def test_cold_build_round_trips_one_row(tmp_path):
+    p = tmp_path / "programs.jsonl"
+    cat = ProgramCatalog(path=p)
+    sch = Scheduler(catalog=cat)
+    _run(sch)
+    # a second request on the same compile key: warm, no new row
+    _run(sch, _spec(seeds=(1,)))
+    rows = read_catalog(p)
+    assert len(rows) == 1, [r.get("key") for r in rows]
+    row = rows[0]
+    for field in ("schema", "key", "plane", "backend", "protocol",
+                  "build_wall_s", "lower_wall_s", "compile_wall_s",
+                  "memory", "cost", "predicted", "arg_leaves",
+                  "batch"):
+        assert field in row, (field, sorted(row))
+    assert row["compile_wall_s"] > 0 and row["build_wall_s"] > 0
+    assert row["memory"].get("temp_bytes", 0) > 0
+    assert row["predicted"]["route_vmem_bytes"] > 0
+    assert row["predicted"]["vmem_budget_bytes"] > 0
+    # chunk-wall samples aggregated per key; drift joins them
+    stats = cat.chunk_stats()
+    assert stats[row["key"]]["count"] >= 2, stats
+    [d] = cat.drift()
+    assert d["vmem_ratio"] > 0 and d["chunks"] >= 2, d
+    rep = cat.report()
+    assert rep["count"] == 1
+    assert rep["top_compile"][0]["key"] == row["key"]
+    assert rep["catalog"]["path"] == str(p)
+
+
+def test_artifacts_bit_identical_catalog_on_off(tmp_path):
+    """The capture serves launches FROM the compiled executable, so a
+    catalogued run's artifacts are the uncatalogued run's artifacts —
+    the only honest difference is the wall clock."""
+    spec = _spec(obs=("metrics", "audit"))
+    a = _run(Scheduler(), spec).artifacts
+    b = _run(Scheduler(
+        catalog=ProgramCatalog(path=tmp_path / "p.jsonl")),
+        spec).artifacts
+    norm = lambda d: json.dumps(                       # noqa: E731
+        {k: v for k, v in d.items() if k != "wall_s"},
+        sort_keys=True, default=str)
+    assert norm(a) == norm(b)
+
+
+# ----------------------------------------------------------- durability
+
+def test_torn_tail_reload(tmp_path):
+    p = tmp_path / "programs.jsonl"
+    cat = ProgramCatalog(path=p)
+    cat.record_program("k1", "metrics", lower_wall_s=0.1,
+                       compile_wall_s=0.5, memory={"temp_bytes": 10},
+                       cost={"flops": 1e6})
+    cat.record_program("k2", "metrics", lower_wall_s=0.1,
+                       compile_wall_s=0.7, memory={"temp_bytes": 20},
+                       cost={})
+    with open(p, "ab") as f:        # the SIGKILL mid-append shape
+        f.write(b'{"schema": 1, "key": "k3", "compile_wa')
+    rows = read_catalog(p)
+    assert [r["key"] for r in rows] == ["k1", "k2"]
+
+
+def test_sigkill_mid_append_at_most_one_torn_row(tmp_path):
+    """A real SIGKILL against a process appending catalog rows in a
+    loop: every complete row parses, and the raw file holds at most
+    ONE extra (torn) line."""
+    p = tmp_path / "programs.jsonl"
+    code = (
+        "import sys\n"
+        "from wittgenstein_tpu.obs.programs import ProgramCatalog\n"
+        f"cat = ProgramCatalog(path={str(p)!r}, fsync=False)\n"
+        "for i in range(100000):\n"
+        "    cat.record_program(f'k{i}', 'metrics', lower_wall_s=0.1,\n"
+        "        compile_wall_s=0.5, memory={'temp_bytes': i},\n"
+        "        cost={'flops': 1.0})\n")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if p.exists() and p.stat().st_size > 4096:
+                break
+            time.sleep(0.05)
+        assert p.exists(), "writer never produced a row"
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    rows = read_catalog(p)
+    raw_lines = len([ln for ln in p.read_bytes().split(b"\n") if ln])
+    assert rows, "no complete rows survived the kill"
+    assert raw_lines - len(rows) <= 1, (raw_lines, len(rows))
+    assert all(r["key"] == f"k{i}" for i, r in enumerate(rows))
+
+
+def test_write_error_degrades_loudly(tmp_path, capsys):
+    """An unwritable catalog path must not take the build down with
+    it — the row is lost, counted, and shouted to stderr."""
+    cat = ProgramCatalog(path=tmp_path)    # a DIRECTORY: open() fails
+    row = cat.record_program("k", "metrics", lower_wall_s=0.1,
+                             compile_wall_s=0.5, memory={}, cost={})
+    assert row is not None
+    assert cat.stats()["write_errors"] == 1
+    assert "programs" in capsys.readouterr().err
+
+
+# -------------------------------------------------------------- metrics
+
+def test_registry_and_drift_gauges_in_exposition(tmp_path):
+    ins = Instrumentation(worker="t")
+    cat = ProgramCatalog(path=tmp_path / "p.jsonl")
+    sch = Scheduler(instrument=ins, catalog=cat)
+    assert cat.metrics is ins.metrics      # adopted, one registry
+    _run(sch)
+    from wittgenstein_tpu.serve.instrument import scheduler_exposition
+    m = parse_exposition(scheduler_exposition(sch))
+    assert m.get("wtpu_registry_misses", 0) >= 1
+    assert "wtpu_registry_hits" in m
+    assert m.get("wtpu_programs_cataloged") == 1
+    key = read_catalog(tmp_path / "p.jsonl")[0]["key"]
+    assert any(k.startswith("wtpu_costmodel_drift{") and key in k
+               for k in m), sorted(k for k in m if "wtpu_" in k)
+    assert any(k.startswith("wtpu_program_compile_seconds{")
+               for k in m)
+    # the chunk-wall histogram fed through the shared registry
+    assert m.get("wtpu_program_chunk_seconds_count", 0) >= 1
+
+
+def test_programs_endpoint(tmp_path):
+    svc = Service(scheduler=Scheduler(
+        catalog=ProgramCatalog(path=tmp_path / "p.jsonl")), auto=False)
+    off = Service(scheduler=Scheduler(), auto=False).programs()
+    assert off["catalog"] == "off" and off["count"] == 0
+    svc.submit(_spec().to_json())
+    svc.run_pending()
+    rep = svc.programs()
+    assert rep["count"] == 1 and rep["top_compile"]
+    assert rep["drift"][0]["vmem_ratio"] > 0
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_tools_programs_cli(tmp_path, capsys):
+    from tools import programs as cli
+    assert cli.main([str(tmp_path / "missing")]) == 2
+    cat = ProgramCatalog(path=tmp_path / "programs-w0.jsonl")
+    cat.record_program("kx", "metrics", lower_wall_s=0.1,
+                       compile_wall_s=0.5,
+                       memory={"temp_bytes": 1024},
+                       cost={"flops": 1e6})
+    capsys.readouterr()
+    assert cli.main([str(tmp_path)]) == 0          # directory glob
+    out = capsys.readouterr().out
+    assert "kx" in out and "top compile-wall" in out
+    assert cli.main([str(tmp_path / "programs-w0.jsonl"),
+                     "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["count"] == 1
+
+
+def test_summarize_orders_by_compile_wall():
+    rows = [{"key": "a", "plane": "m", "compile_wall_s": 0.1,
+             "memory": {"temp_bytes": 10},
+             "predicted": {"route_vmem_bytes": 100}},
+            {"key": "b", "plane": "m", "compile_wall_s": 0.9,
+             "memory": {"temp_bytes": 900},
+             "predicted": {"route_vmem_bytes": 100}}]
+    rep = summarize_programs(rows)
+    assert [t["key"] for t in rep["top_compile"]] == ["b", "a"]
+    assert rep["compile_wall_total_s"] == pytest.approx(1.0)
+    # |log ratio| ordering: the 10x over-prediction (ratio 0.1)
+    # outranks the 9x under-prediction — both directions equally loud
+    assert rep["drift_outliers"][0]["key"] == "a"
+    assert rep["drift_outliers"][1]["key"] == "b"

@@ -1,0 +1,233 @@
+"""Bench-history regression gate (PR 20) — detector + CLI semantics.
+
+Acceptance pins:
+  * a seeded 2x wall inflation exits 1 and the finding NAMES the
+    stage and series; re-gating the same data after a clean round
+    exits 0;
+  * rows from a different host fingerprint are NEVER compared (the
+    cross-host key isolation);
+  * direction semantics: ``*per_sec*`` regresses on a drop, wall
+    series regress on a rise, count series are not gated;
+  * the median/MAD threshold survives an outlier INSIDE the baseline
+    window, and the relative floor keeps a zero-MAD history from
+    flagging noise;
+  * a torn history tail (SIGKILL mid-append) is tolerated on reload;
+  * exit 2 on missing/empty history or an unknown round.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from wittgenstein_tpu.obs import regress
+from wittgenstein_tpu.obs.regress import (BenchHistory,
+                                          detect_regressions, gate,
+                                          read_history,
+                                          series_direction,
+                                          stage_measures)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fill(h, stage="route", rounds=5, wall=0.5, value=100.0,
+          host=None, metric="route_msgs_per_sec", digest="abc",
+          jitter=0.0):
+    for r in range(rounds):
+        h.append(stage=stage, round_id=f"base{r}",
+                 measures={"value": value + jitter * r,
+                           "wall_median_s": wall + 0.01 * jitter * r},
+                 config_digest=digest, backend="cpu", host=host,
+                 metric=metric)
+
+
+# ------------------------------------------------------------- the gate
+
+def test_wall_inflation_exits_1_naming_stage(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, jitter=1.0)
+    h.append(stage="route", round_id="new",
+             measures={"value": 101.0, "wall_median_s": 1.0},
+             config_digest="abc", backend="cpu",
+             metric="route_msgs_per_sec")
+    code, findings, summary = gate(p)
+    assert code == 1 and summary["regressions"] == 1
+    [f] = findings
+    assert f["stage"] == "route" and f["series"] == "wall_median_s"
+    assert f["direction"] == "down" and f["ratio"] == pytest.approx(
+        1.0 / 0.52, rel=0.05)
+    assert "route.wall_median_s" in regress.format_findings(findings)
+
+
+def test_clean_rerun_exits_0(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, jitter=1.0)
+    h.append(stage="route", round_id="new",
+             measures={"value": 103.0, "wall_median_s": 0.53},
+             config_digest="abc", backend="cpu",
+             metric="route_msgs_per_sec")
+    code, findings, summary = gate(p)
+    assert code == 0 and not findings
+    assert summary["series_checked"] == 2
+
+
+def test_throughput_drop_is_a_regression(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h)
+    h.append(stage="route", round_id="new", measures={"value": 50.0},
+             config_digest="abc", backend="cpu",
+             metric="route_msgs_per_sec")
+    code, findings, _ = gate(p)
+    assert code == 1
+    assert findings[0]["series"] == "value"
+    assert findings[0]["direction"] == "up"     # higher-is-better fell
+
+
+def test_cross_host_rows_never_compared(tmp_path):
+    """A laptop's baseline must not gate a TPU host: the new round
+    from an unknown host has NO baseline, so nothing is checked."""
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, host="laptop/arm64")
+    h.append(stage="route", round_id="new",
+             measures={"value": 1.0, "wall_median_s": 99.0},
+             config_digest="abc", backend="cpu", host="tpuvm/x86_64",
+             metric="route_msgs_per_sec")
+    code, findings, summary = gate(p)
+    assert code == 0 and not findings
+    assert summary["series_checked"] == 0
+    assert summary["series_skipped_no_baseline"] == 2
+
+
+def test_config_digest_partitions_baselines(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, digest="k1-config", wall=0.1)
+    h.append(stage="route", round_id="new",
+             measures={"wall_median_s": 5.0},
+             config_digest="k4-config", backend="cpu",
+             metric="route_msgs_per_sec")
+    code, _, summary = gate(p)
+    assert code == 0 and summary["series_checked"] == 0
+
+
+# ------------------------------------------------------------- detector
+
+def test_directions():
+    assert series_direction("value", "route_msgs_per_sec") == "up"
+    assert series_direction("value", "analysis_smoke_wall_s") == "down"
+    assert series_direction("wall_median_s", None) == "down"
+    assert series_direction("wall_s", "x_events") == "down"
+    # count-like series are not gated
+    assert series_direction("value", "trace_smoke_events") is None
+    assert series_direction("value", None) is None
+
+
+def test_stage_measures_extraction():
+    res = {"metric": "m", "value": 7, "wall_median_s": 0.25,
+           "wall_s": 1.5, "reps": 2, "unit": "x",
+           "crosscheck": "sync_override"}
+    assert stage_measures(res) == {"value": 7.0, "wall_s": 1.5,
+                                   "wall_median_s": 0.25}
+    assert stage_measures({"metric": "m", "error": "boom"}) == {}
+    assert stage_measures({"value": True}) == {}    # bools are not data
+
+
+def test_mad_threshold_survives_baseline_outlier():
+    hist = [{"stage": "s", "config_digest": "d", "backend": "cpu",
+             "host": "h", "round": f"r{i}", "metric": "x_per_sec",
+             "measures": {"value": v}}
+            for i, v in enumerate([100.0, 101.0, 99.0, 100.0, 30.0])]
+    new = [{"stage": "s", "config_digest": "d", "backend": "cpu",
+            "host": "h", "round": "n", "metric": "x_per_sec",
+            "measures": {"value": 97.0}}]
+    findings, checked = detect_regressions(hist, new)
+    assert checked == 1 and not findings    # median ~100, MAD robust
+
+
+def test_rel_floor_gates_zero_mad_history():
+    hist = [{"stage": "s", "config_digest": "d", "backend": "cpu",
+             "host": "h", "round": f"r{i}", "metric": "x_per_sec",
+             "measures": {"value": 100.0}} for i in range(5)]
+    mk = lambda v: [{"stage": "s", "config_digest": "d",  # noqa: E731
+                     "backend": "cpu", "host": "h", "round": "n",
+                     "metric": "x_per_sec", "measures": {"value": v}}]
+    # within the 10% floor: clean; past it: flagged
+    assert not detect_regressions(hist, mk(95.0))[0]
+    assert detect_regressions(hist, mk(85.0))[0]
+
+
+def test_min_baseline_skips_thin_history():
+    hist = [{"stage": "s", "config_digest": "d", "backend": "cpu",
+             "host": "h", "round": f"r{i}", "metric": "x_per_sec",
+             "measures": {"value": 100.0}} for i in range(2)]
+    new = [{"stage": "s", "config_digest": "d", "backend": "cpu",
+            "host": "h", "round": "n", "metric": "x_per_sec",
+            "measures": {"value": 1.0}}]
+    findings, checked = detect_regressions(hist, new)
+    assert checked == 0 and not findings
+
+
+# ----------------------------------------------------------- durability
+
+def test_torn_tail_tolerated(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, rounds=3)
+    with open(p, "ab") as f:        # the SIGKILL mid-append shape
+        f.write(b'{"schema": 1, "stage": "route", "measur')
+    rows = read_history(p)
+    assert len(rows) == 3
+    code, _, _ = gate(p)
+    assert code == 0
+
+
+def test_non_history_rows_skipped(tmp_path, capsys):
+    p = tmp_path / "hist.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"not": "a history row"}) + "\n")
+        f.write(json.dumps({"stage": "s", "round": "r",
+                            "measures": {"value": 1.0}}) + "\n")
+    rows = read_history(p)
+    assert len(rows) == 1
+    assert "not a history row" in capsys.readouterr().err
+
+
+def test_append_error_degrades_loudly(tmp_path, capsys):
+    h = BenchHistory(tmp_path)      # a DIRECTORY: open() fails
+    h.append(stage="s", round_id="r", measures={"value": 1.0})
+    assert h.stats()["write_errors"] == 1
+    assert "regress" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_exit_2_on_missing_or_unknown(tmp_path):
+    assert gate(tmp_path / "missing.jsonl")[0] == 2
+    p = tmp_path / "hist.jsonl"
+    BenchHistory(p).append(stage="s", round_id="r",
+                           measures={"value": 1.0})
+    assert gate(p, round_id="nope")[0] == 2
+
+
+def test_tools_regress_cli(tmp_path, capsys):
+    from tools import regress as cli
+    p = tmp_path / "hist.jsonl"
+    h = BenchHistory(p)
+    _fill(h, jitter=1.0)
+    h.append(stage="route", round_id="bad",
+             measures={"wall_median_s": 2.0}, config_digest="abc",
+             backend="cpu", metric="route_msgs_per_sec")
+    capsys.readouterr()
+    assert cli.main([str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION route.wall_median_s" in out
+    assert cli.main([str(p), "--json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["exit"] == 1
+    assert verdict["findings"][0]["stage"] == "route"
+    assert cli.main([str(tmp_path / "missing.jsonl")]) == 2

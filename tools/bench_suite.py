@@ -16,16 +16,27 @@ crash_smoke (PR 15 — one real SIGKILL of a subprocess campaign,
 journal+checkpoint resume, report bit-identity asserted, plus the
 /w/batch/health round trip over real HTTP), analysis_smoke (PR 16
 — the full `--source` static-analysis pass as a subprocess, budgets
-enforced, wall time under 60 s) and spans_smoke (PR 18 — one
+enforced, wall time under 60 s), spans_smoke (PR 18 — one
 instrumented request with the host flight recorder ON: the lifecycle
 span set asserted complete and ordered, the /w/batch/metrics
 Prometheus endpoint round-tripped over real HTTP with monotone
-counters across scrapes).
+counters across scrapes) and catalog_smoke (PR 20 — one request
+through a catalog-attached scheduler: the cold build round-trips one
+durable program-catalog row, the cost-model drift and registry gauges
+land on a real-HTTP scrape, /w/batch/programs serves the report).
 
 Measurement protocol: the shared `wittgenstein_tpu.utils.measure`
 module (the same one `bench.py` uses — ONE implementation of the
 un-fakeable protocol).  A config that faults or fails its convergence
 assert emits an `"error"` line instead of killing the suite.
+
+Every emitted line also appends a row to the bench-history ledger
+(reports/bench_history.jsonl; --no-history or WTPU_HISTORY=0 skips),
+keyed on (stage, config digest, backend, host fingerprint);
+``--check-regressions`` gates the round against same-host baselines
+with the median/MAD detector (wittgenstein_tpu/obs/regress.py) and
+exits 1 on a regression.  tools/regress.py runs the same gate after
+the fact.
 
 Usage: python tools/bench_suite.py [config ...]   (default: all)
 Output: one JSON line per config on stdout.
@@ -917,6 +928,82 @@ def bench_spans_smoke():
             "platform": jax.default_backend()}
 
 
+def bench_catalog_smoke():
+    """Program-observatory smoke stage (PR 20): one request through a
+    catalog-attached scheduler, asserting the whole observatory seam
+    end to end in seconds — a COLD build round-trips one durable
+    catalog row (compile key, backend, compile wall, memory_analysis
+    bytes, cost_analysis flops, the build-time cost-model
+    predictions), the drift and registry gauges land on a REAL-HTTP
+    `/w/batch/metrics` scrape, and `/w/batch/programs` serves the
+    report (top compile-wall consumers + drift pass) over the same
+    server."""
+    import os
+    import tempfile
+    import threading
+    import time
+    import urllib.request
+
+    import wittgenstein_tpu.models  # noqa: F401 — fills the registry
+    from wittgenstein_tpu.obs.metrics import parse_exposition
+    from wittgenstein_tpu.obs.programs import ProgramCatalog, read_catalog
+    from wittgenstein_tpu.serve import ScenarioSpec, Scheduler
+    from wittgenstein_tpu.serve.instrument import Instrumentation
+    from wittgenstein_tpu.server.http import make_server
+
+    spec = ScenarioSpec(protocol="PingPong", params={"node_count": 64},
+                        seeds=(0,), sim_ms=120, chunk_ms=40,
+                        obs=("metrics",))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "programs.jsonl")
+        ins = Instrumentation(worker="catalog_smoke")
+        sch = Scheduler(instrument=ins, catalog=ProgramCatalog(path=path))
+        httpd = make_server(port=0, batch_auto=False, scheduler=sch)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{port}"
+        t0 = time.perf_counter()
+        try:
+            rid = sch.submit(spec)
+            sch.run_pending()
+            req = sch.request(rid)
+            assert req.status == "done", req.error
+            with urllib.request.urlopen(f"{base}/w/batch/metrics",
+                                        timeout=10) as resp:
+                m = parse_exposition(resp.read().decode())
+            with urllib.request.urlopen(f"{base}/w/batch/programs",
+                                        timeout=10) as resp:
+                rep = json.loads(resp.read())
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        wall = time.perf_counter() - t0
+        # the cold build left exactly one durable, fully-populated row
+        rows = read_catalog(path)
+    assert len(rows) == 1, [r.get("key") for r in rows]
+    row = rows[0]
+    for field in ("key", "plane", "backend", "compile_wall_s",
+                  "memory", "cost", "predicted", "build_wall_s"):
+        assert field in row, (field, sorted(row))
+    assert row["compile_wall_s"] > 0 and row["build_wall_s"] > 0, row
+    assert row["predicted"]["route_vmem_bytes"] > 0, row["predicted"]
+    # drift + registry gauges on the real-HTTP scrape
+    assert m.get("wtpu_programs_cataloged") == 1, m
+    assert m.get("wtpu_registry_misses", 0) >= 1, m
+    drift_series = [k for k in m if k.startswith("wtpu_costmodel_drift{")]
+    assert drift_series, sorted(k for k in m if k.startswith("wtpu_"))
+    # the /w/batch/programs report names the build in its top table
+    assert rep["count"] == 1 and rep["top_compile"], rep
+    assert rep["top_compile"][0]["key"] == row["key"], rep["top_compile"]
+    assert any(d.get("vmem_ratio") for d in rep["drift"]), rep["drift"]
+    return {"metric": "catalog_smoke_programs", "value": len(rows),
+            "unit": "programs", "wall_s": round(wall, 2),
+            "compile_wall_s": round(row["compile_wall_s"], 3),
+            "drift_series": len(drift_series),
+            "vmem_ratio": rep["drift"][0].get("vmem_ratio"),
+            "platform": jax.default_backend()}
+
+
 #: the search_smoke stage's boundary question — module-level like
 #: MEMO_SMOKE_GRID (a consumer of its digest can never drift from the
 #: stage): a single-slice 6-step loss ladder whose done_frac >= 0.99
@@ -1021,6 +1108,7 @@ CONFIGS = {
     "spans_smoke": bench_spans_smoke,
     "analysis_smoke": bench_analysis_smoke,
     "search_smoke": bench_search_smoke,
+    "catalog_smoke": bench_catalog_smoke,
 }
 
 # Stages whose metric is not a throughput number: the error path must
@@ -1037,7 +1125,8 @@ METRIC_NAMES = {"trace_smoke": "trace_smoke_events",
                 "fleet_smoke": "fleet_smoke_requests",
                 "spans_smoke": "spans_smoke_spans",
                 "analysis_smoke": "analysis_smoke_wall_s",
-                "search_smoke": "search_smoke_cells_probed"}
+                "search_smoke": "search_smoke_cells_probed",
+                "catalog_smoke": "catalog_smoke_programs"}
 
 
 def _stage_spec(name):
@@ -1129,6 +1218,11 @@ def _stage_spec(name):
             protocol="PingPong", params={"node_count": 64}, seeds=(0,),
             sim_ms=120, chunk_ms=40, obs=("metrics", "audit"),
             superstep=1),
+        # the stage runs one catalogued request; the digested config
+        # is that request's spec (the fleet_smoke convention)
+        "catalog_smoke": dict(
+            protocol="PingPong", params={"node_count": 64}, seeds=(0,),
+            sim_ms=120, chunk_ms=40, obs=("metrics",), superstep=1),
         # the stage answers a whole boundary question; the digested
         # config is the search grid's BASE cell (the memo_smoke
         # convention — the search digest itself rides the result line)
@@ -1167,8 +1261,54 @@ def _append_ledger(name, res):
                                engine="vmapped")  # run_config's scan_chunk
 
 
-def main():
-    names = sys.argv[1:] or list(CONFIGS)
+def _append_history(history, name, res, round_id):
+    """One history row per emitted suite line (the regression gate's
+    input — obs/regress.py).  Error lines append with empty measures
+    (the detector skips them, but the round stays visible in the
+    ledger).  ``WTPU_HISTORY=0`` or ``--no-history`` skips.  Never
+    raises into the suite loop."""
+    from wittgenstein_tpu.obs import regress
+    spec = _stage_spec(name)
+    history.append(
+        stage=name, measures=regress.stage_measures(res),
+        round_id=round_id,
+        config_digest=spec.digest() if spec is not None else None,
+        backend=res.get("platform"), metric=res.get("metric"))
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+    import time
+
+    ap = argparse.ArgumentParser(
+        description="multi-config benchmark suite (one JSON line per "
+        "stage); appends a bench-history row per stage and can gate "
+        "the round against same-host baselines")
+    ap.add_argument("stages", nargs="*", metavar="config",
+                    help=f"stages to run (default: all; known: "
+                    f"{', '.join(CONFIGS)})")
+    ap.add_argument("--history",
+                    default=str(REPO / "reports" / "bench_history.jsonl"),
+                    help="bench-history ledger path (default: "
+                    "reports/bench_history.jsonl)")
+    ap.add_argument("--no-history", action="store_true",
+                    help="skip history appends (WTPU_HISTORY=0 does "
+                    "the same)")
+    ap.add_argument("--check-regressions", action="store_true",
+                    help="after the round, run the median/MAD gate "
+                    "(obs/regress.py) over the history and exit 1 on "
+                    "a regression")
+    args = ap.parse_args(argv)
+
+    names = args.stages or list(CONFIGS)
+    write_history = not args.no_history and \
+        os.environ.get("WTPU_HISTORY", "1") != "0"
+    hist = None
+    round_id = str(time.time_ns())
+    if write_history:
+        from wittgenstein_tpu.obs.regress import BenchHistory
+        hist = BenchHistory(args.history)
     for name in names:
         metric = METRIC_NAMES.get(name, f"{name}_agg_sim_ms_per_sec")
         try:
@@ -1179,8 +1319,24 @@ def main():
             res = {"metric": metric,
                    "error": f"{type(e).__name__}: {e!s:.300}"}
         _append_ledger(name, res)
+        if hist is not None:
+            _append_history(hist, name, res, round_id)
         print(json.dumps(res), flush=True)
+    if args.check_regressions:
+        if hist is None:
+            print("bench_suite: --check-regressions needs history "
+                  "appends on", file=sys.stderr)
+            return 2
+        from wittgenstein_tpu.obs import regress
+        code, findings, summary = regress.gate(args.history,
+                                               round_id=round_id)
+        print(json.dumps({"metric": "regression_gate", "exit": code,
+                          **summary}), flush=True)
+        if findings:
+            print(regress.format_findings(findings), file=sys.stderr)
+        return code
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Render a program catalog: what did this host compile, and what
+did it cost?
+
+The program observatory (wittgenstein_tpu/obs/programs.py) leaves one
+``programs*.jsonl`` per catalog-attached process — one durable row
+per compiled program carrying the compile key, backend, compile wall,
+`memory_analysis()` byte classes, `cost_analysis()` flops, and the
+engine cost model's own build-time predictions.  This CLI reads a
+file or globs a run directory (dead workers' torn tails included —
+the reader is tail-tolerant) and prints the report the serve plane
+serves live at ``GET /w/batch/programs``:
+
+  * top compile-wall consumers (where did the build minutes go),
+  * the bytes-per-program table (temp / argument / output / code),
+  * cost-model drift outliers (predicted VMEM vs measured temp,
+    |log ratio| sorted — under- and over-prediction equally loud).
+
+    # a fleet run directory (programs-w0.jsonl, programs-w1.jsonl...)
+    python tools/programs.py reports/fleet_run
+
+    # one worker's catalog, machine-readable
+    python tools/programs.py reports/run/programs-w0.jsonl --json
+
+Exit code 0 on success, 2 when no catalog rows are found (nothing to
+render is a configuration error, not an empty observatory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from wittgenstein_tpu.obs.programs import (read_catalog,  # noqa: E402
+                                           summarize_programs)
+
+
+def collect_rows(target) -> tuple[list, list]:
+    """Every catalog row under `target`: a JSONL file is read as-is, a
+    directory is globbed recursively for ``programs*.jsonl`` (the
+    fleet layout — one catalog per worker)."""
+    if os.path.isdir(target):
+        files = sorted(glob.glob(os.path.join(target, "**",
+                                              "programs*.jsonl"),
+                                 recursive=True))
+    else:
+        files = [target] if os.path.exists(target) else []
+    rows = []
+    for f in files:
+        rows.extend(read_catalog(f))
+    return rows, files
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:d}B"
+        n /= 1024
+    return str(n)
+
+
+def render(rep: dict, files: list) -> str:
+    lines = [f"{rep['count']} program(s) from {len(files)} catalog "
+             f"file(s); compile wall total "
+             f"{rep['compile_wall_total_s']:.2f}s", ""]
+    lines.append("top compile-wall consumers:")
+    for t in rep["top_compile"]:
+        lines.append(f"  {t['key']}  plane={t['plane']}  "
+                     f"{t['compile_wall_s']:.3f}s")
+    lines.append("")
+    lines.append("bytes per program:")
+    hdr = (f"  {'key':<18} {'plane':<9} {'backend':<8} "
+           f"{'compile_s':>9} {'temp':>10} {'args':>10} "
+           f"{'output':>10} {'code':>10}")
+    lines.append(hdr)
+    for r in rep["programs"]:
+        mem = r.get("memory") or {}
+        lines.append(
+            f"  {str(r.get('key')):<18} {str(r.get('plane')):<9} "
+            f"{str(r.get('backend')):<8} "
+            f"{(r.get('compile_wall_s') or 0):>9.3f} "
+            f"{_fmt_bytes(mem.get('temp_bytes')):>10} "
+            f"{_fmt_bytes(mem.get('argument_bytes')):>10} "
+            f"{_fmt_bytes(mem.get('output_bytes')):>10} "
+            f"{_fmt_bytes(mem.get('code_bytes')):>10}")
+    if rep["drift_outliers"]:
+        lines.append("")
+        lines.append("cost-model drift outliers (measured temp / "
+                     "predicted route VMEM):")
+        for d in rep["drift_outliers"]:
+            extra = ""
+            if d.get("chunk_wall_mean_s") is not None:
+                extra = (f"  chunk_mean={d['chunk_wall_mean_s']:.4f}s"
+                         f" over {d['chunks']} chunk(s)")
+            lines.append(
+                f"  {d['key']}  plane={d['plane']}  "
+                f"ratio={d['vmem_ratio']:g}  "
+                f"({_fmt_bytes(d['measured_temp_bytes'])} vs "
+                f"{_fmt_bytes(d['predicted_vmem_bytes'])})" + extra)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a program-catalog JSONL (or a run "
+        "directory of them) into the /w/batch/programs report")
+    ap.add_argument("target", help="a programs*.jsonl file or a run "
+                    "directory to glob")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    rows, files = collect_rows(args.target)
+    if not rows:
+        print(f"programs: no catalog rows under {args.target}",
+              file=sys.stderr)
+        return 2
+    rep = summarize_programs(rows)
+    rep["files"] = files
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render(rep, files))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

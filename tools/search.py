@@ -96,6 +96,14 @@ def main(argv=None) -> int:
                     help="the shared fleet directory for --workers "
                          "(holds journal/, checkpoints/, ledger.jsonl, "
                          "memo_table/, workers/)")
+    ap.add_argument("--catalog", default=None, metavar="PATH",
+                    help="program-catalog JSONL (obs/programs.py): "
+                         "every probe program build appends a durable "
+                         "row (compile wall, memory/cost analysis, "
+                         "cost-model predictions) — render with "
+                         "tools/programs.py (single-process mode "
+                         "only; fleet workers take --catalog on the "
+                         "worker CLI)")
     ap.add_argument("--plan-only", action="store_true",
                     help="compile + print the probe plan accounting, "
                          "run nothing")
@@ -141,6 +149,13 @@ def main(argv=None) -> int:
                   "same --fleet-dir serves finished probes from the "
                   "shared ledger automatically)", file=sys.stderr)
             return 2
+        if args.catalog:
+            print("config error: --catalog is single-process only "
+                  "(fleet workers own their catalogs: pass --catalog "
+                  "on the worker CLI, files land as "
+                  "<fleet-dir>/programs-<worker>.jsonl)",
+                  file=sys.stderr)
+            return 2
 
     def progress(p):
         if not args.quiet:
@@ -162,9 +177,13 @@ def main(argv=None) -> int:
               f"{r.get('memo_table_hits')} memo-table hits")
     else:
         from wittgenstein_tpu.serve import Scheduler
+        cat = None
+        if args.catalog:
+            from wittgenstein_tpu.obs.programs import ProgramCatalog
+            cat = ProgramCatalog(path=args.catalog)
         sch = Scheduler(ledger_path=args.ledger,
                         checkpoint_dir=args.checkpoint_dir,
-                        journal_dir=args.journal_dir)
+                        journal_dir=args.journal_dir, catalog=cat)
         try:
             run = run_search(spec, sch, splan=splan,
                              max_wave=args.max_wave,

@@ -88,6 +88,20 @@ def summarize(rows) -> str:
         lines.append("top wall consumers (by request):")
         for rid in sorted(by_rid, key=lambda r: -by_rid[r])[:5]:
             lines.append(f"  {rid:<28} {by_rid[rid]:>8.3f} s")
+    # compile spans carry their compile key (instrument.SPAN_COMPILE;
+    # the literal keeps this host tool jax-import-free) — name the
+    # top compile-wall keys so "where did the build minutes go" is
+    # answerable from the summary alone (tools/programs.py has the
+    # full per-program story)
+    by_key: dict = {}
+    for r in rows:
+        if r.get("name") == "serve.compile" and r.get("key"):
+            by_key[r["key"]] = by_key.get(r["key"], 0.0) \
+                + float(r.get("dur", 0.0))
+    if by_key:
+        lines.append("top compile-wall compile keys:")
+        for key in sorted(by_key, key=lambda k: -by_key[k])[:3]:
+            lines.append(f"  {key:<28} {by_key[key]:>8.3f} s")
     return "\n".join(lines)
 
 

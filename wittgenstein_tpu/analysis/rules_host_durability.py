@@ -13,7 +13,9 @@ rule makes that a static error:
 
   * **strict zone** — wittgenstein_tpu/serve/, matrix/, memo/ and
     obs/ledger.py + obs/spans.py (the flight recorder's durable JSONL
-    writer, PR 18) ARE the durable core: every raw write sink there
+    writer, PR 18) + obs/programs.py + obs/regress.py (the program
+    catalog and the bench-history ledger, PR 20 — both are durable
+    append-only logs) ARE the durable core: every raw write sink there
     (``open`` with a write mode, ``json.dump``, ``write_text``/
     ``write_bytes``, ``np.save*``, ``gzip.open``-for-write,
     ``checkpoint.save``) must sit in a function that fsyncs or
@@ -58,7 +60,9 @@ from .host_common import (HOST_DIRS, Aliases, iter_source_files,
 STRICT_PREFIXES = ("wittgenstein_tpu/serve/", "wittgenstein_tpu/matrix/",
                    "wittgenstein_tpu/memo/")
 STRICT_FILES = ("wittgenstein_tpu/obs/ledger.py",
-                "wittgenstein_tpu/obs/spans.py")
+                "wittgenstein_tpu/obs/spans.py",
+                "wittgenstein_tpu/obs/programs.py",
+                "wittgenstein_tpu/obs/regress.py")
 EXEMPT_FILES = ("wittgenstein_tpu/utils/jsonl.py",)
 
 DURABLE_PAT = re.compile(

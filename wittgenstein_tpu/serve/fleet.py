@@ -659,6 +659,12 @@ def main(argv=None) -> int:
                          "publish finished memo prefixes there and "
                          "resolve search probes' memo_fork "
                          "instructions against it")
+    ap.add_argument("--catalog", action="store_true",
+                    help="record the program observatory catalog to "
+                         "<dir>/programs-<worker>.jsonl (compile "
+                         "walls, memory/cost analysis, cost-model "
+                         "drift; report with tools/programs.py or "
+                         "GET /w/batch/programs on the front tier)")
     args = ap.parse_args(argv)
     # protocol registry fills as models import (the classpath-scan
     # analogue — server/http.py main does the same)
@@ -671,9 +677,16 @@ def main(argv=None) -> int:
             span_path=os.path.join(args.timeline,
                                    f"spans-{args.worker_id}.jsonl"),
             worker=args.worker_id)
+    sched_kw = None
+    if args.catalog:
+        from ..obs.programs import ProgramCatalog
+        sched_kw = {"catalog": ProgramCatalog(
+            path=os.path.join(args.dir,
+                              f"programs-{args.worker_id}.jsonl"),
+            metrics=ins.metrics if ins is not None else None)}
     w = FleetWorker(args.dir, args.worker_id, lease_ttl_s=args.ttl,
                     dedup=not args.no_dedup, instrument=ins,
-                    memo_table=args.memo_table)
+                    memo_table=args.memo_table, scheduler_kw=sched_kw)
     counters = w.run(poll_s=args.poll, idle_exit_s=args.idle_exit,
                      max_wall_s=args.max_wall)
     print(json.dumps({"worker": args.worker_id, **counters},

@@ -165,6 +165,13 @@ def refresh_scheduler_metrics(metrics, sch) -> None:
     ema = hs.get("chunk_wall_ema_s")
     if ema:
         metrics.set_gauge("wtpu_serve_chunk_wall_ema_seconds", ema)
+    # compile-registry warm/cold story (satellite of the program
+    # observatory: the per-artifact registry_block()s stay, but a
+    # scrape should not need an artifact to see the hit ratio)
+    reg = getattr(sch, "registry", None)
+    if reg is not None:
+        metrics.set_gauge("wtpu_registry_hits", reg.hits)
+        metrics.set_gauge("wtpu_registry_misses", reg.misses)
 
 
 def refresh_fleet_counters(metrics, counters) -> None:
@@ -190,6 +197,10 @@ def scheduler_exposition(sch) -> str:
     ins = getattr(sch, "_ins", None)
     metrics = ins.metrics if ins is not None else MetricsRegistry()
     refresh_scheduler_metrics(metrics, sch)
+    cat = getattr(sch, "catalog", None)
+    if cat is not None:
+        from ..obs.programs import refresh_catalog_metrics
+        refresh_catalog_metrics(metrics, cat)
     return metrics.exposition()
 
 

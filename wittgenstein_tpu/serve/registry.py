@@ -15,7 +15,17 @@ The request plane's latency story has two layers:
 
 Hit/miss counters are exported through the obs block conventions
 (`registry_block()` — one flat JSON-able dict, like
-`engine_metrics_block`/`audit_block`).
+`engine_metrics_block`/`audit_block`) and projected as
+``wtpu_registry_{hits,misses}`` gauges into ``GET /w/batch/metrics``
+(serve/instrument.refresh_scheduler_metrics).
+
+With a `ProgramCatalog` attached (``catalog=``, default None = zero
+cost beyond one is-None branch), every cold build returns an
+`obs.programs.CatalogProgram` instead of the bare jit wrapper: the
+program's first launch AOT-compiles for the observed shapes, serves
+the launch FROM that executable, and appends the program's catalog
+row (compile walls, memory/cost analysis, the build-time cost-model
+predictions staged here via `record_build`).
 """
 
 from __future__ import annotations
@@ -31,8 +41,11 @@ class CompileRegistry:
     the scheduler's lock; the jitted callables themselves are safe to
     call concurrently."""
 
-    def __init__(self, persistent: bool = True):
+    def __init__(self, persistent: bool = True, catalog=None):
         self.cache_dir = enable_persistent_cache() if persistent else None
+        #: program observatory (obs/programs.ProgramCatalog; None =
+        #: OFF, the default — never imported, one is-None branch)
+        self.catalog = catalog
         self._programs: dict = {}
         self.hits = 0
         self.misses = 0
@@ -77,6 +90,8 @@ class CompileRegistry:
     # ------------------------------------------------------------ builders
 
     def _build(self, spec: ScenarioSpec, plane: str | None, proto=None):
+        cat = self.catalog
+        t_build = 0.0 if cat is None else cat.now()
         proto = proto if proto is not None else spec.build_protocol()
         ms, k, eng = spec.chunk_ms, spec.superstep, spec.engine
         if plane is None:
@@ -142,8 +157,18 @@ class CompileRegistry:
         # tracing happens inside the FIRST call, and a process-level
         # WTPU_PALLAS_ROUTE must never flip what this compile key
         # claims was built (route_kernel is a program field).
-        from ..ops.pallas_route import with_route
-        return with_route(jax.jit(base), spec.route_kernel)
+        if cat is None:
+            from ..ops.pallas_route import with_route
+            return with_route(jax.jit(base), spec.route_kernel)
+        # catalog path: stage the build-time facts (host construction
+        # wall + the cost-model predictions, which need proto.cfg) and
+        # hand the launch seam an AOT-capturing wrapper — it runs the
+        # program under the same forced route pin `with_route` would.
+        from ..obs.programs import CatalogProgram
+        cat.record_build(spec, plane, proto.cfg,
+                         build_wall_s=cat.now() - t_build)
+        return CatalogProgram(jax.jit(base), spec.route_kernel, cat,
+                              spec.compile_key(), plane)
 
     # ------------------------------------------------------------- export
 

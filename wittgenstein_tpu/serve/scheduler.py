@@ -385,13 +385,25 @@ class Scheduler:
                  watchdog_factor: float | None = None,
                  watchdog_floor_s: float = 30.0,
                  worker_id: str | None = None,
-                 instrument=None):
-        self.registry = registry or CompileRegistry()
+                 instrument=None, catalog=None):
+        self.registry = registry or CompileRegistry(catalog=catalog)
         #: host flight recorder + metrics bundle
         #: (serve/instrument.Instrumentation; None = OFF, the default).
         #: Every instrumented site guards on ``self._ins is not None``
         #: — one attribute load, zero allocations when off.
         self._ins = instrument
+        #: program observatory (obs/programs.ProgramCatalog; None =
+        #: OFF, the default — one is-None branch per chunk, nothing
+        #: imported).  A caller-provided registry adopts it unless it
+        #: already carries its own; with both instrumentation and a
+        #: catalog on, chunk-wall samples also feed the shared metrics
+        #: registry's wtpu_program_chunk_seconds histogram.
+        self.catalog = catalog
+        if catalog is not None:
+            if self.registry.catalog is None:
+                self.registry.catalog = catalog
+            if instrument is not None and catalog.metrics is None:
+                catalog.metrics = instrument.metrics
         if instrument is not None and worker_id \
                 and instrument.spans.worker is None:
             instrument.spans.worker = str(worker_id)
@@ -1838,6 +1850,11 @@ class Scheduler:
                 ema = self.chunk_wall_ema_s
                 self.chunk_wall_ema_s = (dt if not ema
                                          else 0.8 * ema + 0.2 * dt)
+            if self.catalog is not None:
+                # per-launch chunk-wall sample into the program
+                # observatory (drift pass: measured walls next to the
+                # capture row's predicted/analyzed costs)
+                self.catalog.observe_chunk(key, dt, lanes=len(widths))
             if ins is not None:
                 from .instrument import SPAN_CHUNK
                 ins.end(SPAN_CHUNK, tc0, key=key, lanes=len(widths))

@@ -217,7 +217,23 @@ class Service:
         with self._matrix_mu:
             sc = dict(self._search_counters)
         refresh_search_counters(metrics, sc)
+        cat = getattr(self.scheduler, "catalog", None)
+        if cat is not None:
+            from ..obs.programs import refresh_catalog_metrics
+            refresh_catalog_metrics(metrics, cat)
         return metrics.exposition()
+
+    def programs(self) -> dict:
+        """GET /w/batch/programs — the program observatory report
+        (obs/programs.ProgramCatalog.report): the bytes-per-program
+        table, the top compile-wall consumers and the cost-model
+        drift pass.  ``{"catalog": "off"}`` when no catalog is
+        attached — an unconfigured observatory is an answer, not an
+        error."""
+        cat = getattr(self.scheduler, "catalog", None)
+        if cat is None:
+            return {"catalog": "off", "programs": [], "count": 0}
+        return cat.report()
 
     def recover(self) -> dict:
         """Crash-only restart seam: replay group checkpoints, then the
@@ -726,6 +742,29 @@ class FleetService:
         if ema:
             reg.set_gauge("wtpu_serve_chunk_wall_ema_seconds", ema)
         return reg.exposition()
+
+    def programs(self) -> dict:
+        """GET /w/batch/programs — the fleet's program observatory:
+        every worker's ``programs-*.jsonl`` catalog under the shared
+        directory (written by workers launched with ``--catalog``),
+        summarized as one cross-worker table.  No catalog files =
+        ``{"catalog": "off"}``, the single-process convention."""
+        import glob
+        import os
+
+        from ..obs.programs import read_catalog, summarize_programs
+        files = sorted(glob.glob(os.path.join(self.paths["dir"],
+                                              "programs*.jsonl")))
+        rows = []
+        for f in files:
+            rows.extend(read_catalog(f))
+        if not rows:
+            return {"catalog": "off", "programs": [], "count": 0,
+                    "fleet": True}
+        out = summarize_programs(rows)
+        out["catalog"] = {"fleet": True, "files": len(files),
+                          "durable": True}
+        return out
 
     def registry_stats(self) -> dict:
         """GET /w/batch/registry — numeric fields summed across the

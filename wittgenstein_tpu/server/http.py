@@ -48,7 +48,14 @@ service"; spec schema in serve/spec.py):
                                            quarantines/watchdog trips/
                                            lease traffic counters,
                                            queue+lag gauges, phase
-                                           histograms
+                                           histograms, registry hit/
+                                           miss + program gauges
+    GET  /w/batch/programs                 program observatory: per-
+                                           program compile walls,
+                                           memory/cost analysis,
+                                           cost-model drift (catalog
+                                           report; "off" when no
+                                           ProgramCatalog attached)
     GET  /w/batch/stream/{id}              long-poll: blocks until the
                                            next chunk boundary, returns
                                            per-chunk totals + deltas
@@ -176,6 +183,11 @@ class _Handler(BaseHTTPRequestHandler):
         # the str return)
         ("GET", r"^/w/batch/metrics$",
          lambda s, m, b: s.batch.metrics()),
+        # program observatory (obs/programs.py): per-program compile
+        # walls, memory/cost analysis and cost-model drift — the
+        # report twin of the wtpu_program_* gauges on /w/batch/metrics
+        ("GET", r"^/w/batch/programs$",
+         lambda s, m, b: s.batch.programs()),
         # long-poll partial-metrics stream (?after=MS&timeout=S) —
         # lock-free like every batch route, and REQUIRED to be: the
         # poll blocks for seconds by design
@@ -220,6 +232,7 @@ class _Handler(BaseHTTPRequestHandler):
         r"^/w/batch/memo$",
         r"^/w/batch/health$",
         r"^/w/batch/metrics$",
+        r"^/w/batch/programs$",
         r"^/w/batch/stream/([A-Za-z0-9_-]+)(?:\?(.*))?$",
         r"^/w/matrix/submit$",
         r"^/w/matrix/status/([A-Za-z0-9_-]+)$",
